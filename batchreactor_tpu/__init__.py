@@ -1,0 +1,41 @@
+"""batchreactor_tpu — TPU-native batch-reactor chemical-kinetics framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``vinodjanardhanan/BatchReactor.jl`` (isothermal constant-volume batch reactor
+with CHEMKIN gas-phase chemistry, mean-field surface chemistry, both coupled,
+or a user-defined rate function; see /root/reference/src/BatchReactor.jl).
+
+Architecture (host -> device):
+  host parsers (CHEMKIN / NASA-7 / surface XML / batch XML)
+    -> frozen mechanism pytrees of jnp tensors
+    -> pure jitted kinetics kernels (thermo, gas rates, surface rates, RHS)
+    -> batched implicit stiff integrator (SDIRK, Newton + LU, vmap-able)
+    -> mesh-sharded ensemble sweeps (jax.sharding, collective-free)
+    -> API layer reproducing the reference's three batch_reactor signatures.
+
+Chemistry spans ~40 orders of magnitude and the reference integrates at
+abstol=1e-10 (/root/reference/src/BatchReactor.jl:210), so float64 is enabled
+at import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .models.thermo import ThermoTable, create_thermo  # noqa: E402
+from .models.gas import GasMechanism, compile_gaschemistry  # noqa: E402
+from .models.surface import SurfaceMechanism, compile_mech  # noqa: E402
+from .api import Chemistry, batch_reactor  # noqa: E402
+
+__all__ = [
+    "ThermoTable",
+    "create_thermo",
+    "GasMechanism",
+    "compile_gaschemistry",
+    "SurfaceMechanism",
+    "compile_mech",
+    "Chemistry",
+    "batch_reactor",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,18 @@
+"""Public API — placeholder, implemented in the API-parity milestone."""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Chemistry:
+    """Chemistry-mode flags, mirroring ReactionCommons.Chemistry
+    (/root/reference/src/BatchReactor.jl:52,68)."""
+
+    surfchem: bool = False
+    gaschem: bool = False
+    userchem: bool = False
+    udf: object = None
+
+
+def batch_reactor(*args, **kwargs):  # pragma: no cover
+    raise NotImplementedError("API layer lands in a later milestone")

@@ -1,0 +1,10 @@
+"""Surface (mean-field catalytic) mechanism parser — placeholder, implemented
+in the surface-kinetics milestone."""
+
+
+class SurfaceMechanism:  # pragma: no cover - placeholder
+    pass
+
+
+def compile_mech(mech_file, thermo_obj, gasphase):  # pragma: no cover
+    raise NotImplementedError("surface chemistry lands in a later milestone")

@@ -1,0 +1,99 @@
+"""Batch-reactor ODE right-hand side as a pure, jit/vmap-able JAX function.
+
+Functional re-design of the reference's mutating ``residual!``
+(/root/reference/src/BatchReactor.jl:312-376).  State vector layout matches the
+reference (:224-232): per-species mass density rho_k = rho * Y_k [kg/m^3] for
+the n_gas species, optionally followed by n_surf surface coverages theta_k.
+
+Physics (docs at /root/reference/docs/src/index.md:26-38):
+  d(rho_k)/dt = sdot_k M_k Asv + wdot_k M_k          (gas species)
+  d(theta_k)/dt = sdot_k sigma_k / Gamma             (surface coverages)
+  rho = sum rho_k;  p = rho R T / Wbar  (recomputed algebraically every call)
+  isothermal, constant volume.
+
+Reference quirk (SURVEY.md): at :345 the reference multiplies the ENTIRE
+surface source vector (gas part and coverage part) by Asv, so coverage
+dynamics are scaled by Asv relative to the textbook equation.  We reproduce
+this behaviour behind ``asv_quirk`` (default True for parity).
+"""
+
+import jax.numpy as jnp
+
+from ..utils.composition import mass_to_mole, pressure
+from ..utils.constants import R
+from . import gas_kinetics, surface_kinetics
+
+
+def make_gas_rhs(gm, thermo):
+    """Pure RHS for gas-only chemistry: rhs(t, y, cfg) with y = rho_k (S,).
+
+    cfg is a dict pytree of per-lane parameters: {'T': K}.  Returns dy (S,).
+    """
+
+    def rhs(t, y, cfg):
+        T = cfg["T"]
+        # conc_k = x_k p/(RT) with p = rho R T/Wbar reduces exactly to
+        # rho_k / W_k — the reference's mole-frac/pressure round-trip
+        # (/root/reference/src/BatchReactor.jl:349-353) is algebraic identity.
+        conc = y / thermo.molwt  # mol/m^3
+        wdot = gas_kinetics.production_rates(T, conc, gm, thermo)
+        return wdot * thermo.molwt
+
+    return rhs
+
+
+def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True):
+    """Pure RHS for surface (and optionally coupled gas) chemistry.
+
+    y = [rho_k (n_gas), theta_k (n_surf)]; cfg = {'T': K, 'Asv': 1/m}.
+    ``sm`` is a SurfaceMechanism; ``gm`` adds gas-phase chemistry on top
+    (the reference's gas+surf mode, /root/reference/src/BatchReactor.jl:368-370).
+    """
+    ng = len(thermo.species) if gm is None else gm.n_species
+
+    def rhs(t, y, cfg):
+        T, Asv = cfg["T"], cfg["Asv"]
+        rho_k = y[:ng]
+        theta = y[ng:]
+        rho = jnp.sum(rho_k)
+        mass_fracs = rho_k / rho
+        mole_fracs = mass_to_mole(mass_fracs, thermo.molwt)
+        p = pressure(rho, mole_fracs, thermo.molwt, T)
+        sdot_gas, sdot_surf = surface_kinetics.production_rates(
+            T, p, mole_fracs, theta, sm, thermo
+        )
+        sdot_gas = sdot_gas * Asv
+        if asv_quirk:
+            sdot_surf = sdot_surf * Asv  # reference :345 scales coverages too
+        dy_gas = sdot_gas * thermo.molwt
+        if gm is not None:
+            conc = mole_fracs * p / (R * T)
+            wdot = gas_kinetics.production_rates(T, conc, gm, thermo)
+            dy_gas = dy_gas + wdot * thermo.molwt
+        # Gamma stored in mol/cm^2 like the reference's site density
+        # (/root/reference/test/lib/ch4ni.xml:6); x1e4 -> mol/m^2 (:367).
+        dtheta = sdot_surf * sm.site_coordination / (sm.site_density * 1e4)
+        return jnp.concatenate([dy_gas, dtheta])
+
+    return rhs
+
+
+def make_udf_rhs(udf, molwt):
+    """Pure RHS for a user-defined source function.
+
+    ``udf(t, state_dict) -> source (S,) [mol/m^3/s]`` must be JAX-traceable;
+    state_dict carries T, p, mole_frac, molwt (cf. UserDefinedState fields,
+    /root/reference/src/BatchReactor.jl:199 and docs/src/index.md:68-76).
+    """
+
+    def rhs(t, y, cfg):
+        T = cfg["T"]
+        rho = jnp.sum(y)
+        mass_fracs = y / rho
+        mole_fracs = mass_to_mole(mass_fracs, molwt)
+        p = pressure(rho, mole_fracs, molwt, T)
+        state = {"T": T, "p": p, "mole_frac": mole_fracs, "molwt": molwt}
+        source = udf(t, state)
+        return source * molwt
+
+    return rhs

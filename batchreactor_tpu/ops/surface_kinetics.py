@@ -1,0 +1,5 @@
+"""Surface kinetics kernel — placeholder, implemented in the surface milestone."""
+
+
+def production_rates(T, p, mole_fracs, theta, sm, thermo):  # pragma: no cover
+    raise NotImplementedError("surface kinetics lands in a later milestone")

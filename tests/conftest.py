@@ -1,0 +1,34 @@
+"""Test harness config: CPU backend, float64, 8 virtual devices for mesh tests.
+
+Must run before jax initializes a backend (SURVEY.md §4: the standard
+fake-multi-device trick, XLA_FLAGS=--xla_force_host_platform_device_count=N).
+"""
+
+import os
+
+# The axon TPU plugin in this image overrides the JAX_PLATFORMS env var, so the
+# cpu pin must go through jax.config (verified: env alone still yields the TPU).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import pathlib
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+REFERENCE = pathlib.Path("/root/reference")
+LIB = REFERENCE / "test" / "lib"
+
+
+@pytest.fixture(scope="session")
+def lib_dir():
+    return str(LIB)
+
+
+@pytest.fixture(scope="session")
+def reference_dir():
+    return REFERENCE

@@ -1,0 +1,142 @@
+"""Gas kinetics kernel tests: conservation laws, reversibility, jit/vmap/jacfwd
+safety.  The trajectory-level oracle against scipy BDF lives in
+test_integration.py (slow-marked)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchreactor_tpu.models.gas import compile_gaschemistry
+from batchreactor_tpu.models.thermo import create_thermo, element_matrix
+from batchreactor_tpu.ops import gas_kinetics
+from batchreactor_tpu.ops.rhs import make_gas_rhs
+from batchreactor_tpu.utils.composition import density, mole_to_mass
+from batchreactor_tpu.utils.constants import R
+
+
+@pytest.fixture(scope="module")
+def h2o2_setup(lib_dir):
+    gm = compile_gaschemistry(f"{lib_dir}/h2o2.dat")
+    th = create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+    return gm, th
+
+
+@pytest.fixture(scope="module")
+def gri_setup(lib_dir):
+    gm = compile_gaschemistry(f"{lib_dir}/grimech.dat")
+    th = create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+    return gm, th
+
+
+def _conc(gm, th, T=1173.0, p=1e5, comp=None):
+    sp = list(gm.species)
+    x = np.zeros(len(sp))
+    for name, v in (comp or {"H2": 0.25, "O2": 0.25, "N2": 0.5}).items():
+        x[sp.index(name)] = v
+    return jnp.asarray(x) * p / (R * T)
+
+
+def test_mass_conservation(h2o2_setup):
+    gm, th = h2o2_setup
+    conc = _conc(gm, th)
+    wdot = gas_kinetics.production_rates(1173.0, conc, gm, th)
+    assert abs(float(jnp.sum(wdot * th.molwt))) < 1e-12 * float(
+        jnp.sum(jnp.abs(wdot * th.molwt))
+    )
+
+
+def test_element_conservation_gri(gri_setup):
+    gm, th = gri_setup
+    conc = _conc(gm, th, comp={"CH4": 0.25, "O2": 0.5, "N2": 0.25})
+    wdot = np.asarray(gas_kinetics.production_rates(1173.0, conc, gm, th))
+    _, E = element_matrix(th)
+    balance = E @ wdot
+    assert np.all(np.abs(balance) < 1e-10 * np.abs(wdot).max())
+
+
+def test_detailed_balance(h2o2_setup):
+    """Construct the equilibrium composition of H2+O2=2OH from ln Kc and
+    assert that reaction's net rate vanishes (kr = kf/Kc consistency)."""
+    gm, th = h2o2_setup
+    T = 1500.0
+    i = list(gm.equations).index("H2+O2=2OH")
+    sp = list(gm.species)
+    log_Kc = float(gas_kinetics.equilibrium_constants(T, gm, th)[i])
+    # dn = 0 for this reaction: [OH]^2/([H2][O2]) = Kc at equilibrium
+    c = np.zeros(9)
+    c[sp.index("H2")] = 2.0
+    c[sp.index("O2")] = 3.0
+    c[sp.index("OH")] = np.sqrt(6.0 * np.exp(log_Kc))
+    q = np.asarray(gas_kinetics.reaction_rates(T, jnp.asarray(c), gm, th))
+    kf, _ = gas_kinetics.forward_rate_constants(T, jnp.asarray(c), gm)
+    rf = float(kf[i]) * 6.0  # forward rate of progress
+    assert abs(q[i]) < 1e-10 * rf  # net rate ~ 0 at equilibrium
+    # and a deliberately off-equilibrium composition must NOT balance
+    c[sp.index("OH")] *= 2.0
+    q2 = np.asarray(gas_kinetics.reaction_rates(T, jnp.asarray(c), gm, th))
+    assert abs(q2[i]) > 1e-3 * rf
+
+
+def test_rhs_jit_vmap_jacfwd(h2o2_setup):
+    gm, th = h2o2_setup
+    rhs = make_gas_rhs(gm, th)
+    sp = list(gm.species)
+    x = np.zeros(9)
+    x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = 0.25, 0.25, 0.5
+    rho = density(jnp.asarray(x), th.molwt, 1173.0, 1e5)
+    y0 = mole_to_mass(jnp.asarray(x), th.molwt) * rho
+    cfg = {"T": 1173.0}
+
+    dy = jax.jit(rhs)(0.0, y0, cfg)
+    assert np.all(np.isfinite(np.asarray(dy)))
+
+    J = jax.jacfwd(lambda y: rhs(0.0, y, cfg))(y0)
+    assert J.shape == (9, 9) and np.all(np.isfinite(np.asarray(J)))
+
+    ys = jnp.stack([y0, y0 * 1.1, y0 * 0.9])
+    cfgs = {"T": jnp.asarray([1173.0, 1200.0, 1100.0])}
+    dys = jax.vmap(lambda y, T: rhs(0.0, y, {"T": T}))(ys, cfgs["T"])
+    assert dys.shape == (3, 9) and np.all(np.isfinite(np.asarray(dys)))
+
+
+def test_negative_conc_no_nan(gri_setup):
+    """Newton iterates can momentarily go negative; RHS and Jacobian must stay
+    finite (CVODE-parity behaviour; SURVEY.md §7 hard parts)."""
+    gm, th = gri_setup
+    rhs = make_gas_rhs(gm, th)
+    sp = list(gm.species)
+    x = np.zeros(53)
+    x[sp.index("CH4")], x[sp.index("O2")], x[sp.index("N2")] = 0.25, 0.5, 0.25
+    rho = density(jnp.asarray(x), th.molwt, 1173.0, 1e5)
+    y0 = np.array(mole_to_mass(jnp.asarray(x), th.molwt) * rho)
+    y0[sp.index("OH")] = -1e-13  # small negative excursion
+    cfg = {"T": 1173.0}
+    dy = rhs(0.0, jnp.asarray(y0), cfg)
+    assert np.all(np.isfinite(np.asarray(dy)))
+    J = jax.jacfwd(lambda y: rhs(0.0, y, cfg))(jnp.asarray(y0))
+    assert np.all(np.isfinite(np.asarray(J)))
+
+
+def test_troe_falloff_limits(gri_setup):
+    """Falloff k must approach k_inf at high [M] and k0[M] at low [M]."""
+    gm, th = gri_setup
+    i = [
+        j
+        for j, eq in enumerate(gm.equations)
+        if eq.replace(" ", "") == "H+CH3(+M)<=>CH4(+M)"
+    ][0]
+    T = 1200.0
+
+    def k_eff(scale):
+        conc = jnp.full(53, scale)
+        kf, _ = gas_kinetics.forward_rate_constants(T, conc, gm)
+        return float(kf[i])
+
+    k_inf = float(gas_kinetics._arrhenius(T, gm.log_A, gm.beta, gm.Ea)[i])
+    k0 = float(gas_kinetics._arrhenius(T, gm.log_A0, gm.beta0, gm.Ea0)[i])
+    cM_hi = float(gm.eff[i] @ jnp.full(53, 1e6))
+    assert k_eff(1e6) / k_inf > 0.95  # high-pressure limit
+    lo = k_eff(1e-8)
+    cM_lo = float(gm.eff[i] @ jnp.full(53, 1e-8))
+    assert abs(lo / (k0 * cM_lo) - 1) < 0.5  # low-pressure limit (F<=1)

@@ -1,0 +1,138 @@
+"""CHEMKIN gas-mechanism parser tests.
+
+Oracles: mechanism feature counts recovered in SURVEY.md §6 from
+/root/reference/test/lib/{h2o2,grimech}.dat, plus hand-checked unit
+conversions for specific reaction lines.
+"""
+
+import numpy as np
+import pytest
+
+from batchreactor_tpu.models.gas import compile_gaschemistry
+from batchreactor_tpu.utils.constants import CAL_TO_J
+
+
+@pytest.fixture(scope="module")
+def h2o2(lib_dir):
+    return compile_gaschemistry(f"{lib_dir}/h2o2.dat")
+
+
+@pytest.fixture(scope="module")
+def gri(lib_dir):
+    return compile_gaschemistry(f"{lib_dir}/grimech.dat")
+
+
+def test_h2o2_counts(h2o2):
+    assert h2o2.n_species == 9
+    assert h2o2.n_reactions == 18
+    assert int(h2o2.has_falloff.sum()) == 0
+    assert int(h2o2.rev_mask.sum()) == 18  # all reversible
+
+
+def test_gri_counts(gri):
+    assert gri.n_species == 53
+    assert gri.n_reactions == 325
+    assert int(gri.has_falloff.sum()) == 29  # LOW blocks (SURVEY.md §6)
+    assert int(gri.has_troe.sum()) == 26
+    assert gri.int_stoich
+
+
+def test_gri_species_order(gri):
+    assert gri.species[:4] == ("H2", "H", "O", "O2")
+    assert gri.species[47] == "N2" and gri.species[48] == "AR"
+
+
+def test_third_body_efficiencies_h2o2(h2o2):
+    # H+O2+M=HO2+M with H2O/21./ H2/3.3/ O2/0.0/  (h2o2.dat:12-13)
+    i = list(h2o2.equations).index("H+O2+M=HO2+M")
+    sp = list(h2o2.species)
+    eff = np.asarray(h2o2.eff[i])
+    assert eff[sp.index("H2O")] == 21.0
+    assert eff[sp.index("H2")] == 3.3
+    assert eff[sp.index("O2")] == 0.0
+    assert eff[sp.index("N2")] == 1.0  # default
+    assert h2o2.has_tb[i] == 1.0
+
+
+def test_arrhenius_si_conversion(h2o2):
+    """OH+H2=H2O+H  1.17E9 1.3 3626. — bimolecular: A_SI = A_cgs*1e-6."""
+    i = list(h2o2.equations).index("OH+H2=H2O+H")
+    assert np.isclose(float(np.exp(h2o2.log_A[i])), 1.17e9 * 1e-6)
+    assert float(h2o2.beta[i]) == 1.3
+    assert np.isclose(float(h2o2.Ea[i]), 3626.0 * CAL_TO_J)
+
+
+def test_third_body_si_conversion(h2o2):
+    """H+O2+M=HO2+M 2.1E18: order 2 + M -> A_SI = A_cgs*(1e-6)^2."""
+    i = list(h2o2.equations).index("H+O2+M=HO2+M")
+    assert np.isclose(float(np.exp(h2o2.log_A[i])), 2.1e18 * 1e-12)
+
+
+def test_explicit_collider(h2o2):
+    """H+O2+O2=HO2+O2 is a plain trimolecular reaction, not third-body."""
+    i = list(h2o2.equations).index("H+O2+O2=HO2+O2")
+    assert h2o2.has_tb[i] == 0.0
+    sp = list(h2o2.species)
+    assert float(h2o2.nu_f[i, sp.index("O2")]) == 2.0
+    assert float(h2o2.nu_r[i, sp.index("O2")]) == 1.0
+    assert np.isclose(float(np.exp(h2o2.log_A[i])), 6.7e19 * 1e-12)
+
+
+def test_falloff_lowtroe(gri):
+    """H+CH3(+M)<=>CH4(+M) (grimech.dat): LOW + 4-param TROE."""
+    sp = list(gri.species)
+    idx = [
+        i
+        for i, eq in enumerate(gri.equations)
+        if eq.replace(" ", "") == "H+CH3(+M)<=>CH4(+M)"
+    ]
+    assert len(idx) == 1
+    i = idx[0]
+    assert gri.has_falloff[i] == 1.0 and gri.has_troe[i] == 1.0
+    # kinf: A=1.390E+16 b=-.534 Ea=536.0 cal; bimolecular
+    assert np.isclose(float(np.exp(gri.log_A[i])), 1.39e16 * 1e-6)
+    assert np.isclose(float(gri.beta[i]), -0.534)
+    # LOW/ 2.620E+33 -4.760 2440.00/ : order+1=3 -> (1e-6)^2
+    assert np.isclose(float(np.exp(gri.log_A0[i])), 2.62e33 * 1e-12)
+    assert np.isclose(float(gri.Ea0[i]), 2440.0 * CAL_TO_J)
+    # TROE/ .7830 74.00 2941.00 6964.00/
+    np.testing.assert_allclose(
+        np.asarray(gri.troe[i]), [0.783, 74.0, 2941.0, 6964.0]
+    )
+    # efficiencies parsed from following line
+    assert float(gri.eff[i, sp.index("CH4")]) == 3.0
+
+
+def test_gri_troe_all_4param(gri):
+    """Every GRI TROE line carries 4 parameters; T2 must be finite there."""
+    troe_rows = np.where(np.asarray(gri.has_troe) > 0)[0]
+    assert len(troe_rows) == 26
+    assert np.all(np.isfinite(np.asarray(gri.troe[troe_rows, 3])))
+
+
+def test_troe_3param_synthetic(tmp_path):
+    """3-parameter TROE (no T2 term) must parse with T2 = +inf sentinel."""
+    mech = tmp_path / "mini.dat"
+    mech.write_text(
+        "ELEMENTS\nH O\nEND\nSPECIES\nH O2 HO2\nEND\nREACTIONS\n"
+        "H+O2(+M)<=>HO2(+M)  4.650E+12  0.44  0.0\n"
+        "   LOW/ 6.366E+20 -1.72 524.8/\n"
+        "   TROE/ 0.5 1.0E-30 1.0E+30/\n"
+        "END\n"
+    )
+    gm = compile_gaschemistry(str(mech))
+    assert gm.n_reactions == 1
+    assert float(gm.has_troe[0]) == 1.0
+    assert np.isinf(float(gm.troe[0, 3]))
+
+
+def test_duplicates_kept_as_rows(gri):
+    """6 DUPLICATE markers -> pairs stay as independent rows (rates add)."""
+    eqs = [eq for eq in gri.equations]
+    dup_eqs = {eq for eq in eqs if eqs.count(eq) > 1}
+    assert len(dup_eqs) >= 3  # e.g. O+C2H4, O+C2H5, OH+HO2, CH+H2O...
+
+
+def test_irreversible(gri):
+    irrev = 325 - int(gri.rev_mask.sum())
+    assert irrev == 16  # GRI-Mech 3.0 has 16 '=>' reactions

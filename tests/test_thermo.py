@@ -1,0 +1,92 @@
+"""NASA-7 thermo parsing + evaluation tests.
+
+Oracles: JANAF standard-state values, the golden initial density committed at
+/root/reference/test/batch_gas_and_surf/gas_profile.csv (row t=0), and
+internal-consistency (range continuity at T_mid).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchreactor_tpu.models.thermo import create_thermo, element_matrix, parse_thermo_entries
+from batchreactor_tpu.ops.thermo import cp_h_s_over_R, gibbs_over_RT
+from batchreactor_tpu.utils.composition import density, mass_to_mole, mole_to_mass
+from batchreactor_tpu.utils.constants import R
+
+
+@pytest.fixture(scope="module")
+def therm(lib_dir):
+    return f"{lib_dir}/therm.dat"
+
+
+def test_parse_all_entries(therm):
+    entries = parse_thermo_entries(therm)
+    assert len(entries) == 53  # GRI-Mech 3.0 thermo (SURVEY.md §6)
+    assert "CH2(S)" in entries and "AR" in entries
+
+
+def test_molecular_weights(therm):
+    t = create_thermo(["H2", "O2", "CH4", "AR"], therm)
+    np.testing.assert_allclose(
+        np.asarray(t.molwt) * 1e3, [2.01594, 31.9988, 16.04303, 39.948], rtol=1e-4
+    )
+
+
+def test_janaf_standard_state(therm):
+    t = create_thermo(["H2O", "O2", "CH4", "CO2"], therm)
+    cp, h, s = cp_h_s_over_R(298.15, t)
+    # heats of formation at 298.15 K [kJ/mol]
+    np.testing.assert_allclose(
+        np.asarray(h) * R * 298.15 / 1e3,
+        [-241.83, 0.0, -74.87, -393.52],
+        rtol=2e-3,
+        atol=0.3,
+    )
+    # standard entropies [J/mol/K]
+    np.testing.assert_allclose(
+        np.asarray(s) * R, [188.8, 205.1, 186.3, 213.8], rtol=2e-3
+    )
+    # cp [J/mol/K]
+    np.testing.assert_allclose(np.asarray(cp) * R, [33.6, 29.4, 35.7, 37.1], rtol=5e-3)
+
+
+def test_range_continuity(therm):
+    """cp/h/s must be continuous at the low/high switch temperature."""
+    t = create_thermo(["H2O", "CH4", "OH", "CO"], therm)
+    Tmid = float(t.T_mid[0])
+    lo = jnp.stack(cp_h_s_over_R(Tmid - 1e-7, t))
+    hi = jnp.stack(cp_h_s_over_R(Tmid + 1e-7, t))
+    np.testing.assert_allclose(np.asarray(lo), np.asarray(hi), rtol=1e-5)
+
+
+def test_golden_initial_density(therm):
+    """Pin R & atomic masses against the committed golden CSV initial row
+    (/root/reference/test/batch_gas_and_surf/gas_profile.csv)."""
+    t = create_thermo(["CH4", "O2", "N2"], therm)
+    x = jnp.asarray([0.25, 0.5, 0.25])
+    rho = float(density(x, t.molwt, 1173.0, 1e5))
+    assert abs(rho - 0.27697974868307573) / 0.27697974868307573 < 1e-5
+
+
+def test_mass_mole_roundtrip(therm):
+    t = create_thermo(["H2", "O2", "H2O", "N2"], therm)
+    x = jnp.asarray([0.1, 0.2, 0.3, 0.4])
+    y = mole_to_mass(x, t.molwt)
+    x2 = mass_to_mole(y, t.molwt)
+    np.testing.assert_allclose(np.asarray(x2), np.asarray(x), rtol=1e-12)
+
+
+def test_element_matrix(therm):
+    t = create_thermo(["CH4", "O2", "CO2", "H2O"], therm)
+    elements, E = element_matrix(t)
+    assert set(elements) == {"C", "H", "O"}
+    ch4 = E[:, 0]
+    assert ch4[elements.index("C")] == 1 and ch4[elements.index("H")] == 4
+
+
+def test_gibbs_matches_h_minus_s(therm):
+    t = create_thermo(["H2", "OH"], therm)
+    _, h, s = cp_h_s_over_R(1500.0, t)
+    g = gibbs_over_RT(1500.0, t)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(h - s), rtol=1e-14)
