@@ -81,20 +81,33 @@ def forward_rate_constants(T, conc, gm):
     return kf, tb_factor
 
 
-def equilibrium_constants(T, gm, thermo):
-    """ln of concentration-based equilibrium constants, ln Kc (R,)."""
+def equilibrium_constants(T, gm, thermo, kc_compat=False):
+    """ln of concentration-based equilibrium constants, ln Kc (R,).
+
+    ``kc_compat=True`` reproduces the reference stack's equilibrium-constant
+    convention, reverse-engineered from the committed golden trajectory
+    (/root/reference/test/batch_gas_and_surf/gas_profile.csv, row-2 finite
+    differences): for non-falloff reversible reactions its effective Kc
+    equals the physical Kc times (1e6)^dn with p0 = 1 bar — consistent with a
+    cgs/SI conversion applied with inverted sign in GasphaseReactions
+    (exact on the O2+M->2O+M reverse channel); falloff reactions do not carry
+    the factor.  Physically correct SI (p0 = 1 atm) is the default."""
     g = gibbs_over_RT(T, thermo)  # (S,)
     dnu = gm.nu_r - gm.nu_f
     dG = dnu @ g  # (R,) Delta G / RT
     dn = jnp.sum(dnu, axis=1)
-    log_Kc = -dG + dn * jnp.log(P_ATM / (R * T))
+    if kc_compat:
+        log_c0 = jnp.log(1e5 / (R * T)) + jnp.log(1e6) * (1.0 - gm.has_falloff)
+    else:
+        log_c0 = jnp.log(P_ATM / (R * T))
+    log_Kc = -dG + dn * log_c0
     return log_Kc
 
 
-def reaction_rates(T, conc, gm, thermo):
+def reaction_rates(T, conc, gm, thermo, kc_compat=False):
     """Net rate of progress q_i (R,) [mol/m^3/s]."""
     kf, tb = forward_rate_constants(T, conc, gm)
-    log_Kc = equilibrium_constants(T, gm, thermo)
+    log_Kc = equilibrium_constants(T, gm, thermo, kc_compat)
     # kr = kf/Kc evaluated as kf * exp(-ln Kc); clip keeps the unreachable
     # far-from-equilibrium extreme finite without changing reachable physics
     kr = gm.rev_mask * kf * jnp.exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
@@ -103,7 +116,7 @@ def reaction_rates(T, conc, gm, thermo):
     return (rf - rr) * tb
 
 
-def production_rates(T, conc, gm, thermo):
+def production_rates(T, conc, gm, thermo, kc_compat=False):
     """Species molar production rates wdot (S,) [mol/m^3/s]."""
-    q = reaction_rates(T, conc, gm, thermo)
+    q = reaction_rates(T, conc, gm, thermo, kc_compat)
     return (gm.nu_r - gm.nu_f).T @ q

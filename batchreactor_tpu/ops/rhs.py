@@ -24,10 +24,12 @@ from ..utils.constants import R
 from . import gas_kinetics, surface_kinetics
 
 
-def make_gas_rhs(gm, thermo):
+def make_gas_rhs(gm, thermo, kc_compat=False):
     """Pure RHS for gas-only chemistry: rhs(t, y, cfg) with y = rho_k (S,).
 
     cfg is a dict pytree of per-lane parameters: {'T': K}.  Returns dy (S,).
+    ``kc_compat`` selects the reference's equilibrium-constant quirk (see
+    ops/gas_kinetics.equilibrium_constants).
     """
 
     def rhs(t, y, cfg):
@@ -36,13 +38,13 @@ def make_gas_rhs(gm, thermo):
         # rho_k / W_k — the reference's mole-frac/pressure round-trip
         # (/root/reference/src/BatchReactor.jl:349-353) is algebraic identity.
         conc = y / thermo.molwt  # mol/m^3
-        wdot = gas_kinetics.production_rates(T, conc, gm, thermo)
+        wdot = gas_kinetics.production_rates(T, conc, gm, thermo, kc_compat)
         return wdot * thermo.molwt
 
     return rhs
 
 
-def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True):
+def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
     """Pure RHS for surface (and optionally coupled gas) chemistry.
 
     y = [rho_k (n_gas), theta_k (n_surf)]; cfg = {'T': K, 'Asv': 1/m}.
@@ -60,7 +62,7 @@ def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True):
         mole_fracs = mass_to_mole(mass_fracs, thermo.molwt)
         p = pressure(rho, mole_fracs, thermo.molwt, T)
         sdot_gas, sdot_surf = surface_kinetics.production_rates(
-            T, p, mole_fracs, theta, sm, thermo
+            T, p, mole_fracs, theta, sm
         )
         sdot_gas = sdot_gas * Asv
         if asv_quirk:
@@ -68,7 +70,7 @@ def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True):
         dy_gas = sdot_gas * thermo.molwt
         if gm is not None:
             conc = mole_fracs * p / (R * T)
-            wdot = gas_kinetics.production_rates(T, conc, gm, thermo)
+            wdot = gas_kinetics.production_rates(T, conc, gm, thermo, kc_compat)
             dy_gas = dy_gas + wdot * thermo.molwt
         # Gamma stored in mol/cm^2 like the reference's site density
         # (/root/reference/test/lib/ch4ni.xml:6); x1e4 -> mol/m^2 (:367).

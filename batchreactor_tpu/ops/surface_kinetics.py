@@ -1,5 +1,75 @@
-"""Surface kinetics kernel — placeholder, implemented in the surface milestone."""
+"""Surface molar production rates as a pure jnp kernel.
+
+Device-side rebuild of ``SurfaceReactions.calculate_molar_production_rates!``
+(/root/reference/src/BatchReactor.jl:344).  Pure function of
+(T, p, gas mole fractions, coverages); returns SI production rates
+(mol/m^2/s) for gas species and surface species separately.  Rate-law
+conventions are pinned against the committed golden trajectory — see the
+models/surface.py module docstring.
+
+Internally works in cgs (mol/cm^3 gas, mol/cm^2 surface) because the
+mechanism's A values are cgs; the single x1e4 conversion happens at the end.
+"""
+
+import jax.numpy as jnp
+
+from ..utils.constants import R
+
+_EXP_MAX = 690.0
+# cgs gas constant for the sticking flux sqrt(R T / 2 pi M): erg/(mol K)
+_R_CGS = R * 1e7
+_PI = 3.141592653589793
 
 
-def production_rates(T, p, mole_fracs, theta, sm, thermo):  # pragma: no cover
-    raise NotImplementedError("surface kinetics lands in a later milestone")
+def _pow_prod(base, expo, int_expo):
+    """prod_k base_k^expo_ik rows.  ``int_expo`` is static (decided at
+    compile_mech time) so XLA materializes exactly one branch: the masked
+    integer path for mechanisms whose exponents are all in {0,1,2,3}, or the
+    log/exp general path for fractional/negative <order> overrides."""
+    b = base[None, :]
+    if int_expo:
+        p = jnp.where(expo >= 1, b, 1.0)
+        p = jnp.where(expo >= 2, p * b, p)
+        p = jnp.where(expo >= 3, p * b, p)
+        return jnp.prod(p, axis=1)
+    safe = jnp.maximum(b, 1e-300)
+    return jnp.exp(jnp.sum(expo * jnp.log(safe), axis=1))
+
+
+def rate_constants(T, theta, sm):
+    """Effective rate constants (R,), cgs units."""
+    # coverage-dependent activation energy: Ea_eff = Ea + eps @ theta
+    # (applies to Arrhenius AND sticking rows — a <coverage> tag targeting a
+    # stick id modifies the sticking probability's activation energy)
+    Ea_eff = sm.Ea + sm.cov_eps @ theta
+    log_k = sm.log_A + sm.beta * jnp.log(T) - Ea_eff / (R * T)
+    k_arr = jnp.exp(jnp.clip(log_k, -_EXP_MAX, _EXP_MAX))
+    # sticking: (s0/(1-s0/2) if MWC) sqrt(RT/2piM) [cm/s], theta enters the
+    # rate directly (no Gamma^m) — golden-trajectory convention
+    s_eff = sm.stick_s0 * jnp.exp(
+        jnp.clip(sm.beta * jnp.log(T) - Ea_eff / (R * T), -_EXP_MAX, _EXP_MAX)
+    )
+    s_eff = jnp.where(sm.mwc > 0, s_eff / (1.0 - s_eff / 2.0), s_eff)
+    k_stick = s_eff * jnp.sqrt(_R_CGS * T / (2.0 * _PI * sm.stick_molwt))
+    return jnp.where(sm.stick > 0, k_stick, k_arr)
+
+
+def reaction_rates(T, p, mole_fracs, theta, sm):
+    """Rate of progress per reaction (R,), mol/cm^2/s."""
+    c_gas = mole_fracs * p / (R * T) * 1e-6           # mol/cm^3
+    c_surf = theta * sm.site_density / sm.site_coordination  # mol/cm^2
+    k = rate_constants(T, theta, sm)
+    gas_part = _pow_prod(c_gas, sm.expo_gas, sm.int_expo)
+    # stick rows use raw coverages; Arrhenius rows use surface concentrations
+    surf_conc_part = _pow_prod(c_surf, sm.expo_surf, sm.int_expo)
+    surf_theta_part = _pow_prod(theta, sm.expo_surf, sm.int_expo)
+    surf_part = jnp.where(sm.stick > 0, surf_theta_part, surf_conc_part)
+    return k * gas_part * surf_part
+
+
+def production_rates(T, p, mole_fracs, theta, sm):
+    """(sdot_gas (Sg,), sdot_surf (Ss,)) in SI mol/m^2/s."""
+    q = reaction_rates(T, p, mole_fracs, theta, sm)  # mol/cm^2/s
+    sdot_gas = (sm.nu_r_gas - sm.nu_f_gas).T @ q * 1e4
+    sdot_surf = (sm.nu_r_surf - sm.nu_f_surf).T @ q * 1e4
+    return sdot_gas, sdot_surf

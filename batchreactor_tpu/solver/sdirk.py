@@ -1,0 +1,221 @@
+"""Batched implicit stiff ODE solver: SDIRK4 + Newton, pure JAX.
+
+This is the TPU-native replacement for the reference's native compute
+component, Sundials CVODE_BDF (/root/reference/src/BatchReactor.jl:138,210 —
+variable-order BDF, Newton, dense LU, reltol 1e-6 / abstol 1e-10).  Instead of
+FFI into C, the whole integration loop is a single XLA program: it jits,
+vmaps over ensemble lanes (each lane with its own adaptive step size), and
+shards over a device mesh.
+
+Method: the classic L-stable, stiffly-accurate SDIRK4 of Hairer & Wanner
+(Solving ODEs II, Table 6.5): 5 stages, gamma = 1/4 on the whole diagonal,
+order 4 with an embedded order-3 error estimate.  One Jacobian (jax.jacfwd)
+and one dense LU per step attempt, reused across all 5 stage Newton solves —
+the same economy CVODE gets from its quasi-constant iteration matrix.
+
+Control flow is lax.while_loop/fori_loop only (XLA-compilable, no host
+callbacks); trajectory output goes to a fixed-size accepted-step buffer
+(the reference streams rows per accepted step via a callback,
+/root/reference/src/BatchReactor.jl:208; on TPU we save on-device and write
+files post-hoc).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.pytree import pytree_dataclass
+from .linalg import lu_factor, lu_solve
+
+# --- SDIRK4 tableau (Hairer & Wanner II, Table 6.5; gamma = 1/4) ---
+_GAMMA = 0.25
+_C = jnp.array([1 / 4, 3 / 4, 11 / 20, 1 / 2, 1.0])
+_A = (
+    (1 / 4,),
+    (1 / 2, 1 / 4),
+    (17 / 50, -1 / 25, 1 / 4),
+    (371 / 1360, -137 / 2720, 15 / 544, 1 / 4),
+    (25 / 24, -49 / 48, 125 / 16, -85 / 12, 1 / 4),
+)
+_B = jnp.array([25 / 24, -49 / 48, 125 / 16, -85 / 12, 1 / 4])
+_B_ERR = _B - jnp.array([59 / 48, -17 / 96, 225 / 32, -85 / 12, 0.0])
+
+# status codes (per lane)
+RUNNING, SUCCESS, MAX_STEPS_REACHED, DT_UNDERFLOW = 0, 1, 2, 3
+
+
+@pytree_dataclass(meta_fields=())
+class SolveResult:
+    """Per-lane outcome of an adaptive SDIRK solve (all fields batched under
+    vmap).  ``status`` is the failure-detection surface the reference exposes
+    as ``Symbol(sol.retcode)`` (/root/reference/src/BatchReactor.jl:216)."""
+
+    t: jnp.ndarray          # final time reached
+    y: jnp.ndarray          # final state
+    status: jnp.ndarray     # SUCCESS/MAX_STEPS_REACHED/DT_UNDERFLOW
+    n_accepted: jnp.ndarray
+    n_rejected: jnp.ndarray
+    ts: jnp.ndarray         # (n_save,) accepted-step times, +inf padded
+    ys: jnp.ndarray         # (n_save, n) accepted-step states, 0 padded
+    n_saved: jnp.ndarray    # number of valid rows in ts/ys (saturates)
+
+
+def _scaled_norm(e, y, rtol, atol):
+    scale = atol + rtol * jnp.abs(y)
+    return jnp.sqrt(jnp.mean(jnp.square(e / scale)))
+
+
+def solve(
+    rhs,
+    y0,
+    t0,
+    t1,
+    cfg,
+    *,
+    rtol=1e-6,
+    atol=1e-10,
+    max_steps=100_000,
+    n_save=0,
+    dt0=None,
+    max_newton=8,
+    newton_tol=0.03,
+    dt_min_factor=1e-14,
+):
+    """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` from t0 to t1.
+
+    Pure function of its inputs: jit/vmap/shard it freely.  ``n_save`` > 0
+    allocates an accepted-step trajectory buffer of that many rows (saving
+    every accepted step, like the reference's FunctionCallingCallback; rows
+    beyond the buffer are dropped with ``n_saved`` saturating).
+    """
+    y0 = jnp.asarray(y0)
+    n = y0.shape[0]
+    t0 = jnp.asarray(t0, dtype=y0.dtype)
+    t1 = jnp.asarray(t1, dtype=y0.dtype)
+    span = t1 - t0
+    eye = jnp.eye(n, dtype=y0.dtype)
+
+    f = functools.partial(rhs, cfg=cfg)
+    jac = jax.jacfwd(lambda t, y: rhs(t, y, cfg), argnums=1)
+
+    if dt0 is None:
+        # standard first-step heuristic (Hairer & Wanner II.4): h ~ 1% of the
+        # scale-relative state/derivative ratio, clipped into the span
+        f0 = f(t0, y0)
+        d0 = _scaled_norm(y0, y0, rtol, atol)
+        d1 = _scaled_norm(f0, y0, rtol, atol)
+        dt0 = jnp.clip(0.01 * d0 / jnp.maximum(d1, 1e-30), span * 1e-12, span)
+    dt0 = jnp.asarray(dt0, dtype=y0.dtype)
+
+    n_save_buf = max(n_save, 1)
+    ts_buf = jnp.full((n_save_buf,), jnp.inf, dtype=y0.dtype)
+    ys_buf = jnp.zeros((n_save_buf, n), dtype=y0.dtype)
+
+    def newton_stage(lu, base, t_stage, h, z_init, y_scale):
+        """Solve z = base + h*gamma*f(t_stage, z) by modified Newton."""
+
+        def cond(state):
+            z, it, delta_norm, converged, diverged = state
+            return (~converged) & (~diverged) & (it < max_newton)
+
+        def body(state):
+            z, it, prev_norm, _, _ = state
+            g = z - base - h * _GAMMA * f(t_stage, z)
+            dz = lu_solve(lu, -g)
+            z_new = z + dz
+            dnorm = _scaled_norm(dz, y_scale, rtol, atol)
+            converged = dnorm < newton_tol
+            # divergence guard: growing updates or non-finite iterates
+            growing = (it > 0) & (dnorm > 2.0 * prev_norm)
+            bad = ~jnp.isfinite(dnorm)
+            return (z_new, it + 1, dnorm, converged, growing | bad)
+
+        init = (z_init, jnp.array(0), jnp.array(jnp.inf, dtype=y0.dtype),
+                jnp.array(False), jnp.array(False))
+        z, it, dnorm, converged, diverged = lax.while_loop(cond, body, init)
+        return z, converged & jnp.isfinite(dnorm)
+
+    def attempt_step(t, y, h):
+        """One SDIRK4 step attempt: returns (y_new, err, newton_ok)."""
+        J = jac(t, y)
+        M = eye - h * _GAMMA * J
+        lu = lu_factor(M)  # pure-jnp pivoted GE (TPU f64-compatible, see linalg.py)
+
+        ks = []
+        ok = jnp.array(True)
+        z_pred = y
+        for i, a_row in enumerate(_A):
+            base = y
+            for j in range(i):
+                base = base + h * a_row[j] * ks[j]
+            t_stage = t + _C[i] * h
+            z, conv = newton_stage(lu, base, t_stage, h, z_pred, y)
+            ok = ok & conv
+            k_i = (z - base) / (h * _GAMMA)  # = f(t_stage, z) at convergence
+            ks.append(k_i)
+            z_pred = z  # next stage predictor
+
+        y_new = y + h * sum(b_i * k for b_i, k in zip(_B, ks))
+        err_vec = h * sum(be * k for be, k in zip(_B_ERR, ks))
+        err = _scaled_norm(err_vec, y, rtol, atol)
+        ok = ok & jnp.all(jnp.isfinite(y_new)) & jnp.isfinite(err)
+        return y_new, err, ok
+
+    def cond(carry):
+        t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved = carry
+        return status == RUNNING
+
+    def body(carry):
+        t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved = carry
+        h_eff = jnp.minimum(h, t1 - t)
+        y_new, err, ok = attempt_step(t, y, h_eff)
+        accept = ok & (err <= 1.0)
+
+        # PI step-size controller (embedded order 3 -> exponent base 1/4)
+        err_c = jnp.maximum(err, 1e-16)
+        ep = jnp.maximum(err_prev, 1e-16)
+        fac = 0.9 * err_c ** (-0.7 / 4.0) * ep ** (0.3 / 4.0)
+        fac = jnp.clip(fac, 0.2, 5.0)
+        h_next = jnp.where(ok, h_eff * fac, h_eff * 0.25)
+        h_next = jnp.where(accept, jnp.maximum(h_next, span * dt_min_factor), h_next)
+
+        t_new = jnp.where(accept, t + h_eff, t)
+        y_out = jnp.where(accept, y_new, y)
+        err_prev_new = jnp.where(accept, err_c, err_prev)
+        n_acc2 = n_acc + accept
+        n_rej2 = n_rej + (~accept)
+
+        # trajectory buffer: record accepted states while capacity remains
+        do_save = accept & (n_saved < n_save_buf) & (n_save > 0)
+        idx = jnp.minimum(n_saved, n_save_buf - 1)
+        ts2 = jnp.where(do_save, ts.at[idx].set(t_new), ts)
+        ys2 = jnp.where(do_save, ys.at[idx].set(y_out), ys)
+        n_saved2 = n_saved + do_save
+
+        # tolerance absorbs t + (t1 - t) rounding so the loop can't stall
+        finished = accept & (t_new >= t1 - span * 1e-14)
+        too_small = (~accept) & (h_next < span * dt_min_factor)
+        out_of_steps = (n_acc2 + n_rej2) >= max_steps
+        status2 = jnp.where(
+            finished,
+            SUCCESS,
+            jnp.where(
+                too_small, DT_UNDERFLOW, jnp.where(out_of_steps, MAX_STEPS_REACHED, RUNNING)
+            ),
+        ).astype(jnp.int32)
+        return (t_new, y_out, h_next, err_prev_new, status2, n_acc2, n_rej2,
+                ts2, ys2, n_saved2)
+
+    zero = jnp.array(0, dtype=jnp.int32)
+    init = (t0, y0, dt0, jnp.array(1.0, dtype=y0.dtype),
+            jnp.array(RUNNING, dtype=jnp.int32), zero, zero,
+            ts_buf, ys_buf, zero)
+    t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved = lax.while_loop(
+        cond, body, init
+    )
+    return SolveResult(
+        t=t, y=y, status=status, n_accepted=n_acc, n_rejected=n_rej,
+        ts=ts, ys=ys, n_saved=n_saved,
+    )

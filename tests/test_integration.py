@@ -1,0 +1,85 @@
+"""End-to-end trajectory tests: mechanism -> RHS -> SDIRK solve, validated
+against physics (equilibrium, conservation) and a scipy-BDF oracle of the
+identical RHS (the CPU stand-in for the reference's CVODE baseline;
+SURVEY.md §6 baseline protocol)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import batchreactor_tpu as br
+from batchreactor_tpu.models.thermo import element_matrix
+from batchreactor_tpu.ops.rhs import make_gas_rhs
+from batchreactor_tpu.solver.sdirk import SUCCESS, solve
+from batchreactor_tpu.utils.composition import density, mole_to_mass
+
+
+@pytest.fixture(scope="module")
+def h2o2_problem(lib_dir):
+    gm = br.compile_gaschemistry(f"{lib_dir}/h2o2.dat")
+    th = br.create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+    sp = list(gm.species)
+    x = np.zeros(9)
+    x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = 0.25, 0.25, 0.5
+    rho = density(jnp.asarray(x), th.molwt, 1173.0, 1e5)
+    y0 = mole_to_mass(jnp.asarray(x), th.molwt) * rho
+    return gm, th, y0
+
+
+def test_h2o2_equilibrium(h2o2_problem):
+    """batch_h2o2 config (/root/reference/test/batch_h2o2/batch.xml):
+    10 s at 1173 K burns H2 to completion -> known stoichiometric endpoint."""
+    gm, th, y0 = h2o2_problem
+    rhs = make_gas_rhs(gm, th)
+    r = jax.jit(
+        lambda y: solve(rhs, y, 0.0, 10.0, {"T": 1173.0}, rtol=1e-6, atol=1e-10)
+    )(y0)
+    assert int(r.status) == SUCCESS
+    sp = list(gm.species)
+    xf = np.asarray(r.y) / np.asarray(th.molwt)
+    xf /= xf.sum()
+    np.testing.assert_allclose(xf[sp.index("H2O")], 2 / 7, rtol=1e-4)
+    np.testing.assert_allclose(xf[sp.index("O2")], 1 / 7, rtol=1e-4)
+    np.testing.assert_allclose(xf[sp.index("N2")], 4 / 7, rtol=1e-4)
+    # mass conservation through ~500 implicit steps
+    assert abs(float(jnp.sum(r.y) - jnp.sum(y0))) < 1e-12
+
+
+def test_h2o2_trajectory_vs_scipy(h2o2_problem):
+    """Same RHS through scipy BDF at tighter tolerance: intermediate-time
+    composition must agree (trajectory-level, not just equilibrium)."""
+    gm, th, y0 = h2o2_problem
+    rhs = make_gas_rhs(gm, th)
+    t_end = 2e-3  # mid-ignition, the numerically hardest region
+    r = jax.jit(
+        lambda y: solve(rhs, y, 0.0, t_end, {"T": 1173.0}, rtol=1e-8, atol=1e-14)
+    )(y0)
+    assert int(r.status) == SUCCESS
+    f = jax.jit(rhs)
+    jac = jax.jit(jax.jacfwd(lambda y: rhs(0.0, y, {"T": 1173.0})))
+    from scipy.integrate import solve_ivp
+
+    ref = solve_ivp(
+        lambda t, y: np.asarray(f(t, jnp.asarray(y), {"T": 1173.0})),
+        (0, t_end), np.asarray(y0), method="BDF",
+        jac=lambda t, y: np.asarray(jac(jnp.asarray(y))),
+        rtol=1e-9, atol=1e-14,
+    )
+    assert ref.status == 0
+    major = np.asarray(r.y) > 1e-8  # compare species above noise floor
+    np.testing.assert_allclose(
+        np.asarray(r.y)[major], ref.y[:, -1][major], rtol=5e-4
+    )
+
+
+def test_element_conservation_along_trajectory(h2o2_problem):
+    gm, th, y0 = h2o2_problem
+    rhs = make_gas_rhs(gm, th)
+    r = solve(rhs, y0, 0.0, 10.0, {"T": 1173.0}, rtol=1e-6, atol=1e-10,
+              n_save=1024)
+    n = int(r.n_saved)
+    _, E = element_matrix(th)
+    moles = np.asarray(r.ys)[:n] / np.asarray(th.molwt)  # mol/m^3 per row
+    elem = moles @ E.T
+    np.testing.assert_allclose(elem, np.broadcast_to(elem[0], elem.shape), rtol=1e-9)

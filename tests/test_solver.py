@@ -1,0 +1,125 @@
+"""SDIRK4 solver tests: accuracy scaling, stiff oracle (Robertson vs scipy),
+per-lane vmap adaptivity, trajectory buffer, and failure detection (the
+status-code analog of the reference's retcode semantics,
+/root/reference/src/BatchReactor.jl:216)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.integrate import solve_ivp
+
+from batchreactor_tpu.solver.sdirk import (
+    DT_UNDERFLOW,
+    MAX_STEPS_REACHED,
+    SUCCESS,
+    solve,
+)
+
+
+def test_accuracy_tracks_rtol():
+    """y' = -y^2, y(0)=1 -> y(2) = 1/3; error must scale with rtol."""
+    rhs = lambda t, y, cfg: -y * y
+    errs = []
+    for rtol in [1e-4, 1e-6, 1e-8]:
+        r = solve(rhs, jnp.array([1.0]), 0.0, 2.0, None, rtol=rtol, atol=1e-12)
+        assert int(r.status) == SUCCESS
+        errs.append(abs(float(r.y[0]) - 1 / 3))
+    assert errs[0] < 1e-4 and errs[1] < 1e-6 and errs[2] < 1e-8
+    assert errs[2] < errs[1] < errs[0]
+
+
+def test_linear_decay_tight_tolerance():
+    """Stiff linear decay to a value well above atol: rel accuracy ~ rtol."""
+    r = solve(lambda t, y, cfg: -10.0 * y, jnp.array([1.0]), 0.0, 1.0, None,
+              rtol=1e-10, atol=1e-16)
+    assert int(r.status) == SUCCESS
+    assert abs(float(r.y[0]) - np.exp(-10.0)) / np.exp(-10.0) < 1e-8
+
+
+def _robertson(t, y, cfg):
+    d1 = -0.04 * y[0] + 1e4 * y[1] * y[2]
+    d3 = 3e7 * y[1] * y[1]
+    return jnp.stack([d1, -d1 - d3, d3])
+
+
+def test_robertson_vs_scipy():
+    """Canonical stiff benchmark over 5 decades of time."""
+    y0 = jnp.array([1.0, 0.0, 0.0])
+    r = jax.jit(
+        lambda y: solve(_robertson, y, 0.0, 1e5, None, rtol=1e-8, atol=1e-12)
+    )(y0)
+    assert int(r.status) == SUCCESS
+    ref = solve_ivp(
+        lambda t, y: np.asarray(_robertson(t, jnp.asarray(y), None)),
+        (0, 1e5), np.asarray(y0), method="BDF", rtol=1e-10, atol=1e-14,
+    )
+    np.testing.assert_allclose(np.asarray(r.y), ref.y[:, -1], rtol=1e-6)
+
+
+def test_vmap_per_lane_adaptivity():
+    """Lanes with 1e4x different stiffness solve independently under vmap."""
+    lam = jnp.array([1.0, 100.0, 10000.0])
+    r = jax.vmap(
+        lambda l: solve(lambda t, y, cfg: -l * y, jnp.array([1.0]), 0.0, 1.0,
+                        None, rtol=1e-6, atol=1e-14)
+    )(lam)
+    assert np.all(np.asarray(r.status) == SUCCESS)
+    # step counts must differ across lanes (independent adaptivity)
+    assert len(set(np.asarray(r.n_accepted).tolist())) > 1
+    np.testing.assert_allclose(
+        np.asarray(r.y[:, 0]), np.exp(-np.asarray(lam)), rtol=1e-5, atol=1e-12
+    )
+
+
+def test_trajectory_buffer():
+    rhs = lambda t, y, cfg: -y
+    r = solve(rhs, jnp.array([1.0]), 0.0, 1.0, None, rtol=1e-6, atol=1e-12,
+              n_save=256)
+    n = int(r.n_saved)
+    assert n == int(r.n_accepted)
+    ts = np.asarray(r.ts)[:n]
+    assert np.all(np.diff(ts) > 0) and ts[-1] >= 1.0 - 1e-12
+    np.testing.assert_allclose(np.asarray(r.ys)[:n, 0], np.exp(-ts), rtol=1e-5)
+    # padding is inf beyond n_saved
+    assert np.all(np.isinf(np.asarray(r.ts)[n:]))
+
+
+def test_buffer_overflow_saturates():
+    rhs = lambda t, y, cfg: -y
+    r = solve(rhs, jnp.array([1.0]), 0.0, 1.0, None, rtol=1e-10, atol=1e-14,
+              n_save=4)
+    assert int(r.status) == SUCCESS  # solve completes even when buffer fills
+    assert int(r.n_saved) == 4
+    assert int(r.n_accepted) > 4
+
+
+def test_max_steps_status():
+    r = solve(lambda t, y, cfg: -y, jnp.array([1.0]), 0.0, 1.0, None,
+              rtol=1e-12, atol=1e-16, max_steps=3)
+    assert int(r.status) == MAX_STEPS_REACHED
+
+
+def test_dt_underflow_on_nan_rhs():
+    """A lane whose RHS goes non-finite must fail loudly, not hang or poison."""
+    def bad(t, y, cfg):
+        return jnp.where(t > 0.1, jnp.nan, -1.0) * y
+    r = solve(bad, jnp.array([1.0]), 0.0, 1.0, None, rtol=1e-6, atol=1e-12)
+    assert int(r.status) == DT_UNDERFLOW
+    assert np.all(np.isfinite(np.asarray(r.y)))  # last good state retained
+
+
+def test_jit_and_grad_compatible():
+    """Solve must trace under jit; forward sensitivities via jacfwd over cfg
+    (the reference's sens hook returns the problem unsolved,
+    /root/reference/src/BatchReactor.jl:205-207 — we differentiate through)."""
+    def decay(t, y, cfg):
+        return -cfg["k"] * y
+
+    def final(k):
+        return solve(decay, jnp.array([1.0]), 0.0, 1.0, {"k": k},
+                     rtol=1e-8, atol=1e-12).y[0]
+
+    k = jnp.array(2.0)
+    dfdk = jax.jacfwd(final)(k)
+    # d/dk exp(-k) = -exp(-k)
+    assert abs(float(dfdk) + np.exp(-2.0)) < 1e-5
