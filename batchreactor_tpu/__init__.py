@@ -25,7 +25,8 @@ jax.config.update("jax_enable_x64", True)
 from .models.thermo import ThermoTable, create_thermo  # noqa: E402
 from .models.gas import GasMechanism, compile_gaschemistry  # noqa: E402
 from .models.surface import SurfaceMechanism, compile_mech  # noqa: E402
-from .api import Chemistry, batch_reactor  # noqa: E402
+from .api import Chemistry, SensitivityProblem, batch_reactor  # noqa: E402
+from .io.config import InputData, input_data  # noqa: E402
 
 __all__ = [
     "ThermoTable",
@@ -35,7 +36,10 @@ __all__ = [
     "SurfaceMechanism",
     "compile_mech",
     "Chemistry",
+    "SensitivityProblem",
     "batch_reactor",
+    "InputData",
+    "input_data",
 ]
 
 __version__ = "0.1.0"

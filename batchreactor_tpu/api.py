@@ -1,12 +1,49 @@
-"""Public API — placeholder, implemented in the API-parity milestone."""
+"""Public API: the reference's three ``batch_reactor`` entry points, TPU-first.
+
+The reference exposes one exported name with three Julia methods
+(/root/reference/src/BatchReactor.jl:51-54, 67-70, 86-147):
+
+1. ``batch_reactor(input_file, lib_dir; surfchem, gaschem, sens)`` — XML-driven
+   run that writes ``gas_profile.{dat,csv}`` (+ ``surface_covg.{dat,csv}``)
+   next to the input file and returns the solver retcode.
+2. ``batch_reactor(input_file, lib_dir, user_defined; sens)`` — same driver
+   with a user-defined source function instead of a mechanism.
+3. ``batch_reactor(inlet_comp::Dict, T, p, time; Asv, chem, thermo_obj, md)``
+   — programmatic dict-in/dict-out API for reactor networks; no files.
+
+Python has no multiple dispatch, so one ``batch_reactor`` function dispatches
+on the argument pattern (dict first argument -> programmatic; callable third
+argument -> UDF).  Everything device-side is pure JAX: the RHS comes from
+``ops.rhs`` and the integration is the jitted SDIRK4 solve in
+``solver.sdirk`` (the CVODE_BDF replacement), at the reference's tolerances
+reltol=1e-6 / abstol=1e-10 (:210).
+
+``sens=True`` reproduces the reference's sensitivity hook (return the
+problem *without* solving, :205-207) — here a :class:`SensitivityProblem`
+whose ``rhs`` is jit/grad/vmap-able, which is strictly more useful than the
+reference's ODEProblem: ``jax.jacfwd`` through ``solver.sdirk.solve`` gives
+forward sensitivities natively (tests/test_solver.py exercises this).
+"""
 
 import dataclasses
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .io.config import input_data, parse_composition_text
+from .io.writers import trim_trajectory, write_profiles
+from .ops.rhs import make_gas_rhs, make_surface_rhs, make_udf_rhs
+from .solver import sdirk
+from .utils.composition import density, mole_to_mass
 
 
 @dataclasses.dataclass(frozen=True)
 class Chemistry:
-    """Chemistry-mode flags, mirroring ReactionCommons.Chemistry
-    (/root/reference/src/BatchReactor.jl:52,68)."""
+    """Chemistry-mode flags, mirroring ``ReactionCommons.Chemistry``
+    (/root/reference/src/BatchReactor.jl:52,68; test/runtests.jl:45,63)."""
 
     surfchem: bool = False
     gaschem: bool = False
@@ -14,5 +51,225 @@ class Chemistry:
     udf: object = None
 
 
-def batch_reactor(*args, **kwargs):  # pragma: no cover
-    raise NotImplementedError("API layer lands in a later milestone")
+@dataclasses.dataclass(frozen=True)
+class SensitivityProblem:
+    """What ``sens=True`` returns instead of solving (reference :205-207
+    returns ``(params, prob, t_span)``).  ``rhs(t, y, cfg)`` is a pure JAX
+    function; differentiate the solve with ``jax.jacfwd`` over ``cfg`` or
+    ``y0`` for forward sensitivities."""
+
+    rhs: object
+    y0: jnp.ndarray
+    cfg: dict
+    t_span: tuple
+    species: tuple
+    surface_species: tuple | None
+
+
+# retcode strings, role-equivalent to Symbol(sol.retcode) == :Success
+# (/root/reference/src/BatchReactor.jl:216)
+_STATUS = {
+    int(sdirk.SUCCESS): "Success",
+    int(sdirk.MAX_STEPS_REACHED): "MaxIters",
+    int(sdirk.DT_UNDERFLOW): "DtLessThanMin",
+    int(sdirk.RUNNING): "Failure",
+}
+
+
+def get_solution_vector(mole_fracs, molwt, T, p, ini_covg=None):
+    """y0 = rho * Y_k (+ initial coverages) — the reference's
+    ``get_solution_vector`` (/root/reference/src/BatchReactor.jl:224-232)."""
+    mole_fracs = jnp.asarray(mole_fracs, dtype=jnp.float64)
+    molwt = jnp.asarray(molwt, dtype=jnp.float64)
+    rho = density(mole_fracs, molwt, T, p)
+    y = rho * mole_to_mass(mole_fracs, molwt)
+    if ini_covg is not None:
+        y = jnp.concatenate([y, jnp.asarray(ini_covg, dtype=jnp.float64)])
+    return y
+
+
+def _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk):
+    """RHS for a chemistry mode (the reference's 4-way branch,
+    /root/reference/src/BatchReactor.jl:314-373).  Called both eagerly and
+    inside :func:`_solve` under jit — the mechanism bundles may be tracers."""
+    if mode == "udf":
+        return make_udf_rhs(udf, thermo.molwt)
+    if mode in ("surf", "gas+surf"):
+        return make_surface_rhs(sm, thermo, gm=gm if mode == "gas+surf" else
+                                None, asv_quirk=asv_quirk,
+                                kc_compat=kc_compat)
+    if mode == "gas":
+        return make_gas_rhs(gm, thermo, kc_compat=kc_compat)
+    raise ValueError("at least one of surfchem/gaschem/userchem required")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mode", "udf", "kc_compat", "asv_quirk", "n_save",
+                     "max_steps"))
+def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
+           n_save, max_steps, kc_compat, asv_quirk):
+    """Jitted solve, cache-keyed on the chemistry *mode* rather than a
+    per-call rhs closure: mechanism tensor bundles enter as traced pytree
+    operands, so repeated calls with any same-shaped mechanism (the
+    reactor-network use case) reuse the compiled program."""
+    rhs = _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk)
+    return sdirk.solve(
+        rhs, y0, t0, t1, cfg,
+        rtol=rtol, atol=atol, n_save=n_save, max_steps=max_steps,
+    )
+
+
+def _mode(chem):
+    if chem.userchem:
+        return "udf"
+    if chem.surfchem and chem.gaschem:
+        return "gas+surf"
+    if chem.surfchem:
+        return "surf"
+    if chem.gaschem:
+        return "gas"
+    raise ValueError("at least one of surfchem/gaschem/userchem required")
+
+
+def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
+                     max_steps, kc_compat, asv_quirk, verbose):
+    """Core driver: parse XML -> build RHS -> solve -> write profiles
+    (reference :152-217)."""
+    import sys
+
+    id_ = input_data(input_file, lib_dir, chem)
+    mode = _mode(chem)
+    surf_species = id_.smd.species if chem.surfchem else None
+    covg0 = id_.smd.ini_covg if chem.surfchem else None
+    cfg = {"T": jnp.asarray(id_.T, dtype=jnp.float64),
+           "Asv": jnp.asarray(id_.Asv, dtype=jnp.float64)}
+    y0 = get_solution_vector(id_.mole_fracs, id_.thermo.molwt, id_.T, id_.p,
+                             ini_covg=covg0)
+    if sens:
+        rhs = _make_rhs(mode, chem.udf, id_.gmd, id_.smd, id_.thermo,
+                        kc_compat, asv_quirk)
+        return SensitivityProblem(
+            rhs=rhs, y0=y0, cfg=cfg, t_span=(0.0, id_.tf),
+            species=id_.species, surface_species=surf_species,
+        )
+
+    res = _solve(mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
+                 jnp.asarray(0.0), jnp.asarray(id_.tf), cfg,
+                 rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
+    ts, ys, truncated = trim_trajectory(0.0, y0, res)
+    if truncated:
+        print(f"warning: trajectory buffer full "
+              f"({int(res.n_accepted)} accepted steps > n_save={n_save}); "
+              f"profile files skip the overflow but end at the true final "
+              f"state", file=sys.stderr)
+    out_dir = os.path.dirname(os.path.abspath(input_file))
+    write_profiles(out_dir, id_.species, ts, ys, id_.T,
+                   np.asarray(id_.thermo.molwt), surface_species=surf_species)
+    if verbose:
+        # the reference prints every accepted time (:401); one summary line
+        # is kinder to terminals at TPU step counts
+        print(f"t = {float(res.t):.4e} s  "
+              f"({int(res.n_accepted)} accepted / {int(res.n_rejected)} "
+              f"rejected steps)")
+    return _STATUS.get(int(res.status), "Failure")
+
+
+def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
+                      rtol, atol, n_save, max_steps, kc_compat, asv_quirk):
+    """Dict-in/dict-out API (reference :86-147): no files; returns
+    ``(accepted_times, {species: final mole fraction})``.
+
+    Species layout follows ``thermo_obj.species`` (the reference uses dict
+    key order for the surface path and mechanism order for the gas path,
+    :103,:118-119 — both equal the order the caller built ``thermo_obj``
+    with).  Missing species zero-fill (:92-100).
+    """
+    species = thermo_obj.species
+    comp_text = ",".join(f"{k}={v}" for k, v in inlet_comp.items())
+    mole_fracs = parse_composition_text(comp_text, species)
+
+    if chem.surfchem and chem.gaschem:
+        # mirror the reference's limitation explicitly: its programmatic
+        # method overwrites the surf params with the gas params when both
+        # flags are set and would KeyError in residual! (SURVEY.md §3.3)
+        raise ValueError("programmatic API supports exactly one of "
+                         "surfchem/gaschem per call (as the reference does)")
+    if chem.surfchem:
+        mode, gm, sm, covg0 = "surf", None, md, md.ini_covg
+    elif chem.gaschem:
+        mode, gm, sm, covg0 = "gas", md, None, None
+    else:
+        raise ValueError("programmatic API needs surfchem or gaschem")
+
+    y0 = get_solution_vector(mole_fracs, thermo_obj.molwt, T, p,
+                             ini_covg=covg0)
+    cfg = {"T": jnp.asarray(T, dtype=jnp.float64),
+           "Asv": jnp.asarray(Asv, dtype=jnp.float64)}
+    res = _solve(mode, None, gm, sm, thermo_obj, y0,
+                 jnp.asarray(0.0), jnp.asarray(float(time)), cfg,
+                 rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
+    status = _STATUS.get(int(res.status), "Failure")
+    if status != "Success":
+        # fail loudly: a partial-integration composition is worse than an
+        # error for reactor-network callers
+        raise RuntimeError(
+            f"batch_reactor integration failed with {status} at "
+            f"t={float(res.t):.4e} of {float(time):.4e} s")
+    ts, _, _ = trim_trajectory(0.0, y0, res)
+
+    # final composition from the true final state res.y (the saved-step
+    # buffer may be truncated; res.y never is)
+    ng = len(species)
+    moles = np.asarray(res.y)[:ng] / np.asarray(thermo_obj.molwt)
+    x_end = moles / moles.sum()
+    return ts, dict(zip(species, x_end.tolist()))
+
+
+def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
+                  Asv=1.0, chem=None, thermo_obj=None, md=None,
+                  rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,
+                  kc_compat=False, asv_quirk=True, verbose=False):
+    """Simulate an isothermal constant-volume batch reactor (three forms).
+
+    Form 1 — file-driven:   ``batch_reactor(input_file, lib_dir,
+        surfchem=..., gaschem=..., sens=...) -> "Success" | ...``
+    Form 2 — user-defined:  ``batch_reactor(input_file, lib_dir, udf,
+        sens=...)`` with ``udf(t, state) -> source (S,) [mol/m^3/s]``
+        JAX-traceable; ``state`` has T, p, mole_frac, molwt.
+    Form 3 — programmatic:  ``batch_reactor(inlet_comp_dict, T, p, time,
+        Asv=..., chem=..., thermo_obj=..., md=...) -> (times, {sp: x})``
+
+    Extra (TPU-native) knobs beyond the reference: ``rtol/atol`` (defaults =
+    the reference's CVODE settings), ``kc_compat``/``asv_quirk`` parity
+    switches (PARITY.md), ``n_save`` trajectory buffer rows.
+    """
+    if args and isinstance(args[0], dict):
+        if len(args) != 4:
+            raise TypeError(
+                "programmatic form: batch_reactor(inlet_comp, T, p, time, "
+                "Asv=..., chem=..., thermo_obj=..., md=...)")
+        if chem is None or thermo_obj is None or md is None:
+            raise TypeError("programmatic form needs chem=, thermo_obj=, md=")
+        return _programmatic_run(
+            args[0], args[1], args[2], args[3], Asv=Asv, chem=chem,
+            thermo_obj=thermo_obj, md=md, rtol=rtol, atol=atol,
+            n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
+            asv_quirk=asv_quirk)
+
+    if len(args) == 3 and callable(args[2]):
+        chem = Chemistry(False, False, True, args[2])
+        return _file_driven_run(
+            args[0], args[1], chem, sens, rtol=rtol, atol=atol,
+            n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
+            asv_quirk=asv_quirk, verbose=verbose)
+
+    if len(args) == 2:
+        if chem is None:
+            chem = Chemistry(surfchem=surfchem, gaschem=gaschem)
+        return _file_driven_run(
+            args[0], args[1], chem, sens, rtol=rtol, atol=atol,
+            n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
+            asv_quirk=asv_quirk, verbose=verbose)
+
+    raise TypeError(f"unrecognized batch_reactor argument pattern: {args!r}")

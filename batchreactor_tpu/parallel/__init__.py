@@ -1,0 +1,15 @@
+from .sweep import (
+    ensemble_solve,
+    ignition_delay,
+    make_mesh,
+    pad_batch,
+    temperature_sweep,
+)
+
+__all__ = [
+    "ensemble_solve",
+    "ignition_delay",
+    "make_mesh",
+    "pad_batch",
+    "temperature_sweep",
+]

@@ -1,0 +1,105 @@
+"""Mesh-sharded ensemble sweeps — the framework's parallelism layer.
+
+The reference runs exactly one reactor condition per call, single-threaded
+(no Threads/Distributed/MPI anywhere in /root/reference — SURVEY.md §2c).
+The TPU-native scaling axis is the *ensemble batch*: one reactor condition
+per lane, RHS + Newton + LU vectorized with ``vmap`` into ``(B, S)``
+batched tensor ops that tile onto the MXU, and the batch axis sharded over
+the ICI device mesh with ``NamedSharding(P('batch'))``.  Lanes are
+independent, so the program is collective-free by construction; XLA moves
+nothing between chips until the host gathers results at the end.
+
+Each lane keeps its *own* adaptive step size (sdirk.solve's while_loop is
+vmapped, so XLA runs lanes until the slowest finishes — fast-igniting lanes
+mask out).  Per-lane ``status`` arrays are the failure-detection surface
+(SURVEY.md §5): a diverged lane reports DT_UNDERFLOW/MAX_STEPS without
+poisoning its neighbours.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..solver import sdirk
+
+
+def make_mesh(devices=None, axis="batch"):
+    """1-D device mesh over all (or the given) devices, for sweep sharding."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def pad_batch(batch_size, mesh):
+    """Smallest multiple of the mesh size >= batch_size (lanes pad with
+    copies so the shard is even; padded lanes are sliced off by the caller)."""
+    n = mesh.devices.size
+    return ((batch_size + n - 1) // n) * n
+
+
+def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
+                   rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
+                   dt0=None, dt_min_factor=1e-22):
+    """Solve a batch of reactor conditions in one XLA program.
+
+    ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
+    leaves (per-lane T, Asv, ...); scalars t0/t1 are shared.  With ``mesh``,
+    the batch axis is sharded ``P('batch')`` across devices (B must divide
+    evenly — see :func:`pad_batch`).  Returns a batched SolveResult.
+    """
+    solve1 = functools.partial(
+        sdirk.solve, rhs, rtol=rtol, atol=atol, max_steps=max_steps,
+        n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor)
+    vsolve = jax.vmap(lambda y0, cfg: solve1(y0, t0, t1, cfg))
+
+    if mesh is None:
+        return jax.jit(vsolve)(y0s, cfgs)
+
+    spec = NamedSharding(mesh, P(axis))
+    y0s = jax.device_put(y0s, spec)
+    cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
+    # outputs inherit the batch sharding; XLA inserts no collectives because
+    # lanes never exchange data
+    return jax.jit(vsolve)(y0s, cfgs)
+
+
+def temperature_sweep(rhs, y0, T_grid, t1, base_cfg=None, **kw):
+    """Convenience: one initial state swept over a temperature grid (the
+    ignition-delay workload in BASELINE.json's batch_ch4 config)."""
+    T_grid = jnp.asarray(T_grid)
+    B = T_grid.shape[0]
+    y0s = jnp.broadcast_to(y0, (B,) + y0.shape)
+    cfg = dict(base_cfg or {})
+    cfg = {k: jnp.broadcast_to(jnp.asarray(v), (B,)) for k, v in cfg.items()}
+    cfg["T"] = T_grid
+    return ensemble_solve(rhs, y0s, 0.0, t1, cfg, **kw)
+
+
+def ignition_delay(ts, ys, marker, mode="peak"):
+    """Per-lane ignition delay from saved trajectories.
+
+    The classic max-dT/dt marker is unavailable (isothermal reactor —
+    SURVEY.md §7.8), so use species markers: ``mode="peak"`` returns the
+    time of the marker species' maximum (e.g. OH mass density), ``"half"``
+    the first time it drops below half its initial value (fuel-consumption
+    marker).  ``ts``: (B, n_save) +inf-padded; ``ys``: (B, n_save, S);
+    ``marker``: species index.
+    """
+    c = ys[..., marker]                      # (B, n_save)
+    valid = jnp.isfinite(ts)
+    if mode == "peak":
+        c = jnp.where(valid, c, -jnp.inf)
+        idx = jnp.argmax(c, axis=-1)
+    elif mode == "half":
+        below = valid & (c < 0.5 * c[..., :1])
+        # first True; if never, fall back to the last valid index
+        idx = jnp.argmax(below, axis=-1)
+        never = ~jnp.any(below, axis=-1)
+        last = jnp.sum(valid, axis=-1) - 1
+        idx = jnp.where(never, last, idx)
+    else:
+        raise ValueError(f"unknown ignition-delay mode {mode!r}")
+    return jnp.take_along_axis(ts, idx[:, None], axis=-1)[:, 0]

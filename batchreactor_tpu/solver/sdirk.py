@@ -81,7 +81,7 @@ def solve(
     dt0=None,
     max_newton=8,
     newton_tol=0.03,
-    dt_min_factor=1e-14,
+    dt_min_factor=1e-22,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` from t0 to t1.
 
@@ -106,7 +106,10 @@ def solve(
         f0 = f(t0, y0)
         d0 = _scaled_norm(y0, y0, rtol, atol)
         d1 = _scaled_norm(f0, y0, rtol, atol)
-        dt0 = jnp.clip(0.01 * d0 / jnp.maximum(d1, 1e-30), span * 1e-12, span)
+        # lower clip must admit chemistry's ~1e-16 s initial transients
+        # (golden first step 4.3e-16 s, /root/reference/test/
+        # batch_gas_and_surf/gas_profile.csv row 2)
+        dt0 = jnp.clip(0.01 * d0 / jnp.maximum(d1, 1e-30), span * 1e-24, span)
     dt0 = jnp.asarray(dt0, dtype=y0.dtype)
 
     n_save_buf = max(n_save, 1)

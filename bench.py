@@ -1,0 +1,164 @@
+"""Headline benchmark: GRI-Mech 3.0 ignition-delay ensemble sweep.
+
+Protocol (BASELINE.md): the reference publishes no numbers, so the baseline
+is self-measured — single-CPU variable-order BDF (scipy, the CVODE solver
+family the reference uses, /root/reference/src/BatchReactor.jl:210) on the
+identical RHS at identical tolerances.  The stored measurement lives in
+BENCH_BASELINE.json (same workload: GRI-3.0, CH4/O2/N2 = 0.25/0.5/0.25,
+1 bar, t1 = 8e-4 s, rtol 1e-6 / atol 1e-10); re-measure live with
+``BENCH_CPU_LIVE=1`` (runs in a subprocess because the axon TPU plugin
+ignores JAX_PLATFORMS — CPU must be pinned via jax.config in a fresh
+process).
+
+The TPU number is a vmapped SDIRK4 ensemble sweep, one reactor condition
+per lane, on whatever jax.devices() provides.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": conditions/sec, "unit": ..., "vs_baseline": speedup}
+Diagnostics go to stderr.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+B = int(os.environ.get("BENCH_B", "256"))
+T_LO = float(os.environ.get("BENCH_T_LO", "1500.0"))
+T_HI = float(os.environ.get("BENCH_T_HI", "2000.0"))
+T1 = float(os.environ.get("BENCH_T1", "8e-4"))
+RTOL, ATOL = 1e-6, 1e-10
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def cpu_probe_main():
+    """Subprocess entry: measure single-CPU BDF seconds/lane on 3 probe
+    temperatures; prints one JSON number (mean seconds per lane)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+    from scipy.integrate import solve_ivp
+
+    sys.path.insert(0, REPO)
+    import batchreactor_tpu as br
+    from batchreactor_tpu.ops.rhs import make_gas_rhs
+    from batchreactor_tpu.utils.composition import density, mole_to_mass
+
+    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sp = list(gm.species)
+    x0 = np.zeros(len(sp))
+    x0[sp.index("CH4")], x0[sp.index("O2")], x0[sp.index("N2")] = .25, .5, .25
+    rhs = jax.jit(make_gas_rhs(gm, th))
+    walls = []
+    for T in np.linspace(T_LO, T_HI, 3):
+        rho = float(density(jnp.asarray(x0), th.molwt, float(T), 1e5))
+        y0 = np.asarray(mole_to_mass(jnp.asarray(x0), th.molwt)) * rho
+        cfg = {"T": jnp.asarray(float(T))}
+
+        def f(t, y):
+            return np.asarray(rhs(t, jnp.asarray(y), cfg))
+
+        f(0.0, y0)
+        t0 = time.perf_counter()
+        sol = solve_ivp(f, (0.0, T1), y0, method="BDF", rtol=RTOL, atol=ATOL)
+        walls.append(time.perf_counter() - t0)
+        print(f"probe T={T:.0f}: {walls[-1]:.2f}s success={sol.success}",
+              file=sys.stderr, flush=True)
+    print(json.dumps(float(np.mean(walls))))
+
+
+def cpu_seconds_per_lane():
+    if os.environ.get("BENCH_CPU_LIVE") == "1":
+        log("live CPU baseline probe (subprocess) ...")
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env={**os.environ, "BENCH_MODE": "cpu_probe"},
+            capture_output=True, text=True, timeout=1200)
+        log(out.stderr.strip())
+        return float(json.loads(out.stdout.strip().splitlines()[-1]))
+    path = os.path.join(REPO, "BENCH_BASELINE.json")
+    d = json.load(open(path))
+    log(f"stored CPU baseline: {d['mean_wall_s']:.3f}s/lane "
+        f"({d['workload']})")
+    return float(d["mean_wall_s"])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import batchreactor_tpu as br
+    from batchreactor_tpu.ops.rhs import make_gas_rhs
+    from batchreactor_tpu.parallel import ensemble_solve, ignition_delay
+    from batchreactor_tpu.solver.sdirk import SUCCESS
+    from batchreactor_tpu.utils.composition import density, mole_to_mass
+
+    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sp = list(gm.species)
+    x0 = np.zeros(len(sp))
+    # the reference's batch_ch4 mixture (/root/reference/test/batch_ch4/batch.xml)
+    x0[sp.index("CH4")], x0[sp.index("O2")], x0[sp.index("N2")] = .25, .5, .25
+    rhs = make_gas_rhs(gm, th)
+    T_grid = jnp.linspace(T_LO, T_HI, B)
+
+    def tpu_sweep():
+        rhos = jax.vmap(lambda T: density(jnp.asarray(x0), th.molwt, T, 1e5))(
+            T_grid)
+        y0 = mole_to_mass(jnp.asarray(x0), th.molwt)
+        y0s = rhos[:, None] * y0[None, :]
+        return ensemble_solve(
+            rhs, y0s, 0.0, T1, {"T": T_grid}, rtol=RTOL, atol=ATOL,
+            max_steps=100_000, n_save=1024, dt0=1e-10)
+
+    log(f"devices: {jax.devices()}")
+    log(f"compiling + warm-up sweep (B={B}, t1={T1}) ...")
+    t_c0 = time.perf_counter()
+    res = tpu_sweep()
+    jax.block_until_ready(res.y)
+    t_compile = time.perf_counter() - t_c0
+    n_ok = int((np.asarray(res.status) == SUCCESS).sum())
+    log(f"warm-up (incl. compile): {t_compile:.1f}s; ok: {n_ok}/{B}; "
+        f"mean accepted steps: {float(np.asarray(res.n_accepted).mean()):.0f}")
+
+    t0 = time.perf_counter()
+    res = tpu_sweep()
+    jax.block_until_ready(res.y)
+    tpu_wall = time.perf_counter() - t0
+    cps = B / tpu_wall
+    log(f"TPU sweep: {tpu_wall:.2f}s -> {cps:.2f} conditions/sec")
+
+    tau = np.asarray(ignition_delay(res.ts, res.ys, sp.index("CH4"),
+                                    mode="half"))
+    log(f"ignition delay range: {tau.min():.2e} .. {tau.max():.2e} s")
+
+    sec_per_lane = cpu_seconds_per_lane()
+    speedup = sec_per_lane * B / tpu_wall
+    log(f"single-CPU extrapolated ({sec_per_lane:.3f}s x {B} lanes = "
+        f"{sec_per_lane * B:.0f}s) -> speedup {speedup:.2f}x")
+
+    print(json.dumps({
+        "metric": "GRI30_ignition_sweep_throughput",
+        "value": round(cps, 3),
+        "unit": "conditions/sec",
+        "vs_baseline": round(speedup, 3),
+    }))
+
+
+if __name__ == "__main__":
+    if os.environ.get("BENCH_MODE") == "cpu_probe":
+        cpu_probe_main()
+    else:
+        main()

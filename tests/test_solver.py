@@ -103,7 +103,10 @@ def test_dt_underflow_on_nan_rhs():
     """A lane whose RHS goes non-finite must fail loudly, not hang or poison."""
     def bad(t, y, cfg):
         return jnp.where(t > 0.1, jnp.nan, -1.0) * y
-    r = solve(bad, jnp.array([1.0]), 0.0, 1.0, None, rtol=1e-6, atol=1e-12)
+    # dt_min_factor pinned: the production default (1e-22, sized for
+    # chemistry's 1e-16 s transients) would hit max_steps first
+    r = solve(bad, jnp.array([1.0]), 0.0, 1.0, None, rtol=1e-6, atol=1e-12,
+              dt_min_factor=1e-14)
     assert int(r.status) == DT_UNDERFLOW
     assert np.all(np.isfinite(np.asarray(r.y)))  # last good state retained
 
