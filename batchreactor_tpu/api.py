@@ -120,6 +120,59 @@ def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
     )
 
 
+def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
+                  n_save, max_steps, kc_compat, asv_quirk):
+    """backend="cpu": the native (C++) CVODE-class BDF runtime
+    (native/br_native.cpp) — the role the reference fills with SUNDIALS
+    (/root/reference/src/BatchReactor.jl:138,210).  Gas-only chemistry runs
+    all-native; other modes integrate the JAX RHS through the generic
+    callback BDF (correct, host-speed)."""
+    from . import native
+
+    if mode == "gas":
+        return native.solve_gas_bdf(
+            gm, thermo, float(cfg["T"]), np.asarray(y0), float(t0), float(t1),
+            rtol=rtol, atol=atol, max_steps=max_steps, n_save=n_save,
+            kc_compat=kc_compat)
+    rhs = _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk)
+    cfg_np = {k: jnp.asarray(v) for k, v in cfg.items()}
+
+    def f(t, y):
+        return np.asarray(rhs(t, jnp.asarray(y), cfg_np))
+
+    return native.solve_bdf(f, np.asarray(y0), float(t0), float(t1),
+                            rtol=rtol, atol=atol, max_steps=max_steps,
+                            n_save=n_save)
+
+
+def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
+               atol, n_save, max_steps, kc_compat, asv_quirk):
+    """Dispatch one solve to the requested backend and normalize the result:
+    returns (status_str, t_end, y_end, ts, ys, truncated, n_acc, n_rej)
+    with ts/ys the saved trajectory *including* the initial row."""
+    if backend == "cpu":
+        res = _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg,
+                            rtol, atol, n_save, max_steps, kc_compat,
+                            asv_quirk)
+        ts = np.concatenate([[float(t0)], res.ts])
+        ys = np.concatenate([np.asarray(y0)[None, :], res.ys])
+        truncated = res.n_accepted > res.ts.shape[0]
+        if truncated:
+            ts = np.concatenate([ts, [res.t]])
+            ys = np.concatenate([ys, res.y[None, :]])
+        return (res.status, res.t, res.y, ts, ys, truncated,
+                res.n_accepted, res.n_rejected)
+    if backend != "jax":
+        raise ValueError(f"unknown backend {backend!r}; use 'jax' or 'cpu'")
+    res = _solve(mode, udf, gm, sm, thermo, y0,
+                 jnp.asarray(t0), jnp.asarray(t1), cfg,
+                 rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
+    ts, ys, truncated = trim_trajectory(float(t0), y0, res)
+    return (_STATUS.get(int(res.status), "Failure"), float(res.t),
+            np.asarray(res.y), ts, ys, truncated, int(res.n_accepted),
+            int(res.n_rejected))
+
+
 def _mode(chem):
     if chem.userchem:
         return "udf"
@@ -133,7 +186,7 @@ def _mode(chem):
 
 
 def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
-                     max_steps, kc_compat, asv_quirk, verbose):
+                     max_steps, kc_compat, asv_quirk, verbose, backend):
     """Core driver: parse XML -> build RHS -> solve -> write profiles
     (reference :152-217)."""
     import sys
@@ -154,13 +207,12 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
             species=id_.species, surface_species=surf_species,
         )
 
-    res = _solve(mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
-                 jnp.asarray(0.0), jnp.asarray(id_.tf), cfg,
-                 rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
-    ts, ys, truncated = trim_trajectory(0.0, y0, res)
+    status, t_end, _, ts, ys, truncated, n_acc, n_rej = _run_solve(
+        backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
+        0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
     if truncated:
         print(f"warning: trajectory buffer full "
-              f"({int(res.n_accepted)} accepted steps > n_save={n_save}); "
+              f"({n_acc} accepted steps > n_save={n_save}); "
               f"profile files skip the overflow but end at the true final "
               f"state", file=sys.stderr)
     out_dir = os.path.dirname(os.path.abspath(input_file))
@@ -169,14 +221,14 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
     if verbose:
         # the reference prints every accepted time (:401); one summary line
         # is kinder to terminals at TPU step counts
-        print(f"t = {float(res.t):.4e} s  "
-              f"({int(res.n_accepted)} accepted / {int(res.n_rejected)} "
-              f"rejected steps)")
-    return _STATUS.get(int(res.status), "Failure")
+        print(f"t = {t_end:.4e} s  "
+              f"({n_acc} accepted / {n_rej} rejected steps)")
+    return status
 
 
 def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
-                      rtol, atol, n_save, max_steps, kc_compat, asv_quirk):
+                      rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
+                      backend):
     """Dict-in/dict-out API (reference :86-147): no files; returns
     ``(accepted_times, {species: final mole fraction})``.
 
@@ -206,22 +258,20 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
                              ini_covg=covg0)
     cfg = {"T": jnp.asarray(T, dtype=jnp.float64),
            "Asv": jnp.asarray(Asv, dtype=jnp.float64)}
-    res = _solve(mode, None, gm, sm, thermo_obj, y0,
-                 jnp.asarray(0.0), jnp.asarray(float(time)), cfg,
-                 rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
-    status = _STATUS.get(int(res.status), "Failure")
+    status, t_end, y_end, ts, _, _, _, _ = _run_solve(
+        backend, mode, None, gm, sm, thermo_obj, y0, 0.0, float(time), cfg,
+        rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
     if status != "Success":
         # fail loudly: a partial-integration composition is worse than an
         # error for reactor-network callers
         raise RuntimeError(
             f"batch_reactor integration failed with {status} at "
-            f"t={float(res.t):.4e} of {float(time):.4e} s")
-    ts, _, _ = trim_trajectory(0.0, y0, res)
+            f"t={t_end:.4e} of {float(time):.4e} s")
 
-    # final composition from the true final state res.y (the saved-step
-    # buffer may be truncated; res.y never is)
+    # final composition from the true final state y_end (the saved-step
+    # buffer may be truncated; y_end never is)
     ng = len(species)
-    moles = np.asarray(res.y)[:ng] / np.asarray(thermo_obj.molwt)
+    moles = y_end[:ng] / np.asarray(thermo_obj.molwt)
     x_end = moles / moles.sum()
     return ts, dict(zip(species, x_end.tolist()))
 
@@ -229,7 +279,8 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
 def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   Asv=1.0, chem=None, thermo_obj=None, md=None,
                   rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,
-                  kc_compat=False, asv_quirk=True, verbose=False):
+                  kc_compat=False, asv_quirk=True, verbose=False,
+                  backend="jax"):
     """Simulate an isothermal constant-volume batch reactor (three forms).
 
     Form 1 — file-driven:   ``batch_reactor(input_file, lib_dir,
@@ -242,7 +293,10 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
 
     Extra (TPU-native) knobs beyond the reference: ``rtol/atol`` (defaults =
     the reference's CVODE settings), ``kc_compat``/``asv_quirk`` parity
-    switches (PARITY.md), ``n_save`` trajectory buffer rows.
+    switches (PARITY.md), ``n_save`` trajectory buffer rows, and
+    ``backend`` — "jax" (default: jitted SDIRK4 on whatever jax.devices()
+    provides) or "cpu" (the native C++ CVODE-class BDF runtime,
+    native/br_native.cpp — the SUNDIALS-role component).
     """
     if args and isinstance(args[0], dict):
         if len(args) != 4:
@@ -255,14 +309,14 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], args[2], args[3], Asv=Asv, chem=chem,
             thermo_obj=thermo_obj, md=md, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
-            asv_quirk=asv_quirk)
+            asv_quirk=asv_quirk, backend=backend)
 
     if len(args) == 3 and callable(args[2]):
         chem = Chemistry(False, False, True, args[2])
         return _file_driven_run(
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
-            asv_quirk=asv_quirk, verbose=verbose)
+            asv_quirk=asv_quirk, verbose=verbose, backend=backend)
 
     if len(args) == 2:
         if chem is None:
@@ -270,6 +324,6 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
         return _file_driven_run(
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
-            asv_quirk=asv_quirk, verbose=verbose)
+            asv_quirk=asv_quirk, verbose=verbose, backend=backend)
 
     raise TypeError(f"unrecognized batch_reactor argument pattern: {args!r}")

@@ -1,0 +1,274 @@
+"""ctypes bindings + on-demand g++ build for native/br_native.cpp."""
+
+import ctypes
+import dataclasses
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "br_native.cpp")
+_SO = os.path.join(_REPO, "native", "libbr_native.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeUnavailable(RuntimeError):
+    """Raised when the shared library cannot be built or loaded."""
+
+
+def _build():
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise NativeUnavailable(f"g++ build failed: {detail}") from e
+
+
+class _BrGasMech(ctypes.Structure):
+    _fields_ = [
+        ("S", ctypes.c_int64),
+        ("R", ctypes.c_int64),
+        ("nu_f", ctypes.POINTER(ctypes.c_double)),
+        ("nu_r", ctypes.POINTER(ctypes.c_double)),
+        ("log_A", ctypes.POINTER(ctypes.c_double)),
+        ("beta", ctypes.POINTER(ctypes.c_double)),
+        ("Ea", ctypes.POINTER(ctypes.c_double)),
+        ("eff", ctypes.POINTER(ctypes.c_double)),
+        ("has_tb", ctypes.POINTER(ctypes.c_double)),
+        ("has_falloff", ctypes.POINTER(ctypes.c_double)),
+        ("log_A0", ctypes.POINTER(ctypes.c_double)),
+        ("beta0", ctypes.POINTER(ctypes.c_double)),
+        ("Ea0", ctypes.POINTER(ctypes.c_double)),
+        ("has_troe", ctypes.POINTER(ctypes.c_double)),
+        ("troe", ctypes.POINTER(ctypes.c_double)),
+        ("rev_mask", ctypes.POINTER(ctypes.c_double)),
+        ("coeffs", ctypes.POINTER(ctypes.c_double)),
+        ("T_mid", ctypes.POINTER(ctypes.c_double)),
+        ("molwt", ctypes.POINTER(ctypes.c_double)),
+        ("kc_compat", ctypes.c_int32),
+        ("int_stoich", ctypes.c_int32),
+    ]
+
+
+class _BrStats(ctypes.Structure):
+    _fields_ = [
+        ("t", ctypes.c_double),
+        ("status", ctypes.c_int32),
+        ("pad", ctypes.c_int32),
+        ("n_steps", ctypes.c_int64),
+        ("n_rejected", ctypes.c_int64),
+        ("n_rhs", ctypes.c_int64),
+        ("n_jac", ctypes.c_int64),
+        ("n_lu", ctypes.c_int64),
+    ]
+
+
+_RHS_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_double,
+                           ctypes.POINTER(ctypes.c_double),
+                           ctypes.POINTER(ctypes.c_double))
+
+_DP = ctypes.POINTER(ctypes.c_double)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+def load_library():
+    """Build (if stale) and load the shared library; cached per process."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SRC):
+            raise NativeUnavailable(f"native source missing: {_SRC}")
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            raise NativeUnavailable(str(e)) from e
+        lib.br_gas_rhs.restype = None
+        lib.br_gas_rhs.argtypes = [ctypes.POINTER(_BrGasMech),
+                                   ctypes.c_double, _DP, _DP]
+        lib.br_bdf.restype = ctypes.c_int32
+        lib.br_bdf.argtypes = [
+            _RHS_CB, ctypes.c_void_p, ctypes.c_int64, _DP,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_double, _DP, _DP, _DP, ctypes.c_int64,
+            _I64P, ctypes.POINTER(_BrStats)]
+        lib.br_solve_gas_bdf.restype = ctypes.c_int32
+        lib.br_solve_gas_bdf.argtypes = [
+            ctypes.POINTER(_BrGasMech), ctypes.c_double, _DP,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_double, _DP, _DP, _DP, ctypes.c_int64,
+            _I64P, ctypes.POINTER(_BrStats)]
+        _lib = lib
+        return lib
+
+
+def available():
+    """True iff the native runtime builds and loads on this host."""
+    try:
+        load_library()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def _carr(x):
+    a = np.ascontiguousarray(np.asarray(x, dtype=np.float64))
+    return a, a.ctypes.data_as(_DP)
+
+
+def _pack_mech(gm, thermo, kc_compat):
+    """Pack GasMechanism + ThermoTable into a _BrGasMech struct.
+
+    Returns (struct, keepalive_list) — the caller must keep the list alive
+    for the duration of any native call using the struct.
+    """
+    keep = []
+    m = _BrGasMech()
+    m.S = len(gm.species)
+    m.R = len(gm.equations)
+    for field, src in [
+        ("nu_f", gm.nu_f), ("nu_r", gm.nu_r), ("log_A", gm.log_A),
+        ("beta", gm.beta), ("Ea", gm.Ea), ("eff", gm.eff),
+        ("has_tb", gm.has_tb), ("has_falloff", gm.has_falloff),
+        ("log_A0", gm.log_A0), ("beta0", gm.beta0), ("Ea0", gm.Ea0),
+        ("has_troe", gm.has_troe), ("troe", gm.troe),
+        ("rev_mask", gm.rev_mask), ("coeffs", thermo.coeffs),
+        ("T_mid", thermo.T_mid), ("molwt", thermo.molwt),
+    ]:
+        arr, ptr = _carr(src)
+        keep.append(arr)
+        setattr(m, field, ptr)
+    m.kc_compat = 1 if kc_compat else 0
+    m.int_stoich = 1 if gm.int_stoich else 0
+    return m, keep
+
+
+@dataclasses.dataclass
+class NativeResult:
+    """Outcome of a native BDF solve (mirrors solver.sdirk.SolveResult)."""
+
+    t: float
+    y: np.ndarray
+    status: str          # "Success" | "MaxIters" | "DtLessThanMin"
+    n_accepted: int
+    n_rejected: int
+    n_rhs: int
+    n_jac: int
+    n_lu: int
+    ts: np.ndarray       # (n_saved,) accepted-step times
+    ys: np.ndarray       # (n_saved, S) accepted-step states
+
+
+_STATUS = {0: "Success", 2: "MaxIters", 3: "DtLessThanMin"}
+
+
+def gas_rhs(gm, thermo, T, y, kc_compat=False):
+    """Native evaluation of the gas RHS dy/dt (same semantics as
+    ops.rhs.make_gas_rhs); used as a cross-implementation test oracle."""
+    lib = load_library()
+    m, keep = _pack_mech(gm, thermo, kc_compat)
+    y_arr, y_ptr = _carr(y)
+    if y_arr.shape != (len(gm.species),):
+        raise ValueError(f"y has shape {y_arr.shape}, mechanism has "
+                         f"{len(gm.species)} species")
+    out = np.empty_like(y_arr)
+    lib.br_gas_rhs(ctypes.byref(m), float(T), y_ptr, out.ctypes.data_as(_DP))
+    del keep, y_arr
+    return out
+
+
+def _run(call, n, n_save):
+    ts = np.empty(max(n_save, 1), dtype=np.float64)
+    ys = np.empty((max(n_save, 1), n), dtype=np.float64)
+    y_out = np.empty(n, dtype=np.float64)
+    n_saved = ctypes.c_int64(0)
+    stats = _BrStats()
+    call(y_out, ts, ys, n_saved, stats)
+    k = int(n_saved.value)
+    return NativeResult(
+        t=float(stats.t), y=y_out, status=_STATUS.get(stats.status, "Failure"),
+        n_accepted=int(stats.n_steps), n_rejected=int(stats.n_rejected),
+        n_rhs=int(stats.n_rhs), n_jac=int(stats.n_jac), n_lu=int(stats.n_lu),
+        ts=ts[:k].copy(), ys=ys[:k].copy(),
+    )
+
+
+def solve_gas_bdf(gm, thermo, T, y0, t0, t1, *, rtol=1e-6, atol=1e-10,
+                  max_steps=200_000, first_step=0.0, n_save=0,
+                  kc_compat=False):
+    """Integrate the gas-phase reactor with the native BDF (CVODE-class):
+    the ``backend="cpu"`` solve path and the bench baseline integrator."""
+    lib = load_library()
+    m, keep = _pack_mech(gm, thermo, kc_compat)
+    y0_arr, y0_ptr = _carr(y0)
+    if y0_arr.shape != (len(gm.species),):
+        raise ValueError(f"y0 has shape {y0_arr.shape}, mechanism has "
+                         f"{len(gm.species)} species")
+    n = y0_arr.shape[0]
+
+    def call(y_out, ts, ys, n_saved, stats):
+        lib.br_solve_gas_bdf(
+            ctypes.byref(m), float(T), y0_ptr, float(t0), float(t1),
+            float(rtol), float(atol), int(max_steps), float(first_step),
+            y_out.ctypes.data_as(_DP), ts.ctypes.data_as(_DP),
+            ys.ctypes.data_as(_DP), int(n_save), ctypes.byref(n_saved),
+            ctypes.byref(stats))
+
+    res = _run(call, n, n_save)
+    del keep, y0_arr
+    return res
+
+
+def solve_bdf(rhs, y0, t0, t1, *, rtol=1e-6, atol=1e-10, max_steps=200_000,
+              first_step=0.0, n_save=0):
+    """Generic native BDF over a Python RHS callback ``rhs(t, y) -> dy``.
+
+    The callback crosses the ctypes boundary per evaluation, so this path is
+    for correctness work (UDF chemistry, solver cross-checks), not speed —
+    use :func:`solve_gas_bdf` for the all-native hot path.
+    """
+    lib = load_library()
+    y0_arr, y0_ptr = _carr(y0)
+    n = y0_arr.shape[0]
+    err: list = []
+
+    @_RHS_CB
+    def cb(_ctx, t, y_ptr, dy_ptr):
+        if err:  # user code already failed: poison without re-entering it
+            bad = np.full(n, np.nan)
+            ctypes.memmove(dy_ptr, bad.ctypes.data, n * 8)
+            return
+        try:
+            y = np.ctypeslib.as_array(y_ptr, shape=(n,))
+            dy = np.asarray(rhs(float(t), y.copy()), dtype=np.float64)
+            if dy.shape != (n,):
+                raise ValueError(f"rhs returned shape {dy.shape}, "
+                                 f"expected ({n},)")
+            ctypes.memmove(dy_ptr, dy.ctypes.data, n * 8)
+        except Exception as e:  # noqa: BLE001 — can't raise through C
+            err.append(e)
+            bad = np.full(n, np.nan)
+            ctypes.memmove(dy_ptr, bad.ctypes.data, n * 8)
+
+    def call(y_out, ts, ys, n_saved, stats):
+        lib.br_bdf(
+            cb, None, n, y0_ptr, float(t0), float(t1), float(rtol),
+            float(atol), int(max_steps), float(first_step),
+            y_out.ctypes.data_as(_DP), ts.ctypes.data_as(_DP),
+            ys.ctypes.data_as(_DP), int(n_save), ctypes.byref(n_saved),
+            ctypes.byref(stats))
+
+    res = _run(call, n, n_save)
+    if err:
+        raise err[0]
+    del y0_arr
+    return res
