@@ -35,7 +35,7 @@ import numpy as np
 
 from .io.config import input_data, parse_composition_text
 from .io.writers import trim_trajectory, write_profiles
-from .ops.rhs import make_gas_rhs, make_surface_rhs, make_udf_rhs
+from .ops.rhs import make_gas_jac, make_gas_rhs, make_surface_rhs, make_udf_rhs
 from .solver import sdirk
 from .utils.composition import density, mole_to_mass
 
@@ -114,9 +114,12 @@ def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
     operands, so repeated calls with any same-shaped mechanism (the
     reactor-network use case) reuse the compiled program."""
     rhs = _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk)
+    # gas-only chemistry has a closed-form Jacobian (ops/rhs.make_gas_jac);
+    # other modes fall back to jacfwd inside the solver
+    jac = make_gas_jac(gm, thermo, kc_compat) if mode == "gas" else None
     return sdirk.solve(
         rhs, y0, t0, t1, cfg,
-        rtol=rtol, atol=atol, n_save=n_save, max_steps=max_steps,
+        rtol=rtol, atol=atol, n_save=n_save, max_steps=max_steps, jac=jac,
     )
 
 
@@ -124,9 +127,9 @@ def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
                   n_save, max_steps, kc_compat, asv_quirk):
     """backend="cpu": the native (C++) CVODE-class BDF runtime
     (native/br_native.cpp) — the role the reference fills with SUNDIALS
-    (/root/reference/src/BatchReactor.jl:138,210).  Gas-only chemistry runs
-    all-native; other modes integrate the JAX RHS through the generic
-    callback BDF (correct, host-speed)."""
+    (/root/reference/src/BatchReactor.jl:138,210).  Mechanism-driven
+    chemistry (gas / surf / gas+surf) runs all-native; UDF mode integrates
+    the JAX RHS through the generic callback BDF (correct, host-speed)."""
     from . import native
 
     if mode == "gas":
@@ -134,6 +137,12 @@ def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
             gm, thermo, float(cfg["T"]), np.asarray(y0), float(t0), float(t1),
             rtol=rtol, atol=atol, max_steps=max_steps, n_save=n_save,
             kc_compat=kc_compat)
+    if mode in ("surf", "gas+surf"):
+        return native.solve_surf_bdf(
+            sm, thermo, float(cfg["T"]), float(cfg["Asv"]), np.asarray(y0),
+            float(t0), float(t1), gm=gm if mode == "gas+surf" else None,
+            asv_quirk=asv_quirk, kc_compat=kc_compat, rtol=rtol, atol=atol,
+            max_steps=max_steps, n_save=n_save)
     rhs = _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk)
     cfg_np = {k: jnp.asarray(v) for k, v in cfg.items()}
 

@@ -56,6 +56,32 @@ class _BrGasMech(ctypes.Structure):
     ]
 
 
+class _BrSurfMech(ctypes.Structure):
+    _fields_ = [
+        ("R", ctypes.c_int64),
+        ("Sg", ctypes.c_int64),
+        ("Ss", ctypes.c_int64),
+        ("nu_f_gas", ctypes.POINTER(ctypes.c_double)),
+        ("nu_r_gas", ctypes.POINTER(ctypes.c_double)),
+        ("nu_f_surf", ctypes.POINTER(ctypes.c_double)),
+        ("nu_r_surf", ctypes.POINTER(ctypes.c_double)),
+        ("expo_gas", ctypes.POINTER(ctypes.c_double)),
+        ("expo_surf", ctypes.POINTER(ctypes.c_double)),
+        ("log_A", ctypes.POINTER(ctypes.c_double)),
+        ("beta", ctypes.POINTER(ctypes.c_double)),
+        ("Ea", ctypes.POINTER(ctypes.c_double)),
+        ("cov_eps", ctypes.POINTER(ctypes.c_double)),
+        ("stick", ctypes.POINTER(ctypes.c_double)),
+        ("stick_s0", ctypes.POINTER(ctypes.c_double)),
+        ("stick_molwt", ctypes.POINTER(ctypes.c_double)),
+        ("mwc", ctypes.POINTER(ctypes.c_double)),
+        ("site_density", ctypes.c_double),
+        ("site_coordination", ctypes.POINTER(ctypes.c_double)),
+        ("molwt_gas", ctypes.POINTER(ctypes.c_double)),
+        ("int_expo", ctypes.c_int32),
+    ]
+
+
 class _BrStats(ctypes.Structure):
     _fields_ = [
         ("t", ctypes.c_double),
@@ -107,6 +133,21 @@ def load_library():
             ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_int64, ctypes.c_double, _DP, _DP, _DP, ctypes.c_int64,
             _I64P, ctypes.POINTER(_BrStats)]
+        lib.br_surface_rates.restype = None
+        lib.br_surface_rates.argtypes = [
+            ctypes.POINTER(_BrSurfMech), ctypes.c_double, ctypes.c_double,
+            _DP, _DP, _DP, _DP]
+        lib.br_surf_rhs.restype = None
+        lib.br_surf_rhs.argtypes = [
+            ctypes.POINTER(_BrSurfMech), ctypes.POINTER(_BrGasMech),
+            ctypes.c_double, ctypes.c_double, ctypes.c_int32, _DP, _DP]
+        lib.br_solve_surf_bdf.restype = ctypes.c_int32
+        lib.br_solve_surf_bdf.argtypes = [
+            ctypes.POINTER(_BrSurfMech), ctypes.POINTER(_BrGasMech),
+            ctypes.c_double, ctypes.c_double, ctypes.c_int32, _DP,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_double, _DP, _DP, _DP, ctypes.c_int64,
+            _I64P, ctypes.POINTER(_BrStats)]
         _lib = lib
         return lib
 
@@ -150,6 +191,67 @@ def _pack_mech(gm, thermo, kc_compat):
     m.kc_compat = 1 if kc_compat else 0
     m.int_stoich = 1 if gm.int_stoich else 0
     return m, keep
+
+
+def _pack_surf(sm, molwt_gas):
+    """Pack a SurfaceMechanism into a _BrSurfMech struct (+ keepalives)."""
+    keep = []
+    m = _BrSurfMech()
+    m.R = len(sm.equations)
+    m.Sg = len(sm.gas_species)
+    m.Ss = len(sm.species)
+    for field, src in [
+        ("nu_f_gas", sm.nu_f_gas), ("nu_r_gas", sm.nu_r_gas),
+        ("nu_f_surf", sm.nu_f_surf), ("nu_r_surf", sm.nu_r_surf),
+        ("expo_gas", sm.expo_gas), ("expo_surf", sm.expo_surf),
+        ("log_A", sm.log_A), ("beta", sm.beta), ("Ea", sm.Ea),
+        ("cov_eps", sm.cov_eps), ("stick", sm.stick),
+        ("stick_s0", sm.stick_s0), ("stick_molwt", sm.stick_molwt),
+        ("mwc", sm.mwc), ("site_coordination", sm.site_coordination),
+        ("molwt_gas", molwt_gas),
+    ]:
+        arr, ptr = _carr(src)
+        keep.append(arr)
+        setattr(m, field, ptr)
+    m.site_density = float(np.asarray(sm.site_density))
+    m.int_expo = 1 if sm.int_expo else 0
+    return m, keep
+
+
+def surface_rates(sm, T, p, mole_fracs, theta):
+    """Native surface production rates (sdot_gas, sdot_surf) [mol/m^2/s]
+    (same semantics as ops.surface_kinetics.production_rates); a
+    cross-implementation test oracle."""
+    lib = load_library()
+    molwt_stub = np.ones(len(sm.gas_species))
+    m, keep = _pack_surf(sm, molwt_stub)
+    x_arr, x_ptr = _carr(mole_fracs)
+    th_arr, th_ptr = _carr(theta)
+    sg = np.empty(len(sm.gas_species))
+    ss = np.empty(len(sm.species))
+    lib.br_surface_rates(ctypes.byref(m), float(T), float(p), x_ptr, th_ptr,
+                         sg.ctypes.data_as(_DP), ss.ctypes.data_as(_DP))
+    del keep, x_arr, th_arr
+    return sg, ss
+
+
+def surf_rhs(sm, thermo, T, Asv, y, gm=None, asv_quirk=True,
+             kc_compat=False):
+    """Native surface(+gas) reactor RHS over y = [rho_k, theta_k]
+    (same semantics as ops.rhs.make_surface_rhs)."""
+    lib = load_library()
+    m, keep = _pack_surf(sm, np.asarray(thermo.molwt))
+    gm_ref = None
+    if gm is not None:
+        gmm, keep_g = _pack_mech(gm, thermo, kc_compat)
+        keep += keep_g
+        gm_ref = ctypes.byref(gmm)
+    y_arr, y_ptr = _carr(y)
+    out = np.empty_like(y_arr)
+    lib.br_surf_rhs(ctypes.byref(m), gm_ref, float(T), float(Asv),
+                    1 if asv_quirk else 0, y_ptr, out.ctypes.data_as(_DP))
+    del keep, y_arr
+    return out
 
 
 @dataclasses.dataclass
@@ -218,6 +320,38 @@ def solve_gas_bdf(gm, thermo, T, y0, t0, t1, *, rtol=1e-6, atol=1e-10,
     def call(y_out, ts, ys, n_saved, stats):
         lib.br_solve_gas_bdf(
             ctypes.byref(m), float(T), y0_ptr, float(t0), float(t1),
+            float(rtol), float(atol), int(max_steps), float(first_step),
+            y_out.ctypes.data_as(_DP), ts.ctypes.data_as(_DP),
+            ys.ctypes.data_as(_DP), int(n_save), ctypes.byref(n_saved),
+            ctypes.byref(stats))
+
+    res = _run(call, n, n_save)
+    del keep, y0_arr
+    return res
+
+
+def solve_surf_bdf(sm, thermo, T, Asv, y0, t0, t1, *, gm=None,
+                   asv_quirk=True, kc_compat=False, rtol=1e-6, atol=1e-10,
+                   max_steps=200_000, first_step=0.0, n_save=0):
+    """Integrate the surface (and optionally coupled gas) reactor with the
+    native BDF — the all-native ``backend="cpu"`` path for surfchem modes
+    (role of the reference's CVODE solve, /root/reference/src/BatchReactor.jl:210)."""
+    lib = load_library()
+    m, keep = _pack_surf(sm, np.asarray(thermo.molwt))
+    gm_ref = None
+    if gm is not None:
+        gmm, keep_g = _pack_mech(gm, thermo, kc_compat)
+        keep += keep_g
+        gm_ref = ctypes.byref(gmm)
+    y0_arr, y0_ptr = _carr(y0)
+    n = len(sm.gas_species) + len(sm.species)
+    if y0_arr.shape != (n,):
+        raise ValueError(f"y0 has shape {y0_arr.shape}, expected ({n},)")
+
+    def call(y_out, ts, ys, n_saved, stats):
+        lib.br_solve_surf_bdf(
+            ctypes.byref(m), gm_ref, float(T), float(Asv),
+            1 if asv_quirk else 0, y0_ptr, float(t0), float(t1),
             float(rtol), float(atol), int(max_steps), float(first_step),
             y_out.ctypes.data_as(_DP), ts.ctypes.data_as(_DP),
             ys.ctypes.data_as(_DP), int(n_save), ctypes.byref(n_saved),

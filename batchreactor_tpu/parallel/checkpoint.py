@@ -1,0 +1,192 @@
+"""Chunked, checkpointed ensemble sweeps (SURVEY.md §5 checkpoint/resume).
+
+The reference has no checkpointing — its streamed ``.dat`` files are
+write-only logs and an interrupted run restarts from scratch
+(/root/reference/src/BatchReactor.jl:210).  For a 4096-lane TPU sweep the
+natural restart unit is the *chunk*: the batch is split into fixed-size
+chunks, each chunk's SolveResult lands in one ``.npz`` next to a manifest,
+and a re-run with the same arguments skips chunks whose files already
+exist.  Recovery from preemption is therefore "run the same command again"
+— the surviving chunks load from disk and only the missing ones touch the
+device.  (Orbax would also work; plain npz keeps the artifact readable
+anywhere and dependency-free.)
+"""
+
+import hashlib
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..solver.sdirk import SolveResult
+from .sweep import ensemble_solve
+
+
+_FIELDS = ("t", "y", "status", "n_accepted", "n_rejected", "ts", "ys",
+           "n_saved")
+
+
+def _obs_dict(res):
+    """SolveResult.observed as a plain {str: array} dict (or None).
+
+    Persisting arbitrary observer pytrees would need a schema; a flat dict
+    of arrays (what ignition_observer produces) covers the sweep use case
+    and anything else fails loudly instead of dropping data.
+    """
+    obs = res.observed
+    if obs is None:
+        return None
+    if not (isinstance(obs, dict)
+            and all(isinstance(k, str) for k in obs)):
+        raise TypeError(
+            "checkpointing supports observer states that are flat "
+            f"{{str: array}} dicts; got {type(obs).__name__}")
+    return obs
+
+
+def save_result(path, res, cfgs=None):
+    """Write a (possibly batched) SolveResult [+ conditions] to one .npz."""
+    payload = {f: np.asarray(getattr(res, f)) for f in _FIELDS}
+    obs = _obs_dict(res)
+    if obs is not None:
+        for k, v in obs.items():
+            payload[f"obs_{k}"] = np.asarray(v)
+    if cfgs:
+        for k, v in cfgs.items():
+            payload[f"cfg_{k}"] = np.asarray(v)
+    tmp = path + ".tmp.npz"  # savez appends .npz unless already suffixed
+    np.savez_compressed(tmp, **payload)
+    os.replace(tmp, path)
+
+
+def load_result(path):
+    """Inverse of :func:`save_result` -> (SolveResult, cfgs dict)."""
+    with np.load(path) as z:
+        obs = {k[4:]: jnp.asarray(z[k]) for k in z.files if k.startswith("obs_")}
+        res = SolveResult(**{f: jnp.asarray(z[f]) for f in _FIELDS},
+                          observed=obs or None)
+        cfgs = {k[4:]: jnp.asarray(z[k]) for k in z.files if k.startswith("cfg_")}
+    return res, cfgs
+
+
+def _concat_results(parts):
+    observed = None
+    if parts and parts[0].observed is not None:
+        keys = parts[0].observed.keys()
+        observed = {k: jnp.concatenate([p.observed[k] for p in parts], axis=0)
+                    for k in keys}
+    return SolveResult(**{
+        f: jnp.concatenate([getattr(p, f) for p in parts], axis=0)
+        for f in _FIELDS
+    }, observed=observed)
+
+
+def _hash_callable(h, fn, depth=0):
+    """Best-effort content hash of a callable: code identity plus any array
+    pytrees captured in its closure (a ``make_gas_rhs`` closure hashes its
+    mechanism tensors, so resuming with a different mechanism — or a
+    different ``kc_compat``/marker — changes the fingerprint even though
+    every such closure is named ``rhs``/``observer``)."""
+    code = getattr(fn, "__code__", None)
+    h.update(getattr(fn, "__qualname__", repr(fn)).encode())
+    if code is not None:
+        h.update(code.co_code)
+    for cell in getattr(fn, "__closure__", None) or ():
+        v = cell.cell_contents
+        if callable(v) and depth < 3:
+            _hash_callable(h, v, depth + 1)
+            continue
+        leaves = jax.tree_util.tree_leaves(v)
+        for leaf in leaves:
+            if hasattr(leaf, "shape"):
+                h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+            else:
+                h.update(repr(leaf).encode())
+
+
+def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
+    """Content hash pinning a sweep's inputs: the rhs (code + captured
+    mechanism tensors), initial states, per-lane conditions, and solver
+    settings.  A resume into a checkpoint dir whose fingerprint differs
+    fails loudly instead of silently serving chunks from a different
+    sweep."""
+    h = hashlib.sha256()
+    _hash_callable(h, rhs)
+    h.update(np.ascontiguousarray(np.asarray(y0s)).tobytes())
+    for k in sorted(cfgs):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(np.asarray(cfgs[k])).tobytes())
+    for k in sorted(solve_kw):
+        v = solve_kw[k]
+        if callable(v):
+            _hash_callable(h, v)
+        else:
+            h.update(f"{k}={v}".encode())
+    return h.hexdigest()
+
+
+def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
+                       **solve_kw):
+    """ensemble_solve with chunk-level checkpoint/resume.
+
+    Splits the (B, ...) batch into ``chunk_size`` pieces; chunk i's result is
+    persisted to ``ckpt_dir/chunk_{i:05d}.npz`` as soon as it finishes.  On
+    re-invocation, chunks with an existing file are loaded instead of
+    re-solved (the manifest pins B/chunk_size so a mismatched resume fails
+    loudly rather than silently mixing sweeps).  Returns the full
+    concatenated SolveResult.
+    """
+    y0s = jnp.asarray(y0s)
+    B = y0s.shape[0]
+    os.makedirs(ckpt_dir, exist_ok=True)
+    manifest_path = os.path.join(ckpt_dir, "manifest.json")
+    manifest = {"B": B, "chunk_size": chunk_size,
+                "t0": float(t0), "t1": float(t1),
+                "fingerprint": _sweep_fingerprint(rhs, y0s, cfgs, solve_kw)}
+    if os.path.exists(manifest_path):
+        prev = json.load(open(manifest_path))
+        if prev != manifest:
+            raise ValueError(
+                f"checkpoint dir {ckpt_dir} holds a different sweep "
+                f"({prev} != {manifest}); use a fresh directory")
+    else:
+        with open(manifest_path, "w") as f:
+            json.dump(manifest, f)
+
+    mesh = solve_kw.get("mesh")
+
+    def _solve_chunk(y0c, cfgc):
+        n = y0c.shape[0]
+        pad = 0
+        if mesh is not None:
+            # mesh sharding needs the batch axis to divide the device count;
+            # pad the ragged tail chunk with copies of its last lane and
+            # slice them back off
+            from .sweep import pad_batch
+
+            pad = pad_batch(n, mesh) - n
+        if pad:
+            y0c = jnp.concatenate([y0c, jnp.repeat(y0c[-1:], pad, axis=0)])
+            cfgc = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
+                    for k, v in cfgc.items()}
+        res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **solve_kw)
+        if pad:
+            res = jax.tree.map(
+                lambda x: x[:n] if hasattr(x, "ndim") and x.ndim >= 1 else x,
+                res)
+        return res
+
+    parts = []
+    for i, lo in enumerate(range(0, B, chunk_size)):
+        hi = min(lo + chunk_size, B)
+        path = os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
+        chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
+        if os.path.exists(path):
+            res, _ = load_result(path)
+        else:
+            res = _solve_chunk(y0s[lo:hi], chunk_cfgs)
+            save_result(path, res, chunk_cfgs)
+        parts.append(res)
+    return _concat_results(parts)

@@ -42,28 +42,58 @@ def pad_batch(batch_size, mesh):
 
 def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
-                   dt0=None, dt_min_factor=1e-22):
+                   dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
+                   observer=None, observer_init=None):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
     leaves (per-lane T, Asv, ...); scalars t0/t1 are shared.  With ``mesh``,
     the batch axis is sharded ``P('batch')`` across devices (B must divide
     evenly — see :func:`pad_batch`).  Returns a batched SolveResult.
+
+    Compilation caching keys on the *identity* of the ``rhs``/``jac``/
+    ``observer`` callables (jit semantics): reuse the same callable objects
+    across calls — build them once, sweep many times.  A freshly constructed
+    closure per call (e.g. ``ignition_observer(...)`` inside a loop) forces
+    a full recompile every call, minutes at GRI scale on TPU.
     """
-    solve1 = functools.partial(
-        sdirk.solve, rhs, rtol=rtol, atol=atol, max_steps=max_steps,
-        n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor)
-    vsolve = jax.vmap(lambda y0, cfg: solve1(y0, t0, t1, cfg))
+    jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
+                            dt_min_factor, linsolve, jac, observer)
+    t0 = jnp.asarray(t0, dtype=y0s.dtype)
+    t1 = jnp.asarray(t1, dtype=y0s.dtype)
+    obs0 = observer_init if observer is not None else 0.0
 
     if mesh is None:
-        return jax.jit(vsolve)(y0s, cfgs)
+        return jitted(y0s, t0, t1, cfgs, obs0)
 
     spec = NamedSharding(mesh, P(axis))
     y0s = jax.device_put(y0s, spec)
     cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
     # outputs inherit the batch sharding; XLA inserts no collectives because
     # lanes never exchange data
-    return jax.jit(vsolve)(y0s, cfgs)
+    return jitted(y0s, t0, t1, cfgs, obs0)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
+                   linsolve, jac=None, observer=None):
+    """One compiled batched solve per (rhs, solver-settings) combination.
+
+    Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
+    the whole while_loop program each time (~2 min at GRI scale on TPU);
+    memoizing on the rhs callable + static solver knobs makes repeat sweeps
+    — the ensemble use case — pay tracing once.  t0/t1 stay traced operands
+    so sweeping the horizon does not recompile.
+    """
+
+    def one(y0, t0, t1, cfg, obs0):
+        return sdirk.solve(
+            rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
+            n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
+            linsolve=linsolve, jac=jac, observer=observer,
+            observer_init=obs0 if observer is not None else None)
+
+    return jax.jit(jax.vmap(one, in_axes=(0, None, None, 0, None)))
 
 
 def temperature_sweep(rhs, y0, T_grid, t1, base_cfg=None, **kw):
@@ -76,6 +106,75 @@ def temperature_sweep(rhs, y0, T_grid, t1, base_cfg=None, **kw):
     cfg = {k: jnp.broadcast_to(jnp.asarray(v), (B,)) for k, v in cfg.items()}
     cfg["T"] = T_grid
     return ensemble_solve(rhs, y0s, 0.0, t1, cfg, **kw)
+
+
+def sweep_report(res, cfgs=None):
+    """Failure-detection summary for an ensemble SolveResult (SURVEY.md §5:
+    the reference's only failure signal is one retcode,
+    /root/reference/src/BatchReactor.jl:216; a sweep needs per-lane triage).
+
+    Returns a dict: per-status lane counts, indices of failed lanes, and —
+    when ``cfgs`` is given — the offending parameter values per failed lane,
+    so a diverged corner of the condition grid is identifiable at a glance.
+    """
+    status = np.asarray(res.status)
+    names = {int(sdirk.SUCCESS): "success",
+             int(sdirk.MAX_STEPS_REACHED): "max_steps",
+             int(sdirk.DT_UNDERFLOW): "dt_underflow",
+             int(sdirk.RUNNING): "running"}
+    counts = {names.get(int(s), str(int(s))): int((status == s).sum())
+              for s in np.unique(status)}
+    failed = np.nonzero(status != int(sdirk.SUCCESS))[0]
+    report = {
+        "n_lanes": int(status.shape[0]),
+        "counts": counts,
+        "failed_lanes": failed.tolist(),
+        "n_accepted": {"min": int(np.min(np.asarray(res.n_accepted))),
+                       "max": int(np.max(np.asarray(res.n_accepted))),
+                       "mean": float(np.mean(np.asarray(res.n_accepted)))},
+    }
+    if cfgs is not None and failed.size:
+        report["failed_conditions"] = {
+            k: np.asarray(v)[failed].tolist() for k, v in cfgs.items()
+        }
+    return report
+
+
+def ignition_observer(marker, mode="half", frac=0.5):
+    """(observer, init) pair extracting ignition delay *during* the solve.
+
+    The O(1)-memory alternative to :func:`ignition_delay` over an ``n_save``
+    trajectory buffer: at 4096 lanes a (B, n_save, S) buffer scatter
+    dominates the sweep (it rewrites the whole buffer every accepted step
+    under vmap), while this fold costs O(B) per step.  ``mode="half"``
+    records the first accepted time the marker species drops below
+    ``frac`` x its first-seen value (fuel-consumption marker; the first
+    accepted step sits ~1e-16 s after t0, so first-seen == initial to
+    rounding).  ``mode="peak"`` records the time of the running maximum
+    (OH-peak marker).  Read the result from ``SolveResult.observed["tau"]``
+    (NaN where never crossed — e.g. lanes that did not ignite).
+    """
+    if mode == "half":
+        init = {"m0": jnp.nan, "tau": jnp.nan}
+
+        def observer(t, y, acc):
+            m = y[marker]
+            m0 = jnp.where(jnp.isnan(acc["m0"]), m, acc["m0"])
+            crossed = jnp.isnan(acc["tau"]) & (m < frac * m0)
+            return {"m0": m0, "tau": jnp.where(crossed, t, acc["tau"])}
+
+    elif mode == "peak":
+        init = {"m_max": -jnp.inf, "tau": jnp.nan}
+
+        def observer(t, y, acc):
+            m = y[marker]
+            higher = m > acc["m_max"]
+            return {"m_max": jnp.maximum(m, acc["m_max"]),
+                    "tau": jnp.where(higher, t, acc["tau"])}
+
+    else:
+        raise ValueError(f"unknown ignition observer mode {mode!r}")
+    return observer, init
 
 
 def ignition_delay(ts, ys, marker, mode="peak"):

@@ -60,6 +60,7 @@ class SolveResult:
     ts: jnp.ndarray         # (n_save,) accepted-step times, +inf padded
     ys: jnp.ndarray         # (n_save, n) accepted-step states, 0 padded
     n_saved: jnp.ndarray    # number of valid rows in ts/ys (saturates)
+    observed: object = None  # observer fold state (None without observer)
 
 
 def _scaled_norm(e, y, rtol, atol):
@@ -82,6 +83,10 @@ def solve(
     max_newton=8,
     newton_tol=0.03,
     dt_min_factor=1e-22,
+    linsolve="auto",
+    jac=None,
+    observer=None,
+    observer_init=None,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` from t0 to t1.
 
@@ -89,6 +94,32 @@ def solve(
     allocates an accepted-step trajectory buffer of that many rows (saving
     every accepted step, like the reference's FunctionCallingCallback; rows
     beyond the buffer are dropped with ``n_saved`` saturating).
+
+    ``linsolve`` picks the Newton linear solver:
+
+    - ``"lu"`` — f64 pivoted elimination in pure jnp (linalg.py).  Exact,
+      but its factor/solve loops are ~50-step sequential chains of tiny ops,
+      re-entered on every Newton iteration — latency-bound on TPU.
+    - ``"inv32"`` — form M = I - h*gamma*J in f64, invert it once per step
+      attempt with XLA's *native* f32 batched LU (the only dtype TPU's
+      LuDecomposition implements, see linalg.py), and run every Newton
+      iteration as one f64 MXU matvec with one f64 iterative-refinement
+      pass.  Refinement restores ~f64 solve accuracy while cond(M) stays
+      below ~1e7; beyond that Newton's divergence guard rejects the step and
+      the controller shrinks h, which re-conditions M = I - h*gamma*J.
+    - ``"auto"`` — "inv32" on accelerators, "lu" on CPU (where native f64
+      LAPACK-free loops are cheap and exact).
+
+    ``jac(t, y, cfg) -> (n, n)`` supplies an analytic Jacobian (e.g.
+    ops.rhs.make_gas_jac); default is ``jax.jacfwd`` of ``rhs``.
+
+    ``observer(t, y, acc) -> acc`` folds an arbitrary pytree over accepted
+    steps (initialized from ``observer_init``), landing in
+    ``SolveResult.observed``.  This is the O(1)-memory alternative to the
+    ``n_save`` trajectory buffer for streaming reductions — running maxima,
+    first-crossing times (ignition delay), integrals — which matters
+    batched: a (B, n_save, S) buffer scatter rewrites O(B * n_save * S)
+    per accepted step under vmap, while an observer fold touches O(B).
     """
     y0 = jnp.asarray(y0)
     n = y0.shape[0]
@@ -97,8 +128,16 @@ def solve(
     span = t1 - t0
     eye = jnp.eye(n, dtype=y0.dtype)
 
+    if linsolve == "auto":
+        linsolve = "lu" if jax.default_backend() == "cpu" else "inv32"
+    if linsolve not in ("lu", "inv32"):
+        raise ValueError(f"unknown linsolve {linsolve!r}; use 'lu'/'inv32'/'auto'")
+
     f = functools.partial(rhs, cfg=cfg)
-    jac = jax.jacfwd(lambda t, y: rhs(t, y, cfg), argnums=1)
+    if jac is None:
+        jac = jax.jacfwd(lambda t, y: rhs(t, y, cfg), argnums=1)
+    else:
+        jac = functools.partial(jac, cfg=cfg)
 
     if dt0 is None:
         # standard first-step heuristic (Hairer & Wanner II.4): h ~ 1% of the
@@ -116,7 +155,7 @@ def solve(
     ts_buf = jnp.full((n_save_buf,), jnp.inf, dtype=y0.dtype)
     ys_buf = jnp.zeros((n_save_buf, n), dtype=y0.dtype)
 
-    def newton_stage(lu, base, t_stage, h, z_init, y_scale):
+    def newton_stage(solve_m, base, t_stage, h, z_init, y_scale):
         """Solve z = base + h*gamma*f(t_stage, z) by modified Newton."""
 
         def cond(state):
@@ -126,7 +165,7 @@ def solve(
         def body(state):
             z, it, prev_norm, _, _ = state
             g = z - base - h * _GAMMA * f(t_stage, z)
-            dz = lu_solve(lu, -g)
+            dz = solve_m(-g)
             z_new = z + dz
             dnorm = _scaled_norm(dz, y_scale, rtol, atol)
             converged = dnorm < newton_tol
@@ -140,11 +179,28 @@ def solve(
         z, it, dnorm, converged, diverged = lax.while_loop(cond, body, init)
         return z, converged & jnp.isfinite(dnorm)
 
+    def make_solve_m(M):
+        """Linear solver for M x = b, built once per step attempt."""
+        if linsolve == "lu":
+            lu = lu_factor(M)  # pure-jnp pivoted GE (TPU f64-compatible)
+            return lambda b: lu_solve(lu, b)
+        # inv32: native f32 batched inverse + one f64 refinement pass.  The
+        # f32 inverse carries ~1e-7 relative error; computing the residual
+        # r = b - M x in f64 and correcting once recovers the rest (Newton's
+        # own convergence test owns the failure path past cond(M) ~ 1e7).
+        Minv = jnp.linalg.inv(M.astype(jnp.float32)).astype(y0.dtype)
+
+        def solve_m(b):
+            x = Minv @ b
+            return x + Minv @ (b - M @ x)
+
+        return solve_m
+
     def attempt_step(t, y, h):
         """One SDIRK4 step attempt: returns (y_new, err, newton_ok)."""
         J = jac(t, y)
         M = eye - h * _GAMMA * J
-        lu = lu_factor(M)  # pure-jnp pivoted GE (TPU f64-compatible, see linalg.py)
+        solve_m = make_solve_m(M)
 
         ks = []
         ok = jnp.array(True)
@@ -154,7 +210,7 @@ def solve(
             for j in range(i):
                 base = base + h * a_row[j] * ks[j]
             t_stage = t + _C[i] * h
-            z, conv = newton_stage(lu, base, t_stage, h, z_pred, y)
+            z, conv = newton_stage(solve_m, base, t_stage, h, z_pred, y)
             ok = ok & conv
             k_i = (z - base) / (h * _GAMMA)  # = f(t_stage, z) at convergence
             ks.append(k_i)
@@ -166,12 +222,16 @@ def solve(
         ok = ok & jnp.all(jnp.isfinite(y_new)) & jnp.isfinite(err)
         return y_new, err, ok
 
+    if (observer is None) != (observer_init is None):
+        raise ValueError("observer and observer_init must be given together")
+    obs0 = observer_init if observer is not None else jnp.zeros(())
+
     def cond(carry):
-        t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved = carry
+        t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved, obs = carry
         return status == RUNNING
 
     def body(carry):
-        t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved = carry
+        t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved, obs = carry
         h_eff = jnp.minimum(h, t1 - t)
         y_new, err, ok = attempt_step(t, y, h_eff)
         accept = ok & (err <= 1.0)
@@ -197,6 +257,11 @@ def solve(
         ys2 = jnp.where(do_save, ys.at[idx].set(y_out), ys)
         n_saved2 = n_saved + do_save
 
+        if observer is not None:
+            obs_new = observer(t_new, y_new, obs)
+            obs = jax.tree.map(
+                lambda new, old: jnp.where(accept, new, old), obs_new, obs)
+
         # tolerance absorbs t + (t1 - t) rounding so the loop can't stall
         finished = accept & (t_new >= t1 - span * 1e-14)
         too_small = (~accept) & (h_next < span * dt_min_factor)
@@ -209,16 +274,16 @@ def solve(
             ),
         ).astype(jnp.int32)
         return (t_new, y_out, h_next, err_prev_new, status2, n_acc2, n_rej2,
-                ts2, ys2, n_saved2)
+                ts2, ys2, n_saved2, obs)
 
     zero = jnp.array(0, dtype=jnp.int32)
     init = (t0, y0, dt0, jnp.array(1.0, dtype=y0.dtype),
             jnp.array(RUNNING, dtype=jnp.int32), zero, zero,
-            ts_buf, ys_buf, zero)
-    t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved = lax.while_loop(
-        cond, body, init
-    )
+            ts_buf, ys_buf, zero, obs0)
+    (t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved,
+     obs) = lax.while_loop(cond, body, init)
     return SolveResult(
         t=t, y=y, status=status, n_accepted=n_acc, n_rejected=n_rej,
         ts=ts, ys=ys, n_saved=n_saved,
+        observed=obs if observer is not None else None,
     )

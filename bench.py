@@ -25,6 +25,12 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+# persistent XLA compilation cache: the sweep program at GRI scale takes
+# minutes to compile; cache entries survive across processes so repeat bench
+# runs (and the driver's) pay it once per program shape
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
 B = int(os.environ.get("BENCH_B", "256"))
 T_LO = float(os.environ.get("BENCH_T_LO", "1500.0"))
@@ -100,8 +106,8 @@ def main():
 
     sys.path.insert(0, REPO)
     import batchreactor_tpu as br
-    from batchreactor_tpu.ops.rhs import make_gas_rhs
-    from batchreactor_tpu.parallel import ensemble_solve, ignition_delay
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+    from batchreactor_tpu.parallel import ensemble_solve, ignition_observer
     from batchreactor_tpu.solver.sdirk import SUCCESS
     from batchreactor_tpu.utils.composition import density, mole_to_mass
 
@@ -112,7 +118,13 @@ def main():
     # the reference's batch_ch4 mixture (/root/reference/test/batch_ch4/batch.xml)
     x0[sp.index("CH4")], x0[sp.index("O2")], x0[sp.index("N2")] = .25, .5, .25
     rhs = make_gas_rhs(gm, th)
+    jac = make_gas_jac(gm, th)  # closed-form Jacobian: ~13x cheaper than jacfwd
     T_grid = jnp.linspace(T_LO, T_HI, B)
+
+    # ignition delay extracted in-loop by an O(B) observer fold (a full
+    # (B, n_save, S) trajectory buffer costs ~50s/sweep in scatter traffic
+    # at B=256 — measured; the fold is free)
+    obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
 
     def tpu_sweep():
         rhos = jax.vmap(lambda T: density(jnp.asarray(x0), th.molwt, T, 1e5))(
@@ -121,7 +133,8 @@ def main():
         y0s = rhos[:, None] * y0[None, :]
         return ensemble_solve(
             rhs, y0s, 0.0, T1, {"T": T_grid}, rtol=RTOL, atol=ATOL,
-            max_steps=100_000, n_save=1024, dt0=1e-10)
+            max_steps=100_000, dt0=1e-10, jac=jac,
+            observer=obs, observer_init=obs0)
 
     log(f"devices: {jax.devices()}")
     log(f"compiling + warm-up sweep (B={B}, t1={T1}) ...")
@@ -140,9 +153,9 @@ def main():
     cps = B / tpu_wall
     log(f"TPU sweep: {tpu_wall:.2f}s -> {cps:.2f} conditions/sec")
 
-    tau = np.asarray(ignition_delay(res.ts, res.ys, sp.index("CH4"),
-                                    mode="half"))
-    log(f"ignition delay range: {tau.min():.2e} .. {tau.max():.2e} s")
+    tau = np.asarray(res.observed["tau"])
+    log(f"ignition delay range: {np.nanmin(tau):.2e} .. {np.nanmax(tau):.2e} s"
+        f" ({int(np.isnan(tau).sum())} lanes never crossed)")
 
     sec_per_lane = cpu_seconds_per_lane()
     speedup = sec_per_lane * B / tpu_wall

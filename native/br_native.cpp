@@ -542,6 +542,150 @@ int32_t br_bdf(BrRhsFn f, const void* ctx, int64_t n_, const double* y0,
   return st.status;
 }
 
+// ---------------------------------------------------------------------------
+// Surface (catalytic) chemistry — native mirror of ops/surface_kinetics.py
+// and ops/rhs.make_surface_rhs (reference semantics:
+// SurfaceReactions.calculate_molar_production_rates!,
+// /root/reference/src/BatchReactor.jl:344, conventions pinned in PARITY.md).
+// ---------------------------------------------------------------------------
+
+struct BrSurfMech {
+  int64_t R;                  // reactions
+  int64_t Sg;                 // gas species coupled to
+  int64_t Ss;                 // surface species
+  const double* nu_f_gas;     // (R,Sg)
+  const double* nu_r_gas;     // (R,Sg)
+  const double* nu_f_surf;    // (R,Ss)
+  const double* nu_r_surf;    // (R,Ss)
+  const double* expo_gas;     // (R,Sg) rate-law exponents
+  const double* expo_surf;    // (R,Ss)
+  const double* log_A;        // (R,) ln A, cgs
+  const double* beta;         // (R,)
+  const double* Ea;           // (R,) J/mol
+  const double* cov_eps;      // (R,Ss) coverage-dependent Ea slopes, J/mol
+  const double* stick;        // (R,) 1.0 for sticking rows
+  const double* stick_s0;     // (R,)
+  const double* stick_molwt;  // (R,) g/mol
+  const double* mwc;          // (R,) Motz-Wise flag
+  double site_density;        // Gamma, mol/cm^2
+  const double* site_coordination;  // (Ss,) sigma
+  const double* molwt_gas;    // (Sg,) kg/mol (gas state layout order)
+  int32_t int_expo;           // all exponents in {0,1,2,3}
+};
+
+namespace {
+
+constexpr double kRCgs = kR * 1e7;  // erg/(mol K)
+constexpr double kPi = 3.141592653589793;
+
+// prod_k base_k^expo_ik for one reaction row (ops/surface_kinetics._pow_prod)
+inline double pow_prod_row(const double* base, const double* expo, int64_t n,
+                           bool int_expo) {
+  double p = 1.0;
+  if (int_expo) {
+    for (int64_t k = 0; k < n; ++k) {
+      const int e = (int)(expo[k] + 0.5);
+      for (int j = 0; j < e; ++j) p *= base[k];
+    }
+    return p;
+  }
+  double s = 0.0;
+  for (int64_t k = 0; k < n; ++k)
+    s += expo[k] * std::log(base[k] > kTiny ? base[k] : kTiny);
+  return std::exp(s);
+}
+
+}  // namespace
+
+// Surface molar production rates (SI, mol/m^2/s) from T [K], p [Pa], gas
+// mole fractions x (Sg,), coverages theta (Ss,).  Mirrors
+// ops/surface_kinetics.production_rates.
+void br_surface_rates(const BrSurfMech* m, double T, double p,
+                      const double* x, const double* theta,
+                      double* sdot_gas, double* sdot_surf) {
+  const int64_t R = m->R, Sg = m->Sg, Ss = m->Ss;
+  std::vector<double> c_gas(Sg), c_surf(Ss);
+  for (int64_t k = 0; k < Sg; ++k) c_gas[k] = x[k] * p / (kR * T) * 1e-6;
+  for (int64_t k = 0; k < Ss; ++k)
+    c_surf[k] = theta[k] * m->site_density / m->site_coordination[k];
+  for (int64_t k = 0; k < Sg; ++k) sdot_gas[k] = 0.0;
+  for (int64_t k = 0; k < Ss; ++k) sdot_surf[k] = 0.0;
+
+  const double logT = std::log(T), rt = kR * T;
+  for (int64_t i = 0; i < R; ++i) {
+    double Ea_eff = m->Ea[i];
+    const double* eps = m->cov_eps + i * Ss;
+    for (int64_t k = 0; k < Ss; ++k) Ea_eff += eps[k] * theta[k];
+
+    double k_rate;
+    const bool is_stick = m->stick[i] > 0;
+    if (is_stick) {
+      // s_eff sqrt(RT/2 pi M) [cm/s]; coverages enter the rate directly
+      // (no Gamma^m) — golden-trajectory convention (PARITY.md)
+      double s_eff = m->stick_s0[i] *
+          std::exp(clamp(m->beta[i] * logT - Ea_eff / rt, -kExpMax, kExpMax));
+      if (m->mwc[i] > 0) s_eff = s_eff / (1.0 - s_eff / 2.0);
+      k_rate = s_eff * std::sqrt(kRCgs * T / (2.0 * kPi * m->stick_molwt[i]));
+    } else {
+      k_rate = std::exp(clamp(m->log_A[i] + m->beta[i] * logT - Ea_eff / rt,
+                              -kExpMax, kExpMax));
+    }
+
+    const double gas_part =
+        pow_prod_row(c_gas.data(), m->expo_gas + i * Sg, Sg, m->int_expo);
+    const double surf_part = pow_prod_row(
+        is_stick ? theta : c_surf.data(), m->expo_surf + i * Ss, Ss,
+        m->int_expo);
+    const double q = k_rate * gas_part * surf_part;  // mol/cm^2/s
+
+    const double* nfg = m->nu_f_gas + i * Sg;
+    const double* nrg = m->nu_r_gas + i * Sg;
+    const double* nfs = m->nu_f_surf + i * Ss;
+    const double* nrs = m->nu_r_surf + i * Ss;
+    for (int64_t k = 0; k < Sg; ++k) sdot_gas[k] += (nrg[k] - nfg[k]) * q;
+    for (int64_t k = 0; k < Ss; ++k) sdot_surf[k] += (nrs[k] - nfs[k]) * q;
+  }
+  for (int64_t k = 0; k < Sg; ++k) sdot_gas[k] *= 1e4;   // -> mol/m^2/s
+  for (int64_t k = 0; k < Ss; ++k) sdot_surf[k] *= 1e4;
+}
+
+// Full surface(+gas) reactor RHS over y = [rho_k (Sg), theta_k (Ss)].
+// Mirrors ops/rhs.make_surface_rhs including the reference's Asv quirk
+// (/root/reference/src/BatchReactor.jl:345: the WHOLE surface source —
+// coverage part included — scales by Asv when asv_quirk).
+void br_surf_rhs(const BrSurfMech* m, const BrGasMech* gm, double T,
+                 double Asv, int32_t asv_quirk, const double* y, double* dy) {
+  const int64_t Sg = m->Sg, Ss = m->Ss;
+  std::vector<double> x(Sg), sdot_gas(Sg), sdot_surf(Ss);
+  double rho = 0.0;
+  for (int64_t k = 0; k < Sg; ++k) rho += y[k];
+  // mass fracs -> mole fracs; p = rho R T sum(Y_k/M_k)
+  double inv_wbar = 0.0;
+  for (int64_t k = 0; k < Sg; ++k) {
+    x[k] = (y[k] / rho) / m->molwt_gas[k];
+    inv_wbar += x[k];
+  }
+  const double p = rho * kR * T * inv_wbar;
+  for (int64_t k = 0; k < Sg; ++k) x[k] /= inv_wbar;
+
+  br_surface_rates(m, T, p, x.data(), y + Sg, sdot_gas.data(),
+                   sdot_surf.data());
+
+  for (int64_t k = 0; k < Sg; ++k)
+    dy[k] = sdot_gas[k] * Asv * m->molwt_gas[k];
+  if (gm) {
+    std::vector<double> yg(Sg), dyg(Sg);
+    // conc = x p/(RT) = rho_k/M_k: reuse the gas RHS on the mass densities
+    for (int64_t k = 0; k < Sg; ++k) yg[k] = y[k];
+    br_gas_rhs(gm, T, yg.data(), dyg.data());
+    for (int64_t k = 0; k < Sg; ++k) dy[k] += dyg[k];
+  }
+  const double covg_scale = asv_quirk ? Asv : 1.0;
+  for (int64_t k = 0; k < Ss; ++k)
+    dy[Sg + k] = sdot_surf[k] * covg_scale * m->site_coordination[k] /
+                 (m->site_density * 1e4);
+}
+
 // Convenience: BDF over the built-in gas RHS at fixed temperature T
 // (isothermal reactor, /root/reference/src/BatchReactor.jl:14-17).
 struct GasCtx {
@@ -564,6 +708,34 @@ int32_t br_solve_gas_bdf(const BrGasMech* m, double T, const double* y0,
   GasCtx ctx = {m, T};
   return br_bdf(gas_rhs_tramp, &ctx, m->S, y0, t0, t1, rtol, atol, max_steps,
                 first_step, y_out, ts_out, ys_out, n_save, n_saved, stats);
+}
+
+// Convenience: BDF over the surface(+gas) RHS (gm may be null: surf-only).
+struct SurfCtx {
+  const BrSurfMech* m;
+  const BrGasMech* gm;
+  double T;
+  double Asv;
+  int32_t asv_quirk;
+};
+
+static void surf_rhs_tramp(const void* ctx, double t, const double* y,
+                           double* dy) {
+  (void)t;
+  const SurfCtx* s = (const SurfCtx*)ctx;
+  br_surf_rhs(s->m, s->gm, s->T, s->Asv, s->asv_quirk, y, dy);
+}
+
+int32_t br_solve_surf_bdf(const BrSurfMech* m, const BrGasMech* gm, double T,
+                          double Asv, int32_t asv_quirk, const double* y0,
+                          double t0, double t1, double rtol, double atol,
+                          int64_t max_steps, double first_step, double* y_out,
+                          double* ts_out, double* ys_out, int64_t n_save,
+                          int64_t* n_saved, BrStats* stats) {
+  SurfCtx ctx = {m, gm, T, Asv, asv_quirk};
+  return br_bdf(surf_rhs_tramp, &ctx, m->Sg + m->Ss, y0, t0, t1, rtol, atol,
+                max_steps, first_step, y_out, ts_out, ys_out, n_save, n_saved,
+                stats);
 }
 
 }  // extern "C"

@@ -26,9 +26,15 @@ LIB = REFERENCE / "test" / "lib"
 
 @pytest.fixture(scope="session")
 def lib_dir():
+    # CI runners have no reference checkout: mechanism-driven tests skip
+    # there and the pure-solver/pure-math tests still give signal
+    if not LIB.is_dir():
+        pytest.skip(f"reference mechanism library unavailable at {LIB}")
     return str(LIB)
 
 
 @pytest.fixture(scope="session")
 def reference_dir():
+    if not REFERENCE.is_dir():
+        pytest.skip(f"reference checkout unavailable at {REFERENCE}")
     return REFERENCE

@@ -140,3 +140,47 @@ def test_troe_falloff_limits(gri_setup):
     lo = k_eff(1e-8)
     cM_lo = float(gm.eff[i] @ jnp.full(53, 1e-8))
     assert abs(lo / (k0 * cM_lo) - 1) < 0.5  # low-pressure limit (F<=1)
+
+
+class TestAnalyticJacobian:
+    """ops/rhs.make_gas_jac must equal jax.jacfwd of the RHS to roundoff —
+    it is the matrix every implicit step builds (solver/sdirk.py)."""
+
+    def _check(self, mech, lib_dir, comp, kc_compat=False):
+        import batchreactor_tpu as br
+        from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+        from batchreactor_tpu.utils.composition import density, mole_to_mass
+
+        gm = br.compile_gaschemistry(f"{lib_dir}/{mech}")
+        th = br.create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+        sp = list(gm.species)
+        x0 = np.zeros(len(sp))
+        for name, frac in comp.items():
+            x0[sp.index(name)] = frac
+        T = 1400.0
+        rho = float(density(jnp.asarray(x0), th.molwt, T, 1e5))
+        y0 = jnp.asarray(np.asarray(mole_to_mass(jnp.asarray(x0), th.molwt)) * rho)
+        rhs = make_gas_rhs(gm, th, kc_compat=kc_compat)
+        jac = make_gas_jac(gm, th, kc_compat=kc_compat)
+        cfg = {"T": jnp.asarray(T)}
+        states = [
+            y0,  # zeros present (radicals at 0): exclusive-product edge case
+            y0 + 1e-4 * jnp.max(y0) * jnp.abs(jnp.sin(1.7 * jnp.arange(len(sp)))),
+            jnp.abs(y0) + 1e-7,  # strictly positive
+        ]
+        for y in states:
+            Jf = jax.jacfwd(lambda q: rhs(0.0, q, cfg))(y)
+            Ja = jac(0.0, y, cfg)
+            scale = float(jnp.max(jnp.abs(Jf)))
+            assert float(jnp.max(jnp.abs(Ja - Jf))) / scale < 1e-12
+
+    def test_h2o2(self, lib_dir):
+        self._check("h2o2.dat", lib_dir, {"H2": 0.25, "O2": 0.25, "N2": 0.5})
+
+    def test_grimech_with_falloff_and_troe(self, lib_dir):
+        self._check("grimech.dat", lib_dir,
+                    {"CH4": 0.25, "O2": 0.5, "N2": 0.25})
+
+    def test_kc_compat_mode(self, lib_dir):
+        self._check("grimech.dat", lib_dir,
+                    {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, kc_compat=True)

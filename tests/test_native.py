@@ -154,3 +154,74 @@ def test_first_step_and_max_steps(h2o2):
     res = native.solve_gas_bdf(gm, th, 1173.0, y0, 0.0, 10.0, max_steps=5)
     assert res.status == "MaxIters"
     assert res.t < 10.0
+
+
+class TestNativeSurface:
+    """Native surface kinetics vs the JAX kernels (ops/surface_kinetics.py)
+    and the all-native surf/gas+surf solve path (backend="cpu")."""
+
+    @pytest.fixture(scope="class")
+    def surf(self, lib_dir):
+        from batchreactor_tpu.io.config import input_data
+        from batchreactor_tpu.api import Chemistry
+
+        id_ = input_data("/root/reference/test/batch_gas_and_surf/batch.xml",
+                         lib_dir, Chemistry(surfchem=True, gaschem=True))
+        return id_
+
+    def test_surface_rates_match_jax(self, surf):
+        from batchreactor_tpu.ops import surface_kinetics
+
+        id_ = surf
+        sm = id_.smd
+        T, p = id_.T, id_.p
+        x = jnp.asarray(id_.mole_fracs)
+        theta = sm.ini_covg
+        sg_j, ss_j = surface_kinetics.production_rates(T, p, x, theta, sm)
+        sg_n, ss_n = native.surface_rates(sm, T, p, np.asarray(x),
+                                          np.asarray(theta))
+        np.testing.assert_allclose(sg_n, np.asarray(sg_j), rtol=1e-12,
+                                   atol=1e-300)
+        np.testing.assert_allclose(ss_n, np.asarray(ss_j), rtol=1e-12,
+                                   atol=1e-300)
+
+    @pytest.mark.parametrize("coupled", [False, True])
+    def test_surf_rhs_matches_jax(self, surf, coupled):
+        from batchreactor_tpu.api import get_solution_vector
+        from batchreactor_tpu.ops.rhs import make_surface_rhs
+
+        id_ = surf
+        y0 = get_solution_vector(id_.mole_fracs, id_.thermo.molwt, id_.T,
+                                 id_.p, ini_covg=id_.smd.ini_covg)
+        gm = id_.gmd if coupled else None
+        rhs = make_surface_rhs(id_.smd, id_.thermo, gm=gm)
+        cfg = {"T": jnp.asarray(id_.T), "Asv": jnp.asarray(id_.Asv)}
+        dy_j = np.asarray(rhs(0.0, y0, cfg))
+        dy_n = native.surf_rhs(id_.smd, id_.thermo, id_.T, id_.Asv,
+                               np.asarray(y0), gm=gm)
+        scale = np.max(np.abs(dy_j))
+        np.testing.assert_allclose(dy_n, dy_j, rtol=1e-10, atol=1e-12 * scale)
+
+    def test_native_backend_gas_and_surf_run(self, surf, tmp_path, lib_dir):
+        """backend="cpu" end-to-end on the golden gas+surf config (short
+        horizon): runs all-native and matches the JAX backend's state."""
+        import shutil
+
+        src = "/root/reference/test/batch_gas_and_surf/batch.xml"
+        dst = tmp_path / "batch.xml"
+        txt = open(src).read().replace("<time>10</time>", "<time>1e-4</time>")
+        dst.write_text(txt)
+        ret = br.batch_reactor(str(dst), lib_dir, gaschem=True, surfchem=True,
+                               backend="cpu")
+        assert ret == "Success"
+        rows_cpu = open(tmp_path / "gas_profile.csv").readlines()
+        ret = br.batch_reactor(str(dst), lib_dir, gaschem=True, surfchem=True,
+                               backend="jax")
+        assert ret == "Success"
+        rows_jax = open(tmp_path / "gas_profile.csv").readlines()
+        last_cpu = np.array([float(v) for v in rows_cpu[-1].split(",")])
+        last_jax = np.array([float(v) for v in rows_jax[-1].split(",")])
+        # same final time, state agreement at solver-tolerance scale
+        np.testing.assert_allclose(last_cpu[0], last_jax[0], rtol=1e-12)
+        np.testing.assert_allclose(last_cpu[1:], last_jax[1:], rtol=5e-4,
+                                   atol=1e-12)

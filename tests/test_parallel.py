@@ -97,3 +97,102 @@ def test_sharded_matches_unsharded(h2o2):
     a = temperature_sweep(rhs, y0, T_grid, 1e-4, mesh=make_mesh(), dt0=1e-12)
     b = temperature_sweep(rhs, y0, T_grid, 1e-4, mesh=None, dt0=1e-12)
     np.testing.assert_allclose(np.asarray(a.y), np.asarray(b.y), rtol=1e-12)
+
+
+def test_condition_grid_and_premixed():
+    from batchreactor_tpu.parallel import condition_grid, premixed_mole_fracs
+
+    g = condition_grid(T=jnp.linspace(1000., 1300., 4),
+                       phi=jnp.linspace(0.5, 2.0, 3))
+    assert g["T"].shape == (12,) and g["phi"].shape == (12,)
+    # lane-major ordering: T varies slowest
+    assert float(g["T"][0]) == float(g["T"][2]) == 1000.0
+    assert float(g["phi"][0]) == 0.5 and float(g["phi"][1]) == 1.25
+
+    species = ("CH4", "O2", "N2", "AR")
+    x = premixed_mole_fracs(species, "CH4", jnp.array([1.0]), stoich_o2=2.0,
+                            diluent="N2", o2_to_diluent=3.76)
+    # phi=1 CH4/air: x_CH4 = 1/(1+2+7.52) = 0.0950
+    np.testing.assert_allclose(float(x[0, 0]), 1.0 / 10.52, rtol=1e-12)
+    np.testing.assert_allclose(float(np.asarray(x).sum()), 1.0, rtol=1e-12)
+    x2 = premixed_mole_fracs(species, "CH4", jnp.array([0.5, 2.0]),
+                             stoich_o2=2.0)
+    # richer mixture -> more fuel fraction
+    assert float(x2[1, 0]) > float(x2[0, 0])
+
+
+def test_sweep_solution_vectors_matches_api(h2o2):
+    from batchreactor_tpu.api import get_solution_vector
+    from batchreactor_tpu.parallel import sweep_solution_vectors
+
+    gm, th, y0 = h2o2
+    sp = list(gm.species)
+    x = np.zeros(9)
+    x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = 0.25, 0.25, 0.5
+    xs = jnp.broadcast_to(jnp.asarray(x), (3, 9))
+    Ts = jnp.array([1100.0, 1173.0, 1250.0])
+    ys = sweep_solution_vectors(xs, th.molwt, Ts, 1e5)
+    for i, T in enumerate([1100.0, 1173.0, 1250.0]):
+        ref = get_solution_vector(x, th.molwt, T, 1e5)
+        np.testing.assert_allclose(np.asarray(ys[i]), np.asarray(ref),
+                                   rtol=1e-12)
+
+
+def test_sweep_report(h2o2):
+    from batchreactor_tpu.parallel import sweep_report
+
+    gm, th, y0 = h2o2
+    rhs = make_gas_rhs(gm, th)
+    y0s = jnp.stack([y0, y0.at[0].set(jnp.nan), y0])
+    cfg = {"T": jnp.array([1173.0, 1173.0, 1200.0])}
+    res = ensemble_solve(rhs, y0s, 0.0, 1e-5, cfg, dt0=1e-12)
+    rep = sweep_report(res, cfg)
+    assert rep["n_lanes"] == 3
+    assert rep["counts"]["success"] == 2
+    assert rep["failed_lanes"] == [1]
+    assert rep["failed_conditions"]["T"] == [1173.0]
+
+
+def test_checkpointed_sweep_resume(h2o2, tmp_path):
+    """Chunked checkpoint/resume: second invocation loads chunks from disk
+    (no device work) and reproduces the full-result concatenation exactly."""
+    from batchreactor_tpu.parallel import checkpointed_sweep
+
+    gm, th, y0 = h2o2
+    rhs = make_gas_rhs(gm, th)
+    B = 6
+    y0s = jnp.broadcast_to(y0, (B, 9))
+    cfgs = {"T": jnp.linspace(1150.0, 1300.0, B)}
+    ck = str(tmp_path / "sweep")
+    res1 = checkpointed_sweep(rhs, y0s, 0.0, 1e-5, cfgs, ck, chunk_size=4,
+                              dt0=1e-12)
+    assert res1.y.shape == (B, 9)
+    import os
+    files = sorted(os.listdir(ck))
+    assert files == ["chunk_00000.npz", "chunk_00001.npz", "manifest.json"]
+    # tamper-proof resume: drop one chunk, re-run -> only that chunk resolves
+    os.remove(os.path.join(ck, "chunk_00001.npz"))
+    res2 = checkpointed_sweep(rhs, y0s, 0.0, 1e-5, cfgs, ck, chunk_size=4,
+                              dt0=1e-12)
+    np.testing.assert_allclose(np.asarray(res2.y), np.asarray(res1.y),
+                               rtol=1e-12)
+    # manifest mismatch fails loudly
+    with pytest.raises(ValueError):
+        checkpointed_sweep(rhs, y0s, 0.0, 2e-5, cfgs, ck, chunk_size=4,
+                           dt0=1e-12)
+
+
+def test_phases_timer():
+    from batchreactor_tpu.utils.profiling import Phases
+
+    ph = Phases()
+    with ph("parse"):
+        pass
+    with ph("solve", block=jnp.ones(4)):
+        pass
+    with ph("solve"):
+        pass
+    s = ph.summary()
+    assert set(s) == {"parse", "solve"} and all(v >= 0 for v in s.values())
+    assert ph.counts["solve"] == 2
+    assert "solve" in ph.pretty()
