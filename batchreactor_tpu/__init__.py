@@ -25,7 +25,12 @@ jax.config.update("jax_enable_x64", True)
 from .models.thermo import ThermoTable, create_thermo  # noqa: E402
 from .models.gas import GasMechanism, compile_gaschemistry  # noqa: E402
 from .models.surface import SurfaceMechanism, compile_mech  # noqa: E402
-from .api import Chemistry, SensitivityProblem, batch_reactor  # noqa: E402
+from .api import (  # noqa: E402
+    Chemistry,
+    SensitivityProblem,
+    batch_reactor,
+    batch_reactor_sweep,
+)
 from .io.config import InputData, input_data  # noqa: E402
 
 __all__ = [
@@ -38,6 +43,7 @@ __all__ = [
     "Chemistry",
     "SensitivityProblem",
     "batch_reactor",
+    "batch_reactor_sweep",
     "InputData",
     "input_data",
 ]

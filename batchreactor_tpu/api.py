@@ -285,6 +285,133 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
     return ts, dict(zip(species, x_end.tolist()))
 
 
+# (rhs, jac, observer, observer_init) closures per (mechanism, settings):
+# ensemble compilation caches key on callable *identity* (parallel/sweep.py),
+# so rebuilding closures per call would recompile the sweep every time.
+# Keyed on object ids with strong refs held in the values (ids stay valid
+# while cached); bounded FIFO eviction.
+_SWEEP_FNS = {}
+
+
+def _sweep_fns(mode, md, thermo_obj, kc_compat, asv_quirk, marker_idx,
+               ignition_mode):
+    from .parallel import ignition_observer
+
+    key = (mode, id(md), id(thermo_obj), kc_compat, asv_quirk, marker_idx,
+           ignition_mode)
+    hit = _SWEEP_FNS.get(key)
+    if hit is not None and hit[0] is md and hit[1] is thermo_obj:
+        return hit[2:]
+    rhs = _make_rhs(mode, None, md if mode == "gas" else None,
+                    md if mode == "surf" else None, thermo_obj,
+                    kc_compat, asv_quirk)
+    jac = make_gas_jac(md, thermo_obj, kc_compat) if mode == "gas" else None
+    observer = obs0 = None
+    if marker_idx is not None:
+        observer, obs0 = ignition_observer(marker_idx, mode=ignition_mode)
+    if len(_SWEEP_FNS) >= 64:
+        _SWEEP_FNS.pop(next(iter(_SWEEP_FNS)))
+    _SWEEP_FNS[key] = (md, thermo_obj, rhs, jac, observer, obs0)
+    return rhs, jac, observer, obs0
+
+
+def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
+                        md=None, Asv=1.0, mesh=None, rtol=1e-6, atol=1e-10,
+                        max_steps=200_000, segment_steps=0, kc_compat=False,
+                        asv_quirk=True, ignition_marker=None,
+                        ignition_mode="half"):
+    """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
+    per condition, solved in a single mesh-sharded XLA program.
+
+    ``T`` and/or ``Asv`` may be scalars or (B,)-arrays (scalars broadcast);
+    ``inlet_comp`` is either one composition dict shared by all lanes or a
+    dict of per-lane arrays ``{species: (B,)}``.  Returns a dict with
+    per-lane final mole fractions ``x`` {species: (B,)}, solver ``report``
+    (parallel.sweep_report), final times, and — when ``ignition_marker`` (a
+    species name) is given — per-lane ignition delays ``tau`` extracted
+    in-loop by an observer fold.
+
+    The reference has no sweep analog (one condition per call,
+    /root/reference/src/BatchReactor.jl:210); this is the TPU-native scaling
+    surface (BASELINE.md workloads).  ``segment_steps > 0`` bounds each
+    device launch and continues on host (parallel.ensemble_solve_segmented).
+    """
+    from .parallel import (ensemble_solve, ensemble_solve_segmented,
+                           sweep_report)
+    from .parallel.grid import sweep_solution_vectors
+    from .parallel.sweep import pad_to_mesh, unpad_result
+
+    if chem is None or thermo_obj is None or md is None:
+        raise TypeError("batch_reactor_sweep needs chem=, thermo_obj=, md=")
+    if chem.surfchem and chem.gaschem:
+        raise ValueError("sweep API supports exactly one of surfchem/gaschem "
+                         "per call (as the programmatic reference form does)")
+    species = thermo_obj.species
+    mode = "surf" if chem.surfchem else "gas"
+    covg0 = md.ini_covg if chem.surfchem else None
+
+    T = jnp.atleast_1d(jnp.asarray(T, dtype=jnp.float64))
+    Asv = jnp.asarray(Asv, dtype=jnp.float64)
+    B = max(T.shape[0], Asv.shape[0] if Asv.ndim else 1,
+            max((np.asarray(v).shape[0] for v in inlet_comp.values()
+                 if np.ndim(v)), default=1))
+    T = jnp.broadcast_to(T, (B,))
+    Asv = jnp.broadcast_to(Asv, (B,))
+
+    idx = {s.upper(): k for k, s in enumerate(species)}
+    X = np.zeros((B, len(species)))
+    for name, val in inlet_comp.items():
+        key = name.upper()
+        if key not in idx:
+            raise KeyError(f"composition species {name!r} not in species list")
+        X[:, idx[key]] = np.asarray(val)
+
+    y0s = sweep_solution_vectors(jnp.asarray(X), thermo_obj.molwt, T, p,
+                                 ini_covg=covg0)
+    cfgs = {"T": T, "Asv": Asv}
+    marker_idx = None
+    if ignition_marker is not None:
+        key = ignition_marker.upper()
+        if key not in idx:
+            raise KeyError(f"ignition_marker {ignition_marker!r} not in "
+                           f"species list")
+        marker_idx = idx[key]
+    rhs, jac, observer, obs0 = _sweep_fns(mode, md, thermo_obj, kc_compat,
+                                          asv_quirk, marker_idx,
+                                          ignition_mode)
+
+    if mesh is not None:
+        # pad the batch to the mesh device count with copies of the last
+        # lane (even shards are a sharding requirement); sliced off below
+        y0s, cfgs, B = pad_to_mesh(y0s, cfgs, mesh)
+
+    common = dict(mesh=mesh, rtol=rtol, atol=atol, jac=jac,
+                  observer=observer, observer_init=obs0)
+    if segment_steps > 0:
+        res = ensemble_solve_segmented(rhs, y0s, 0.0, float(time), cfgs,
+                                       segment_steps=segment_steps, **common)
+    else:
+        res = ensemble_solve(rhs, y0s, 0.0, float(time), cfgs,
+                             max_steps=max_steps, **common)
+    res = unpad_result(res, B)
+    cfgs = {k: v[:B] for k, v in cfgs.items()}
+
+    ng = len(species)
+    moles = np.asarray(res.y)[:, :ng] / np.asarray(thermo_obj.molwt)
+    x_end = moles / moles.sum(axis=1, keepdims=True)
+    out = {
+        "x": {s: x_end[:, k] for k, s in enumerate(species)},
+        "t": np.asarray(res.t),
+        "status": np.asarray(res.status),
+        "report": sweep_report(res, cfgs),
+    }
+    if chem.surfchem:
+        out["covg"] = np.asarray(res.y)[:, ng:]
+    if ignition_marker is not None:
+        out["tau"] = np.asarray(res.observed["tau"])
+    return out
+
+
 def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   Asv=1.0, chem=None, thermo_obj=None, md=None,
                   rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,

@@ -2,6 +2,7 @@ from .checkpoint import checkpointed_sweep, load_result, save_result
 from .grid import condition_grid, premixed_mole_fracs, sweep_solution_vectors
 from .sweep import (
     ensemble_solve,
+    ensemble_solve_segmented,
     ignition_delay,
     ignition_observer,
     make_mesh,
@@ -14,6 +15,7 @@ __all__ = [
     "checkpointed_sweep",
     "condition_grid",
     "ensemble_solve",
+    "ensemble_solve_segmented",
     "ignition_delay",
     "ignition_observer",
     "load_result",

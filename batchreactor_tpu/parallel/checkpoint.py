@@ -25,7 +25,7 @@ from .sweep import ensemble_solve
 
 
 _FIELDS = ("t", "y", "status", "n_accepted", "n_rejected", "ts", "ys",
-           "n_saved")
+           "n_saved", "h")
 
 
 def _obs_dict(res):

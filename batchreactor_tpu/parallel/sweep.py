@@ -40,6 +40,29 @@ def pad_batch(batch_size, mesh):
     return ((batch_size + n - 1) // n) * n
 
 
+def pad_to_mesh(y0s, cfgs, mesh):
+    """Pad the batch axis to the mesh device count with copies of the last
+    lane.  Returns (y0s, cfgs, original_B); slice results back with
+    :func:`unpad_result`."""
+    B = y0s.shape[0]
+    pad = pad_batch(B, mesh) - B
+    if pad:
+        y0s = jnp.concatenate([y0s, jnp.repeat(y0s[-1:], pad, axis=0)])
+        cfgs = jax.tree.map(
+            lambda v: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)]),
+            cfgs)
+    return y0s, cfgs, B
+
+
+def unpad_result(res, B):
+    """Slice a batched SolveResult back to the original B lanes (inverse of
+    :func:`pad_to_mesh`; no-op when nothing was padded)."""
+    if int(res.y.shape[0]) == B:
+        return res
+    return jax.tree.map(
+        lambda x: x[:B] if hasattr(x, "ndim") and x.ndim >= 1 else x, res)
+
+
 def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
                    dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
@@ -106,6 +129,104 @@ def temperature_sweep(rhs, y0, T_grid, t1, base_cfg=None, **kw):
     cfg = {k: jnp.broadcast_to(jnp.asarray(v), (B,)) for k, v in cfg.items()}
     cfg["T"] = T_grid
     return ensemble_solve(rhs, y0s, 0.0, t1, cfg, **kw)
+
+
+def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
+                             max_segments=10_000, mesh=None, axis="batch",
+                             progress=None, rtol=1e-6, atol=1e-10,
+                             linsolve="auto", jac=None, observer=None,
+                             observer_init=None, dt_min_factor=1e-22):
+    """ensemble_solve with the device program bounded to ``segment_steps``
+    step attempts per launch; the host loops segments until every lane
+    terminates.
+
+    Why: one monolithic while_loop over a full ignition sweep can run for
+    many minutes on a single XLA launch — long enough to trip RPC/watchdog
+    limits on tunneled TPU runtimes, and invisible to the host until it
+    finishes.  Segmenting bounds the blast radius of a launch, lets
+    ``progress`` observe per-segment completion (lanes done / steps taken),
+    and costs one dispatch per segment.  State carried between segments:
+    per-lane (t, y, next step size h, observer fold); a lane that fails
+    terminally (DT_UNDERFLOW) is parked so it does not burn segment budget
+    re-failing.  Trajectory buffers are not supported here (``n_save``
+    merging across segments is not implemented) — use the observer for
+    streaming reductions, or unsegmented ensemble_solve for trajectories.
+    """
+    y0s = jnp.asarray(y0s)
+    B = y0s.shape[0]
+    jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
+                                      dt_min_factor, linsolve, jac, observer)
+    t1 = jnp.asarray(t1, dtype=y0s.dtype)
+    t = jnp.full((B,), t0, dtype=y0s.dtype)
+    h = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: heuristic first step
+    y = y0s
+    if observer is not None:
+        obs = jax.tree.map(
+            lambda x: jnp.broadcast_to(jnp.asarray(x),
+                                       (B,) + jnp.shape(jnp.asarray(x))),
+            observer_init)
+    else:
+        obs = jnp.zeros((B,))
+    if mesh is not None:
+        spec = NamedSharding(mesh, P(axis))
+        y = jax.device_put(y, spec)
+        t = jax.device_put(t, spec)
+        h = jax.device_put(h, spec)
+        cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
+        obs = jax.tree.map(lambda x: jax.device_put(x, spec), obs)
+
+    final_status = np.full((B,), int(sdirk.RUNNING), dtype=np.int32)
+    n_acc = np.zeros((B,), dtype=np.int64)
+    n_rej = np.zeros((B,), dtype=np.int64)
+    for seg in range(max_segments):
+        res = jitted(y, t, t1, cfgs, h, obs)
+        status = np.asarray(res.status)
+        n_acc += np.asarray(res.n_accepted)
+        n_rej += np.asarray(res.n_rejected)
+        running = final_status == int(sdirk.RUNNING)
+        terminal = status != int(sdirk.MAX_STEPS_REACHED)
+        final_status = np.where(running & terminal, status, final_status)
+        # park terminally failed lanes at t1 so they finish trivially
+        failed = jnp.asarray((final_status != int(sdirk.SUCCESS))
+                             & (final_status != int(sdirk.RUNNING)))
+        t = jnp.where(failed, t1, res.t)
+        y, h = res.y, res.h
+        if observer is not None:
+            obs = res.observed
+        done = not bool(np.any(final_status == int(sdirk.RUNNING)))
+        if progress is not None:
+            progress({"segment": seg, "lanes_done": int(
+                (final_status != int(sdirk.RUNNING)).sum()), "n_lanes": B,
+                "accepted_total": int(n_acc.sum())})
+        if done:
+            break
+    else:
+        final_status[final_status == int(sdirk.RUNNING)] = int(
+            sdirk.MAX_STEPS_REACHED)
+
+    return sdirk.SolveResult(
+        t=res.t, y=y, status=jnp.asarray(final_status),
+        n_accepted=jnp.asarray(n_acc), n_rejected=jnp.asarray(n_rej),
+        ts=res.ts, ys=res.ys, n_saved=res.n_saved, h=h,
+        observed=obs if observer is not None else None)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
+                             linsolve, jac, observer):
+    """Compiled per-segment batched solve: per-lane t0 and carried-in step
+    size are traced operands (vmap axis 0), so every segment reuses one
+    executable."""
+
+    def one(y0, t0, t1, cfg, h0, obs0):
+        return sdirk.solve(
+            rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol,
+            max_steps=segment_steps, n_save=0, dt0=h0,
+            dt_min_factor=dt_min_factor, linsolve=linsolve, jac=jac,
+            observer=observer,
+            observer_init=obs0 if observer is not None else None)
+
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, None, 0, 0, 0)))
 
 
 def sweep_report(res, cfgs=None):

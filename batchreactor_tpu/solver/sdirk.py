@@ -60,6 +60,7 @@ class SolveResult:
     ts: jnp.ndarray         # (n_save,) accepted-step times, +inf padded
     ys: jnp.ndarray         # (n_save, n) accepted-step states, 0 padded
     n_saved: jnp.ndarray    # number of valid rows in ts/ys (saturates)
+    h: jnp.ndarray = None   # step size the controller would try next
     observed: object = None  # observer fold state (None without observer)
 
 
@@ -139,7 +140,7 @@ def solve(
     else:
         jac = functools.partial(jac, cfg=cfg)
 
-    if dt0 is None:
+    if dt0 is None or not isinstance(dt0, (int, float)):
         # standard first-step heuristic (Hairer & Wanner II.4): h ~ 1% of the
         # scale-relative state/derivative ratio, clipped into the span
         f0 = f(t0, y0)
@@ -148,7 +149,13 @@ def solve(
         # lower clip must admit chemistry's ~1e-16 s initial transients
         # (golden first step 4.3e-16 s, /root/reference/test/
         # batch_gas_and_surf/gas_profile.csv row 2)
-        dt0 = jnp.clip(0.01 * d0 / jnp.maximum(d1, 1e-30), span * 1e-24, span)
+        h_heur = jnp.clip(0.01 * d0 / jnp.maximum(d1, 1e-30), span * 1e-24, span)
+        if dt0 is None:
+            dt0 = h_heur
+        else:
+            # traced dt0 (segmented resume): non-positive means "no carry-in
+            # step size, use the heuristic"
+            dt0 = jnp.where(jnp.asarray(dt0) > 0, jnp.asarray(dt0), h_heur)
     dt0 = jnp.asarray(dt0, dtype=y0.dtype)
 
     n_save_buf = max(n_save, 1)
@@ -264,7 +271,10 @@ def solve(
 
         # tolerance absorbs t + (t1 - t) rounding so the loop can't stall
         finished = accept & (t_new >= t1 - span * 1e-14)
-        too_small = (~accept) & (h_next < span * dt_min_factor)
+        # non-finite h (NaN state/RHS poisoning the controller) is terminal:
+        # it can never recover and would otherwise burn max_steps rejecting
+        too_small = (~accept) & ((h_next < span * dt_min_factor)
+                                 | ~jnp.isfinite(h_next))
         out_of_steps = (n_acc2 + n_rej2) >= max_steps
         status2 = jnp.where(
             finished,
@@ -284,6 +294,6 @@ def solve(
      obs) = lax.while_loop(cond, body, init)
     return SolveResult(
         t=t, y=y, status=status, n_accepted=n_acc, n_rejected=n_rej,
-        ts=ts, ys=ys, n_saved=n_saved,
+        ts=ts, ys=ys, n_saved=n_saved, h=h,
         observed=obs if observer is not None else None,
     )

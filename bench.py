@@ -107,7 +107,8 @@ def main():
     sys.path.insert(0, REPO)
     import batchreactor_tpu as br
     from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
-    from batchreactor_tpu.parallel import ensemble_solve, ignition_observer
+    from batchreactor_tpu.parallel import (ensemble_solve_segmented,
+                                           ignition_observer)
     from batchreactor_tpu.solver.sdirk import SUCCESS
     from batchreactor_tpu.utils.composition import density, mole_to_mass
 
@@ -126,15 +127,21 @@ def main():
     # at B=256 — measured; the fold is free)
     obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
 
+    # segmented execution: bounded device launches (host continuation)
+    # so one multi-minute XLA launch can't trip tunnel RPC/watchdog limits
+    seg_steps = int(os.environ.get("BENCH_SEG_STEPS", "512"))
+
     def tpu_sweep():
         rhos = jax.vmap(lambda T: density(jnp.asarray(x0), th.molwt, T, 1e5))(
             T_grid)
         y0 = mole_to_mass(jnp.asarray(x0), th.molwt)
         y0s = rhos[:, None] * y0[None, :]
-        return ensemble_solve(
+        return ensemble_solve_segmented(
             rhs, y0s, 0.0, T1, {"T": T_grid}, rtol=RTOL, atol=ATOL,
-            max_steps=100_000, dt0=1e-10, jac=jac,
-            observer=obs, observer_init=obs0)
+            segment_steps=seg_steps, jac=jac,
+            observer=obs, observer_init=obs0,
+            progress=lambda p: log(f"  segment {p['segment']}: "
+                                   f"{p['lanes_done']}/{p['n_lanes']} lanes"))
 
     log(f"devices: {jax.devices()}")
     log(f"compiling + warm-up sweep (B={B}, t1={T1}) ...")

@@ -196,3 +196,51 @@ def test_phases_timer():
     assert set(s) == {"parse", "solve"} and all(v >= 0 for v in s.values())
     assert ph.counts["solve"] == 2
     assert "solve" in ph.pretty()
+
+
+def test_segmented_matches_unsegmented(h2o2):
+    """Segmented execution (bounded device launches + host continuation)
+    must reproduce the monolithic solve: same final states at tolerance
+    scale, same ignition delays from the carried observer fold."""
+    from batchreactor_tpu.parallel import (ensemble_solve_segmented,
+                                           ignition_observer)
+
+    gm, th, y0 = h2o2
+    sp = list(gm.species)
+    rhs = make_gas_rhs(gm, th)
+    B = 4
+    y0s = jnp.broadcast_to(y0, (B, 9))
+    cfgs = {"T": jnp.linspace(1200.0, 1400.0, B)}
+    obs, obs0 = ignition_observer(sp.index("H2"), mode="half")
+    full = ensemble_solve(rhs, y0s, 0.0, 2e-3, cfgs, dt0=1e-12,
+                          observer=obs, observer_init=obs0)
+    segs = []
+    seg = ensemble_solve_segmented(
+        rhs, y0s, 0.0, 2e-3, cfgs, segment_steps=64,
+        observer=obs, observer_init=obs0,
+        progress=lambda p: segs.append(p))
+    assert len(segs) >= 2, "expected multiple segments at segment_steps=64"
+    assert np.all(np.asarray(seg.status) == SUCCESS)
+    np.testing.assert_allclose(np.asarray(seg.t), np.asarray(full.t),
+                               rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(seg.y), np.asarray(full.y),
+                               rtol=1e-5, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(seg.observed["tau"]),
+                               np.asarray(full.observed["tau"]), rtol=5e-2)
+
+
+def test_segmented_parks_failed_lanes(h2o2):
+    """A terminally failed lane must not burn segment budget re-failing:
+    its DT_UNDERFLOW status survives while healthy lanes complete."""
+    from batchreactor_tpu.parallel import ensemble_solve_segmented
+    from batchreactor_tpu.solver.sdirk import DT_UNDERFLOW
+
+    gm, th, y0 = h2o2
+    rhs = make_gas_rhs(gm, th)
+    y0s = jnp.stack([y0, y0.at[0].set(jnp.nan), y0])
+    cfgs = {"T": jnp.full((3,), 1173.0)}
+    res = ensemble_solve_segmented(rhs, y0s, 0.0, 1e-5, cfgs,
+                                   segment_steps=64, dt_min_factor=1e-12)
+    status = np.asarray(res.status)
+    assert status[0] == SUCCESS and status[2] == SUCCESS
+    assert status[1] == DT_UNDERFLOW
