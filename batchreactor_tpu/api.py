@@ -93,7 +93,7 @@ def _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk):
     /root/reference/src/BatchReactor.jl:314-373).  Called both eagerly and
     inside :func:`_solve` under jit — the mechanism bundles may be tracers."""
     if mode == "udf":
-        return make_udf_rhs(udf, thermo.molwt)
+        return make_udf_rhs(udf, thermo.molwt, species=thermo.species)
     if mode in ("surf", "gas+surf"):
         return make_surface_rhs(sm, thermo, gm=gm if mode == "gas+surf" else
                                 None, asv_quirk=asv_quirk,

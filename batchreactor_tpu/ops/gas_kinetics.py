@@ -104,7 +104,10 @@ def forward_rate_constants(T, conc, gm, with_grad=False):
     F, dF_dPr = _troe_F(T, Pr, gm.troe, gm.has_troe, with_grad=True)
     kf = jnp.where(gm.has_falloff > 0, k_inf * L * F, k_inf)
     dkf_dPr = k_inf * (F / ((1.0 + Pr) * (1.0 + Pr)) + L * dF_dPr)
-    dkf_dcM = jnp.where(gm.has_falloff > 0, dkf_dPr * ratio, 0.0)
+    # the forward path clamps Pr at cM=0, so the true derivative is 0 for
+    # transiently negative Newton iterates — match it exactly
+    dkf_dcM = jnp.where((gm.has_falloff > 0) & (cM > 0.0),
+                        dkf_dPr * ratio, 0.0)
     dtb_dcM = jnp.where(gm.has_tb > 0, 1.0, 0.0)
     return kf, tb_factor, dkf_dcM, dtb_dcM
 

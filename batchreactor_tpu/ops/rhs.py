@@ -99,13 +99,16 @@ def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
     return rhs
 
 
-def make_udf_rhs(udf, molwt):
+def make_udf_rhs(udf, molwt, species=None):
     """Pure RHS for a user-defined source function.
 
     ``udf(t, state_dict) -> source (S,) [mol/m^3/s]`` must be JAX-traceable;
-    state_dict carries T, p, mole_frac, molwt (cf. UserDefinedState fields,
+    state_dict carries T, p, mole_frac, molwt, and species — the static
+    tuple of species names, so a UDF author can map state-vector indices to
+    names without out-of-band info (cf. UserDefinedState fields,
     /root/reference/src/BatchReactor.jl:199 and docs/src/index.md:68-76).
     """
+    species = tuple(species) if species is not None else None
 
     def rhs(t, y, cfg):
         T = cfg["T"]
@@ -113,7 +116,8 @@ def make_udf_rhs(udf, molwt):
         mass_fracs = y / rho
         mole_fracs = mass_to_mole(mass_fracs, molwt)
         p = pressure(rho, mole_fracs, molwt, T)
-        state = {"T": T, "p": p, "mole_frac": mole_fracs, "molwt": molwt}
+        state = {"T": T, "p": p, "mole_frac": mole_fracs, "molwt": molwt,
+                 "species": species}
         source = udf(t, state)
         return source * molwt
 

@@ -120,10 +120,24 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
         h.update(np.ascontiguousarray(np.asarray(cfgs[k])).tobytes())
     for k in sorted(solve_kw):
         v = solve_kw[k]
+        h.update(k.encode())
         if callable(v):
             _hash_callable(h, v)
+        elif isinstance(v, (np.ndarray, jax.Array, list, tuple, dict)):
+            # array-valued kwargs (e.g. observer_init pytrees) hash by
+            # content: reprs truncate with '...' above ~1000 elements, so two
+            # sweeps differing only in a big array would collide and a
+            # mismatched resume would silently serve stale chunks
+            for leaf in jax.tree_util.tree_leaves(v):
+                if isinstance(leaf, (np.ndarray, jax.Array)):
+                    h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+                else:
+                    h.update(repr(leaf).encode())
         else:
-            h.update(f"{k}={v}".encode())
+            # opaque objects (e.g. Mesh) hash by repr — np.asarray on them
+            # yields a 0-d object array whose bytes are a memory address,
+            # nondeterministic across processes
+            h.update(repr(v).encode())
     return h.hexdigest()
 
 

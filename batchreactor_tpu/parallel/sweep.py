@@ -176,21 +176,31 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         obs = jax.tree.map(lambda x: jax.device_put(x, spec), obs)
 
     final_status = np.full((B,), int(sdirk.RUNNING), dtype=np.int32)
+    final_t = np.full((B,), np.nan)
     n_acc = np.zeros((B,), dtype=np.int64)
     n_rej = np.zeros((B,), dtype=np.int64)
     for seg in range(max_segments):
         res = jitted(y, t, t1, cfgs, h, obs)
         status = np.asarray(res.status)
-        n_acc += np.asarray(res.n_accepted)
-        n_rej += np.asarray(res.n_rejected)
+        # only lanes still live this segment contribute step counts: parked
+        # lanes re-enter as zero-span solves that burn one rejected attempt
         running = final_status == int(sdirk.RUNNING)
+        n_acc += np.where(running, np.asarray(res.n_accepted), 0)
+        n_rej += np.where(running, np.asarray(res.n_rejected), 0)
         terminal = status != int(sdirk.MAX_STEPS_REACHED)
-        final_status = np.where(running & terminal, status, final_status)
-        # park terminally failed lanes at t1 so they finish trivially
-        failed = jnp.asarray((final_status != int(sdirk.SUCCESS))
-                             & (final_status != int(sdirk.RUNNING)))
-        t = jnp.where(failed, t1, res.t)
-        y, h = res.y, res.h
+        newly_terminal = running & terminal
+        final_status = np.where(newly_terminal, status, final_status)
+        # the reported t for a terminal lane is the t at the segment where it
+        # first terminated (for DT_UNDERFLOW that is the failure time, same
+        # as the unsegmented path reports) — not the t1 it gets parked at
+        final_t = np.where(newly_terminal, np.asarray(res.t), final_t)
+        parked = jnp.asarray(final_status != int(sdirk.RUNNING))
+        t = jnp.where(parked, t1, res.t)
+        y = res.y
+        # lanes parked *before* this segment ran a zero-span solve whose
+        # res.h is NaN — keep their last live h; lanes that terminated this
+        # segment take res.h (their final adapted step size)
+        h = jnp.where(jnp.asarray(~running), h, res.h)
         if observer is not None:
             obs = res.observed
         done = not bool(np.any(final_status == int(sdirk.RUNNING)))
@@ -203,9 +213,12 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     else:
         final_status[final_status == int(sdirk.RUNNING)] = int(
             sdirk.MAX_STEPS_REACHED)
+    # lanes that never terminated (budget exhausted) report their current t
+    final_t = np.where(np.isnan(final_t), np.asarray(res.t), final_t)
 
     return sdirk.SolveResult(
-        t=res.t, y=y, status=jnp.asarray(final_status),
+        t=jnp.asarray(final_t, dtype=y0s.dtype), y=y,
+        status=jnp.asarray(final_status),
         n_accepted=jnp.asarray(n_acc), n_rejected=jnp.asarray(n_rej),
         ts=res.ts, ys=res.ys, n_saved=res.n_saved, h=h,
         observed=obs if observer is not None else None)

@@ -103,10 +103,10 @@ def test_gas_and_surface_file_driven(tmp_path, reference_dir, lib_dir):
 
 
 # --- testset "surf chemistry" programmatic (runtests.jl:37-49) ---
-def test_programmatic_surface(lib_dir):
+def test_programmatic_surface(gri_lib_dir):
     gasphase = ["CH4", "H2O", "H2", "CO", "CO2", "O2", "N2"]
-    thermo = br.create_thermo(gasphase, f"{lib_dir}/therm.dat")
-    md = br.compile_mech(f"{lib_dir}/ch4ni.xml", thermo, gasphase)
+    thermo = br.create_thermo(gasphase, f"{gri_lib_dir}/therm.dat")
+    md = br.compile_mech(f"{gri_lib_dir}/ch4ni.xml", thermo, gasphase)
     chem = br.Chemistry(surfchem=True)
     t = 10.0
     ts, xf = br.batch_reactor(
@@ -138,12 +138,19 @@ def test_programmatic_gas(lib_dir):
 # --- testset "user defined chemistry" (runtests.jl:70-77): zero source ---
 def test_udf_file_driven(tmp_path, reference_dir, lib_dir):
     xml = _stage(tmp_path, reference_dir / "test" / "batch_udf")
+    seen_species = []
 
     def udf(t, state):
+        # state carries the static species tuple (UserDefinedState contract,
+        # /root/reference/src/BatchReactor.jl:199) so indices map to names
+        seen_species.append(state["species"])
         return jnp.zeros_like(state["mole_frac"])
 
     ret = br.batch_reactor(xml, lib_dir, udf)
     assert ret == "Success"
+    assert seen_species and all(
+        isinstance(s, tuple) and len(s) == len(seen_species[0]) and
+        all(isinstance(n, str) for n in s) for s in seen_species)
     rows = np.loadtxt(tmp_path / "gas_profile.csv", delimiter=",",
                       skiprows=1)
     # zero source: composition frozen at the inlet for all rows

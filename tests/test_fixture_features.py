@@ -1,0 +1,128 @@
+"""Parser features only exercised by the vendored fixtures.
+
+The reference's ch4ni.xml shows <mwc>, <order>, and 3-number <stick> entries
+only in comments (/root/reference/test/lib/ch4ni.xml:57-59), and no committed
+mechanism uses REACTIONS unit keywords — these paths were parsed-but-untested
+in round 1.  tests/fixtures/h2oni.xml exercises all of them; every rate here
+is asserted against a hand-computed value, not a stored snapshot.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import batchreactor_tpu as br
+from batchreactor_tpu.models.surface import compile_mech
+from batchreactor_tpu.ops import surface_kinetics
+from batchreactor_tpu.ops.gas_kinetics import forward_rate_constants
+from batchreactor_tpu.utils.constants import R
+
+GASPHASE = ["H2", "O2", "H2O", "H", "O", "OH", "HO2", "H2O2", "N2"]
+
+
+@pytest.fixture(scope="module")
+def h2oni(fixtures_dir):
+    th = br.create_thermo(GASPHASE, f"{fixtures_dir}/therm.dat")
+    sm = compile_mech(f"{fixtures_dir}/h2oni.xml", th, GASPHASE)
+    return th, sm
+
+
+def test_parse_features(h2oni):
+    th, sm = h2oni
+    assert sm.species == ("(NI)", "H(NI)", "O(NI)", "OH(NI)", "H2O(NI)")
+    assert sm.n_reactions == 7
+    # 3-number stick entry: s0 beta Ea(kJ/mol -> J/mol)
+    np.testing.assert_allclose(np.asarray(sm.stick_s0)[:3], [1e-2, 2e-2, 1e-1])
+    assert float(sm.beta[1]) == 0.5
+    assert float(sm.Ea[1]) == pytest.approx(10.0e3)
+    # mwc applies to stick id 3 only
+    np.testing.assert_allclose(np.asarray(sm.mwc), [0, 0, 1, 0, 0, 0, 0])
+    # <order id="4">h(ni)=1.5</order> overrides the exponent, not stoichiometry
+    h_idx = sm.species.index("H(NI)")
+    assert float(sm.expo_surf[3, h_idx]) == 1.5
+    assert float(sm.nu_f_surf[3, h_idx]) == 2.0
+    assert sm.int_expo is False  # fractional exponent forces the log/exp path
+    # <coverage id="5 6">o(ni)=-30</coverage> in kJ/mol
+    o_idx = sm.species.index("O(NI)")
+    np.testing.assert_allclose(
+        np.asarray(sm.cov_eps)[:, o_idx], [0, 0, 0, 0, -30e3, -30e3, 0])
+
+
+def test_hand_computed_rates(h2oni):
+    """Every reaction's rate of progress vs closed-form hand arithmetic."""
+    th, sm = h2oni
+    T, p = 900.0, 1.2e5
+    x = np.zeros(len(GASPHASE))
+    x[GASPHASE.index("H2")] = 0.3
+    x[GASPHASE.index("O2")] = 0.2
+    x[GASPHASE.index("H2O")] = 0.1
+    x[GASPHASE.index("N2")] = 0.4
+    theta = np.array([0.4, 0.2, 0.2, 0.1, 0.1])  # (ni) h o oh h2o
+
+    q = np.asarray(surface_kinetics.reaction_rates(
+        T, p, jnp.asarray(x), jnp.asarray(theta), sm))
+
+    c = x * p / (R * T) * 1e-6                  # mol/cm^3
+    molwt = np.asarray(th.molwt) * 1e3          # g/mol
+    gamma = 2.66e-9                             # mol/cm^2 (fixture site density)
+    R_cgs = R * 1e7
+
+    def flux(M):                                # sqrt(RT/2piM), cm/s
+        return np.sqrt(R_cgs * T / (2 * np.pi * M))
+
+    # 1: plain stick, h2 + 2(ni): s0 * flux * c_H2 * theta_ni^2
+    q1 = 1e-2 * flux(molwt[0]) * c[0] * theta[0] ** 2
+    # 2: 3-number stick: s0 T^0.5-style beta and Ea enter the probability
+    s2 = 2e-2 * np.exp(0.5 * np.log(T) - 10.0e3 / (R * T))
+    q2 = s2 * flux(molwt[1]) * c[1] * theta[0] ** 2
+    # 3: Motz-Wise: s0 -> s0/(1 - s0/2)
+    s3 = 1e-1 / (1.0 - 1e-1 / 2.0)
+    q3 = s3 * flux(molwt[2]) * c[2] * theta[0]
+    # 4: Arrhenius with <order> h(ni)=1.5: k * (Gamma theta_h)^1.5
+    q4 = 2.545e19 * np.exp(-81.21e3 / (R * T)) * (gamma * theta[1]) ** 1.5
+    # 5: coverage-dependent Ea: Ea_eff = 97.9e3 - 30e3 * theta_o
+    k5 = 5.0e22 * np.exp(-(97.90e3 - 30e3 * theta[2]) / (R * T))
+    q5 = k5 * (gamma * theta[2]) * (gamma * theta[1])
+    # 6: same coverage tag on id 6
+    k6 = 3.0e20 * np.exp(-(42.70e3 - 30e3 * theta[2]) / (R * T))
+    q6 = k6 * (gamma * theta[3]) * (gamma * theta[1])
+    # 7: unimolecular desorption
+    q7 = 3.732e12 * np.exp(-60.79e3 / (R * T)) * (gamma * theta[4])
+
+    np.testing.assert_allclose(
+        q, [q1, q2, q3, q4, q5, q6, q7], rtol=1e-12)
+
+
+MECH_TEMPLATE = """ELEMENTS
+H O
+END
+SPECIES
+H2 O2 OH HO2
+END
+REACTIONS {units}
+H2+O2=2OH   1.7E13  0.0  {ea}
+END
+"""
+
+
+@pytest.mark.parametrize("units,ea_text,ea_si", [
+    ("", "47780.", 47780.0 * 4.184),            # CHEMKIN default cal/mol
+    ("CAL/MOLE", "47780.", 47780.0 * 4.184),
+    ("KCAL/MOLE", "47.78", 47.78 * 4184.0),
+    ("JOULES/MOLE", "199911.5", 199911.5),
+    ("KJOULES/MOLE", "199.9115", 199.9115e3),
+    ("KELVINS", "24043.", 24043.0 * R),
+])
+def test_reactions_unit_keywords(tmp_path, units, ea_text, ea_si):
+    """REACTIONS unit keywords rescale Ea (models/gas.py:_energy_factor);
+    asserted through the compiled tensor AND the forward rate constant."""
+    mech = tmp_path / "m.dat"
+    mech.write_text(MECH_TEMPLATE.format(units=units, ea=ea_text))
+    gm = br.compile_gaschemistry(str(mech))
+    assert float(gm.Ea[0]) == pytest.approx(ea_si, rel=1e-12)
+    T = 1100.0
+    conc = jnp.asarray([1.0, 2.0, 0.0, 0.0])    # mol/m^3
+    kf, _tb = forward_rate_constants(T, conc, gm)
+    # bimolecular: A_SI = A_cgs * 1e-6
+    k_hand = 1.7e13 * 1e-6 * np.exp(-ea_si / (R * T))
+    np.testing.assert_allclose(float(kf[0]), k_hand, rtol=1e-12)

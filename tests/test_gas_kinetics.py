@@ -23,9 +23,9 @@ def h2o2_setup(lib_dir):
 
 
 @pytest.fixture(scope="module")
-def gri_setup(lib_dir):
-    gm = compile_gaschemistry(f"{lib_dir}/grimech.dat")
-    th = create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+def gri_setup(gri_lib_dir):
+    gm = compile_gaschemistry(f"{gri_lib_dir}/grimech.dat")
+    th = create_thermo(list(gm.species), f"{gri_lib_dir}/therm.dat")
     return gm, th
 
 
@@ -177,10 +177,10 @@ class TestAnalyticJacobian:
     def test_h2o2(self, lib_dir):
         self._check("h2o2.dat", lib_dir, {"H2": 0.25, "O2": 0.25, "N2": 0.5})
 
-    def test_grimech_with_falloff_and_troe(self, lib_dir):
-        self._check("grimech.dat", lib_dir,
+    def test_grimech_with_falloff_and_troe(self, gri_lib_dir):
+        self._check("grimech.dat", gri_lib_dir,
                     {"CH4": 0.25, "O2": 0.5, "N2": 0.25})
 
-    def test_kc_compat_mode(self, lib_dir):
-        self._check("grimech.dat", lib_dir,
+    def test_kc_compat_mode(self, gri_lib_dir):
+        self._check("grimech.dat", gri_lib_dir,
                     {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, kc_compat=True)

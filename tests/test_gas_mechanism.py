@@ -18,8 +18,8 @@ def h2o2(lib_dir):
 
 
 @pytest.fixture(scope="module")
-def gri(lib_dir):
-    return compile_gaschemistry(f"{lib_dir}/grimech.dat")
+def gri(gri_lib_dir):
+    return compile_gaschemistry(f"{gri_lib_dir}/grimech.dat")
 
 
 def test_h2o2_counts(h2o2):

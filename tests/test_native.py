@@ -29,9 +29,9 @@ def h2o2(lib_dir):
 
 
 @pytest.fixture(scope="module")
-def gri(lib_dir):
-    gm = br.compile_gaschemistry(f"{lib_dir}/grimech.dat")
-    th = br.create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+def gri(gri_lib_dir):
+    gm = br.compile_gaschemistry(f"{gri_lib_dir}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{gri_lib_dir}/therm.dat")
     return gm, th
 
 
@@ -161,11 +161,11 @@ class TestNativeSurface:
     and the all-native surf/gas+surf solve path (backend="cpu")."""
 
     @pytest.fixture(scope="class")
-    def surf(self, lib_dir):
+    def surf(self, reference_dir, lib_dir):
         from batchreactor_tpu.io.config import input_data
         from batchreactor_tpu.api import Chemistry
 
-        id_ = input_data("/root/reference/test/batch_gas_and_surf/batch.xml",
+        id_ = input_data(str(reference_dir / "test/batch_gas_and_surf/batch.xml"),
                          lib_dir, Chemistry(surfchem=True, gaschem=True))
         return id_
 

@@ -24,19 +24,19 @@ GOLD = "/root/reference/test/batch_gas_and_surf"
 
 
 @pytest.fixture(scope="module")
-def setup(lib_dir):
-    gm = br.compile_gaschemistry(f"{lib_dir}/grimech.dat")
-    th = br.create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
-    sm = compile_mech(f"{lib_dir}/ch4ni.xml", th, list(gm.species))
+def setup(gri_lib_dir):
+    gm = br.compile_gaschemistry(f"{gri_lib_dir}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{gri_lib_dir}/therm.dat")
+    sm = compile_mech(f"{gri_lib_dir}/ch4ni.xml", th, list(gm.species))
     return gm, th, sm
 
 
 @pytest.fixture(scope="module")
-def surf_only(lib_dir):
+def surf_only(gri_lib_dir):
     """batch_surf config: 7 gas species listed in the XML, no gas mechanism."""
     gasphase = ["CH4", "H2O", "H2", "CO", "CO2", "O2", "N2"]
-    th = br.create_thermo(gasphase, f"{lib_dir}/therm.dat")
-    sm = compile_mech(f"{lib_dir}/ch4ni.xml", th, gasphase)
+    th = br.create_thermo(gasphase, f"{gri_lib_dir}/therm.dat")
+    sm = compile_mech(f"{gri_lib_dir}/ch4ni.xml", th, gasphase)
     return th, sm
 
 

@@ -20,9 +20,15 @@ def therm(lib_dir):
     return f"{lib_dir}/therm.dat"
 
 
-def test_parse_all_entries(therm):
-    entries = parse_thermo_entries(therm)
+def test_parse_all_entries(gri_lib_dir):
+    entries = parse_thermo_entries(f"{gri_lib_dir}/therm.dat")
     assert len(entries) == 53  # GRI-Mech 3.0 thermo (SURVEY.md §6)
+    assert "CH2(S)" in entries and "AR" in entries
+
+
+def test_parse_vendored_fixture(fixtures_dir):
+    entries = parse_thermo_entries(f"{fixtures_dir}/therm.dat")
+    assert len(entries) == 14
     assert "CH2(S)" in entries and "AR" in entries
 
 

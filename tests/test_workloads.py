@@ -41,10 +41,10 @@ def h2o2(lib_dir):
 
 
 @pytest.fixture(scope="module")
-def ch4ni(lib_dir):
+def ch4ni(gri_lib_dir):
     gasphase = ["CH4", "H2O", "H2", "CO", "CO2", "O2", "N2"]
-    th = br.create_thermo(gasphase, f"{lib_dir}/therm.dat")
-    sm = compile_mech(f"{lib_dir}/ch4ni.xml", th, gasphase)
+    th = br.create_thermo(gasphase, f"{gri_lib_dir}/therm.dat")
+    sm = compile_mech(f"{gri_lib_dir}/ch4ni.xml", th, gasphase)
     return th, sm
 
 
