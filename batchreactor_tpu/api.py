@@ -103,6 +103,23 @@ def _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk):
     raise ValueError("at least one of surfchem/gaschem/userchem required")
 
 
+@functools.lru_cache(maxsize=64)
+def _segmented_builder(mode, udf, kc_compat, asv_quirk):
+    """Builder for the segmented sweep's bundle mode: mechanism tensors
+    enter the compiled program as traced operands (exactly like the
+    monolithic :func:`_solve`), so repeated file-driven runs with freshly
+    parsed same-shaped mechanisms reuse one executable.  The lru key is the
+    static chemistry config, not object ids — bounded and leak-free."""
+
+    def build(bundle):
+        gm, sm, thermo = bundle
+        rhs = _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk)
+        jacf = make_gas_jac(gm, thermo, kc_compat) if mode == "gas" else None
+        return rhs, jacf
+
+    return build
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("mode", "udf", "kc_compat", "asv_quirk", "n_save",
@@ -155,10 +172,15 @@ def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
 
 
 def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
-               atol, n_save, max_steps, kc_compat, asv_quirk):
+               atol, n_save, max_steps, kc_compat, asv_quirk,
+               segmented=None):
     """Dispatch one solve to the requested backend and normalize the result:
     returns (status_str, t_end, y_end, ts, ys, truncated, n_acc, n_rej)
-    with ts/ys the saved trajectory *including* the initial row."""
+    with ts/ys the saved trajectory *including* the initial row.
+
+    ``segmented=None`` auto-selects: accelerators run the solve as bounded
+    device launches (segments) with the trajectory drained to host between
+    them; CPU runs one monolithic while_loop."""
     if backend == "cpu":
         res = _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg,
                             rtol, atol, n_save, max_steps, kc_compat,
@@ -173,9 +195,33 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
                 res.n_accepted, res.n_rejected)
     if backend != "jax":
         raise ValueError(f"unknown backend {backend!r}; use 'jax' or 'cpu'")
-    res = _solve(mode, udf, gm, sm, thermo, y0,
-                 jnp.asarray(t0), jnp.asarray(t1), cfg,
-                 rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
+    if segmented is None:
+        segmented = jax.default_backend() != "cpu"
+    if segmented:
+        # bounded device launches: a monolithic GRI-scale while_loop can run
+        # for minutes and trip RPC/watchdog limits on tunneled TPU runtimes;
+        # the trajectory drains to host between segments, so XML runs with
+        # default n_save stay safe on accelerators
+        from .parallel.sweep import ensemble_solve_segmented
+
+        builder = _segmented_builder(mode, udf, kc_compat, asv_quirk)
+        # honor small max_steps budgets exactly; larger ones may overshoot
+        # by < seg_steps attempts (the per-segment budget is compiled in)
+        seg_steps = min(512, int(max_steps))
+        resb = ensemble_solve_segmented(
+            builder, jnp.asarray(y0)[None, :], float(t0), float(t1),
+            jax.tree.map(lambda v: jnp.asarray(v)[None], cfg),
+            rtol=rtol, atol=atol, n_save=n_save,
+            segment_steps=seg_steps,
+            max_segments=max(1, -(-int(max_steps) // seg_steps)),
+            rhs_bundle=(gm, sm, thermo))
+        res = jax.tree.map(
+            lambda x: x[0] if hasattr(x, "ndim") and x.ndim >= 1 else x,
+            resb)
+    else:
+        res = _solve(mode, udf, gm, sm, thermo, y0,
+                     jnp.asarray(t0), jnp.asarray(t1), cfg,
+                     rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
     ts, ys, truncated = trim_trajectory(float(t0), y0, res)
     return (_STATUS.get(int(res.status), "Failure"), float(res.t),
             np.asarray(res.y), ts, ys, truncated, int(res.n_accepted),
@@ -195,7 +241,8 @@ def _mode(chem):
 
 
 def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
-                     max_steps, kc_compat, asv_quirk, verbose, backend):
+                     max_steps, kc_compat, asv_quirk, verbose, backend,
+                     segmented=None):
     """Core driver: parse XML -> build RHS -> solve -> write profiles
     (reference :152-217)."""
     import sys
@@ -218,7 +265,8 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
 
     status, t_end, _, ts, ys, truncated, n_acc, n_rej = _run_solve(
         backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
-        0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
+        0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
+        segmented=segmented)
     if truncated:
         print(f"warning: trajectory buffer full "
               f"({n_acc} accepted steps > n_save={n_save}); "
@@ -237,7 +285,7 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
 
 def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
                       rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-                      backend):
+                      backend, segmented=None):
     """Dict-in/dict-out API (reference :86-147): no files; returns
     ``(accepted_times, {species: final mole fraction})``.
 
@@ -269,7 +317,8 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
            "Asv": jnp.asarray(Asv, dtype=jnp.float64)}
     status, t_end, y_end, ts, _, _, _, _ = _run_solve(
         backend, mode, None, gm, sm, thermo_obj, y0, 0.0, float(time), cfg,
-        rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
+        rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
+        segmented=segmented)
     if status != "Success":
         # fail loudly: a partial-integration composition is worse than an
         # error for reactor-network callers
@@ -416,7 +465,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   Asv=1.0, chem=None, thermo_obj=None, md=None,
                   rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,
                   kc_compat=False, asv_quirk=True, verbose=False,
-                  backend="jax"):
+                  backend="jax", segmented=None):
     """Simulate an isothermal constant-volume batch reactor (three forms).
 
     Form 1 — file-driven:   ``batch_reactor(input_file, lib_dir,
@@ -429,10 +478,12 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
 
     Extra (TPU-native) knobs beyond the reference: ``rtol/atol`` (defaults =
     the reference's CVODE settings), ``kc_compat``/``asv_quirk`` parity
-    switches (PARITY.md), ``n_save`` trajectory buffer rows, and
+    switches (PARITY.md), ``n_save`` trajectory buffer rows,
     ``backend`` — "jax" (default: jitted SDIRK4 on whatever jax.devices()
     provides) or "cpu" (the native C++ CVODE-class BDF runtime,
-    native/br_native.cpp — the SUNDIALS-role component).
+    native/br_native.cpp — the SUNDIALS-role component) — and ``segmented``
+    (None = auto: accelerators integrate in bounded device launches with
+    the trajectory drained to host between segments; identical numerics).
     """
     if args and isinstance(args[0], dict):
         if len(args) != 4:
@@ -445,14 +496,15 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], args[2], args[3], Asv=Asv, chem=chem,
             thermo_obj=thermo_obj, md=md, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
-            asv_quirk=asv_quirk, backend=backend)
+            asv_quirk=asv_quirk, backend=backend, segmented=segmented)
 
     if len(args) == 3 and callable(args[2]):
         chem = Chemistry(False, False, True, args[2])
         return _file_driven_run(
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
-            asv_quirk=asv_quirk, verbose=verbose, backend=backend)
+            asv_quirk=asv_quirk, verbose=verbose, backend=backend,
+            segmented=segmented)
 
     if len(args) == 2:
         if chem is None:
@@ -460,6 +512,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
         return _file_driven_run(
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
-            asv_quirk=asv_quirk, verbose=verbose, backend=backend)
+            asv_quirk=asv_quirk, verbose=verbose, backend=backend,
+            segmented=segmented)
 
     raise TypeError(f"unrecognized batch_reactor argument pattern: {args!r}")

@@ -81,12 +81,21 @@ def _troe_F(T, Pr, troe, has_troe, with_grad=False):
     return F, dF_dPr
 
 
-def forward_rate_constants(T, conc, gm, with_grad=False):
+def forward_rate_constants(T, conc, gm, with_grad=False,
+                           falloff_compat=False):
     """Effective forward rate constants (R,) including third-body/falloff.
 
     Returns (kf, tb_factor); with ``with_grad=True`` additionally
     (dkf/dcM, dtb/dcM) for the analytic Jacobian (cM = eff @ conc, so
     d/dconc_k = d/dcM * eff_k).
+
+    ``falloff_compat=True`` reproduces the reference stack's falloff
+    convention (resolved round 2 against the full golden trajectory — see
+    PARITY.md): the blended falloff rate k_inf*L*F is additionally
+    multiplied by the collider concentration *in mol/cm^3* (cM * 1e-6),
+    i.e. the reference treats ``(+M)`` like a plain ``+M`` third body in
+    its cgs rate space.  Physical CHEMKIN-II/TROE semantics (no factor)
+    is the default.
     """
     k_inf = _arrhenius(T, gm.log_A, gm.beta, gm.Ea)
     cM = gm.eff @ conc  # (R,)
@@ -94,20 +103,28 @@ def forward_rate_constants(T, conc, gm, with_grad=False):
     # falloff blending
     k0 = _arrhenius(T, gm.log_A0, gm.beta0, gm.Ea0)
     ratio = k0 / jnp.maximum(k_inf, _TINY)
-    Pr = ratio * jnp.maximum(cM, 0.0)
+    cM_pos = jnp.maximum(cM, 0.0)
+    Pr = ratio * cM_pos
     L = Pr / (1.0 + Pr)
     tb_factor = jnp.where(gm.has_tb > 0, cM, 1.0)
+    fc = cM_pos * 1e-6 if falloff_compat else 1.0
     if not with_grad:
         F = _troe_F(T, Pr, gm.troe, gm.has_troe)
-        kf = jnp.where(gm.has_falloff > 0, k_inf * L * F, k_inf)
+        kf = jnp.where(gm.has_falloff > 0, k_inf * L * F * fc, k_inf)
         return kf, tb_factor
     F, dF_dPr = _troe_F(T, Pr, gm.troe, gm.has_troe, with_grad=True)
-    kf = jnp.where(gm.has_falloff > 0, k_inf * L * F, k_inf)
+    kf = jnp.where(gm.has_falloff > 0, k_inf * L * F * fc, k_inf)
     dkf_dPr = k_inf * (F / ((1.0 + Pr) * (1.0 + Pr)) + L * dF_dPr)
-    # the forward path clamps Pr at cM=0, so the true derivative is 0 for
-    # transiently negative Newton iterates — match it exactly
-    dkf_dcM = jnp.where((gm.has_falloff > 0) & (cM > 0.0),
-                        dkf_dPr * ratio, 0.0)
+    # the forward path clamps Pr (and fc) at cM=0, so the true derivative is
+    # 0 for transiently negative Newton iterates — match it exactly
+    if falloff_compat:
+        # d/dcM [kinf L F cM 1e-6] = (dkf/dPr ratio cM + kinf L F) 1e-6
+        dkf_dcM = jnp.where(
+            (gm.has_falloff > 0) & (cM > 0.0),
+            (dkf_dPr * ratio * cM_pos + k_inf * L * F) * 1e-6, 0.0)
+    else:
+        dkf_dcM = jnp.where((gm.has_falloff > 0) & (cM > 0.0),
+                            dkf_dPr * ratio, 0.0)
     dtb_dcM = jnp.where(gm.has_tb > 0, 1.0, 0.0)
     return kf, tb_factor, dkf_dcM, dtb_dcM
 
@@ -117,27 +134,36 @@ def equilibrium_constants(T, gm, thermo, kc_compat=False):
 
     ``kc_compat=True`` reproduces the reference stack's equilibrium-constant
     convention, reverse-engineered from the committed golden trajectory
-    (/root/reference/test/batch_gas_and_surf/gas_profile.csv, row-2 finite
-    differences): for non-falloff reversible reactions its effective Kc
-    equals the physical Kc times (1e6)^dn with p0 = 1 bar — consistent with a
-    cgs/SI conversion applied with inverted sign in GasphaseReactions
-    (exact on the O2+M->2O+M reverse channel); falloff reactions do not carry
-    the factor.  Physically correct SI (p0 = 1 atm) is the default."""
+    (/root/reference/test/batch_gas_and_surf/gas_profile.csv): the effective
+    Kc equals the physical Kc times (1e6)^dn with p0 = 1 bar — a cgs
+    concentration standard state (mol/cm^3) applied uniformly to every
+    reversible reaction, consistent with GasphaseReactions computing reverse
+    rates entirely in cgs space.  (Round 1 had inferred a falloff exclusion
+    from t=0 data alone; the full-trajectory fit resolved it — falloff rows
+    carry the factor too, paired with the falloff_compat forward convention.
+    See PARITY.md.)  Physically correct SI (p0 = 1 atm) is the default."""
     g = gibbs_over_RT(T, thermo)  # (S,)
     dnu = gm.nu_r - gm.nu_f
     dG = dnu @ g  # (R,) Delta G / RT
     dn = jnp.sum(dnu, axis=1)
     if kc_compat:
-        log_c0 = jnp.log(1e5 / (R * T)) + jnp.log(1e6) * (1.0 - gm.has_falloff)
+        log_c0 = jnp.log(1e5 / (R * T)) + jnp.log(1e6)
     else:
         log_c0 = jnp.log(P_ATM / (R * T))
     log_Kc = -dG + dn * log_c0
     return log_Kc
 
 
-def reaction_rates(T, conc, gm, thermo, kc_compat=False):
-    """Net rate of progress q_i (R,) [mol/m^3/s]."""
-    kf, tb = forward_rate_constants(T, conc, gm)
+def reaction_rates(T, conc, gm, thermo, kc_compat=False, falloff_compat=None):
+    """Net rate of progress q_i (R,) [mol/m^3/s].
+
+    ``falloff_compat=None`` follows ``kc_compat``: the two quirks travel
+    together in the reference stack, so ``kc_compat=True`` is the full
+    reference-parity mode (PARITY.md)."""
+    if falloff_compat is None:
+        falloff_compat = kc_compat
+    kf, tb = forward_rate_constants(T, conc, gm,
+                                    falloff_compat=falloff_compat)
     log_Kc = equilibrium_constants(T, gm, thermo, kc_compat)
     # kr = kf/Kc evaluated as kf * exp(-ln Kc); clip keeps the unreachable
     # far-from-equilibrium extreme finite without changing reachable physics
@@ -147,9 +173,10 @@ def reaction_rates(T, conc, gm, thermo, kc_compat=False):
     return (rf - rr) * tb
 
 
-def production_rates(T, conc, gm, thermo, kc_compat=False):
+def production_rates(T, conc, gm, thermo, kc_compat=False,
+                     falloff_compat=None):
     """Species molar production rates wdot (S,) [mol/m^3/s]."""
-    q = reaction_rates(T, conc, gm, thermo, kc_compat)
+    q = reaction_rates(T, conc, gm, thermo, kc_compat, falloff_compat)
     return (gm.nu_r - gm.nu_f).T @ q
 
 
@@ -187,7 +214,8 @@ def _stoich_prod_and_grad(conc, nu, int_stoich):
     return total[:, 0], d * E
 
 
-def production_rates_and_jac(T, conc, gm, thermo, kc_compat=False):
+def production_rates_and_jac(T, conc, gm, thermo, kc_compat=False,
+                             falloff_compat=None):
     """(wdot (S,), dwdot/dconc (S, S)) — analytic, closed form.
 
     ``jax.jacfwd`` through :func:`production_rates` costs S forward passes
@@ -203,8 +231,10 @@ def production_rates_and_jac(T, conc, gm, thermo, kc_compat=False):
       term dF/dPr, so the Jacobian is exact (matches jacfwd to roundoff;
       tests/test_gas_kinetics.py).
     """
-    kf, tb, dkf_dcM, dtb_dcM = forward_rate_constants(T, conc, gm,
-                                                      with_grad=True)
+    if falloff_compat is None:
+        falloff_compat = kc_compat
+    kf, tb, dkf_dcM, dtb_dcM = forward_rate_constants(
+        T, conc, gm, with_grad=True, falloff_compat=falloff_compat)
     log_Kc = equilibrium_constants(T, gm, thermo, kc_compat)
     rKc = gm.rev_mask * jnp.exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
 

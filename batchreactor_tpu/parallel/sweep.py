@@ -135,7 +135,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              max_segments=10_000, mesh=None, axis="batch",
                              progress=None, rtol=1e-6, atol=1e-10,
                              linsolve="auto", jac=None, observer=None,
-                             observer_init=None, dt_min_factor=1e-22):
+                             observer_init=None, dt_min_factor=1e-22,
+                             n_save=0, rhs_bundle=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -148,17 +149,39 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     and costs one dispatch per segment.  State carried between segments:
     per-lane (t, y, next step size h, observer fold); a lane that fails
     terminally (DT_UNDERFLOW) is parked so it does not burn segment budget
-    re-failing.  Trajectory buffers are not supported here (``n_save``
-    merging across segments is not implemented) — use the observer for
-    streaming reductions, or unsegmented ensemble_solve for trajectories.
+    re-failing.
+
+    ``n_save`` > 0 records up to that many accepted rows per lane, exactly
+    like the unsegmented path (first-n_save semantics), but the *device*
+    buffer is only ``min(n_save, segment_steps)`` rows — segments drain to a
+    host-side (B, n_save) array between launches.  This is how file-driven
+    XML runs get their profile trajectories on accelerators without the
+    monolithic launch (reference streaming callback analog,
+    /root/reference/src/BatchReactor.jl:208,383-402).
+
+    With ``rhs_bundle``, ``rhs`` is instead a *builder*:
+    ``rhs(bundle) -> (rhs_fn, jac_fn)``, and the bundle pytree (mechanism
+    tensors) enters the compiled program as a traced operand.  The compile
+    cache then keys on the builder's identity, so repeated calls with
+    fresh same-shaped bundles (e.g. re-parsed mechanisms in file-driven
+    runs) reuse one executable instead of recompiling.  ``jac`` is ignored
+    in this form.
     """
     y0s = jnp.asarray(y0s)
     B = y0s.shape[0]
+    # a segment can accept at most segment_steps rows, so this buffer never
+    # drops a row the host still has capacity for
+    seg_save = min(int(n_save), int(segment_steps)) if n_save else 0
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
-                                      dt_min_factor, linsolve, jac, observer)
+                                      dt_min_factor, linsolve,
+                                      None if rhs_bundle is not None else jac,
+                                      observer, seg_save,
+                                      rhs_bundle is not None)
+    bundle_arg = rhs_bundle if rhs_bundle is not None else 0.0
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     t = jnp.full((B,), t0, dtype=y0s.dtype)
     h = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: heuristic first step
+    e = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: fresh PI controller
     y = y0s
     if observer is not None:
         obs = jax.tree.map(
@@ -172,6 +195,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         y = jax.device_put(y, spec)
         t = jax.device_put(t, spec)
         h = jax.device_put(h, spec)
+        e = jax.device_put(e, spec)
         cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
         obs = jax.tree.map(lambda x: jax.device_put(x, spec), obs)
 
@@ -179,14 +203,29 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     final_t = np.full((B,), np.nan)
     n_acc = np.zeros((B,), dtype=np.int64)
     n_rej = np.zeros((B,), dtype=np.int64)
+    if n_save:
+        all_ts = np.full((B, int(n_save)), np.inf)
+        all_ys = np.zeros((B, int(n_save)) + y0s.shape[1:])
+        saved = np.zeros((B,), dtype=np.int64)
     for seg in range(max_segments):
-        res = jitted(y, t, t1, cfgs, h, obs)
+        res = jitted(bundle_arg, y, t, t1, cfgs, h, e, obs)
         status = np.asarray(res.status)
         # only lanes still live this segment contribute step counts: parked
         # lanes re-enter as zero-span solves that burn one rejected attempt
         running = final_status == int(sdirk.RUNNING)
         n_acc += np.where(running, np.asarray(res.n_accepted), 0)
         n_rej += np.where(running, np.asarray(res.n_rejected), 0)
+        if n_save:
+            # drain this segment's device buffer into the host trajectory
+            seg_n = np.asarray(res.n_saved)
+            seg_ts = np.asarray(res.ts)
+            seg_ys = np.asarray(res.ys)
+            for b in np.nonzero(running & (seg_n > 0))[0]:
+                take = min(int(seg_n[b]), int(n_save) - int(saved[b]))
+                if take > 0:
+                    all_ts[b, saved[b]:saved[b] + take] = seg_ts[b, :take]
+                    all_ys[b, saved[b]:saved[b] + take] = seg_ys[b, :take]
+                    saved[b] += take
         terminal = status != int(sdirk.MAX_STEPS_REACHED)
         newly_terminal = running & terminal
         final_status = np.where(newly_terminal, status, final_status)
@@ -198,9 +237,10 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         t = jnp.where(parked, t1, res.t)
         y = res.y
         # lanes parked *before* this segment ran a zero-span solve whose
-        # res.h is NaN — keep their last live h; lanes that terminated this
-        # segment take res.h (their final adapted step size)
+        # res.h is NaN — keep their last live h (and PI memory); lanes that
+        # terminated this segment take res.h (their final adapted step size)
         h = jnp.where(jnp.asarray(~running), h, res.h)
+        e = jnp.where(jnp.asarray(~running), e, res.err_prev)
         if observer is not None:
             obs = res.observed
         done = not bool(np.any(final_status == int(sdirk.RUNNING)))
@@ -216,30 +256,42 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     # lanes that never terminated (budget exhausted) report their current t
     final_t = np.where(np.isnan(final_t), np.asarray(res.t), final_t)
 
+    if n_save:
+        ts_out = jnp.asarray(all_ts, dtype=y0s.dtype)
+        ys_out = jnp.asarray(all_ys, dtype=y0s.dtype)
+        n_saved_out = jnp.asarray(saved)
+    else:
+        ts_out, ys_out, n_saved_out = res.ts, res.ys, res.n_saved
     return sdirk.SolveResult(
         t=jnp.asarray(final_t, dtype=y0s.dtype), y=y,
         status=jnp.asarray(final_status),
         n_accepted=jnp.asarray(n_acc), n_rejected=jnp.asarray(n_rej),
-        ts=res.ts, ys=res.ys, n_saved=res.n_saved, h=h,
+        ts=ts_out, ys=ys_out, n_saved=n_saved_out, h=h,
         observed=obs if observer is not None else None)
 
 
 @functools.lru_cache(maxsize=64)
 def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
-                             linsolve, jac, observer):
+                             linsolve, jac, observer, n_save=0,
+                             bundle_mode=False):
     """Compiled per-segment batched solve: per-lane t0 and carried-in step
     size are traced operands (vmap axis 0), so every segment reuses one
-    executable."""
+    executable.  In ``bundle_mode`` the first operand is a mechanism-bundle
+    pytree (broadcast, not vmapped) and ``rhs`` is a builder."""
 
-    def one(y0, t0, t1, cfg, h0, obs0):
+    def one(bundle, y0, t0, t1, cfg, h0, e0, obs0):
+        if bundle_mode:
+            rhs_fn, jac_fn = rhs(bundle)
+        else:
+            rhs_fn, jac_fn = rhs, jac
         return sdirk.solve(
-            rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol,
-            max_steps=segment_steps, n_save=0, dt0=h0,
-            dt_min_factor=dt_min_factor, linsolve=linsolve, jac=jac,
+            rhs_fn, y0, t0, t1, cfg, rtol=rtol, atol=atol,
+            max_steps=segment_steps, n_save=n_save, dt0=h0, err0=e0,
+            dt_min_factor=dt_min_factor, linsolve=linsolve, jac=jac_fn,
             observer=observer,
             observer_init=obs0 if observer is not None else None)
 
-    return jax.jit(jax.vmap(one, in_axes=(0, 0, None, 0, 0, 0)))
+    return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0)))
 
 
 def sweep_report(res, cfgs=None):

@@ -126,6 +126,11 @@ void br_gas_rhs(const BrGasMech* m, double T, const double* y, double* dy) {
         F = std::exp(kLog10 * log_fc / (1.0 + f1 * f1));
       }
       kf = kf * (Pr / (1.0 + Pr)) * F;
+      // reference-parity falloff (PARITY.md, resolved round 2): the blended
+      // rate is additionally multiplied by the collider concentration in
+      // mol/cm^3 — the reference treats (+M) like a plain +M third body in
+      // its cgs rate space
+      if (m->kc_compat) kf *= (cM > 0.0 ? cM : 0.0) * 1e-6;
     }
     const double tb = m->has_tb[i] > 0 ? cM : 1.0;
 
@@ -137,8 +142,7 @@ void br_gas_rhs(const BrGasMech* m, double T, const double* y, double* dy) {
       dn += d;
     }
     const double log_c0 =
-        m->kc_compat ? log_c0_ref + std::log(1e6) * (falloff ? 0.0 : 1.0)
-                     : log_c0_phys;
+        m->kc_compat ? log_c0_ref + std::log(1e6) : log_c0_phys;
     const double log_Kc = -dG + dn * log_c0;
     const double kr =
         m->rev_mask[i] * kf * std::exp(clamp(-log_Kc, -kExpMax, kExpMax));

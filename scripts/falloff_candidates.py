@@ -1,0 +1,91 @@
+"""Enumerate falloff-convention candidates against the two golden scalar
+constraints:
+  (A) reverse of H+CH3(+M)<=>CH4(+M) at 1173 K: k_rev = 1.1686e-3 1/s
+      (pure-H-production channel at t=0, golden row 2)
+  (B) forward of 2CH3(+M)<=>C2H6(+M) at 1173 K: k_fwd = 79.6 m^3/mol/s
+      (golden C2H6 ~ k t^3 growth, method calibrated on CH3+O2 exact)
+"""
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+import batchreactor_tpu as br
+from batchreactor_tpu.ops import gas_kinetics as gk
+from batchreactor_tpu.ops.thermo import gibbs_over_RT
+from batchreactor_tpu.utils.constants import R
+
+LIB = "/root/reference/test/lib"
+gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+sp = list(gm.species)
+eqs = list(gm.equations)
+i_ch4 = next(i for i, e in enumerate(eqs) if "H+CH3(+M)" in e)
+i_c2h6 = next(i for i, e in enumerate(eqs) if "C2H6" in e and "2CH3" in e)
+print("rxns:", eqs[i_ch4], "|", eqs[i_c2h6])
+
+T = 1173.0
+x = np.zeros(len(sp)); x[sp.index("CH4")], x[sp.index("O2")], x[sp.index("N2")] = .25, .5, .25
+conc = jnp.asarray(x * 1e5 / (R * T))
+kinf = np.asarray(gk._arrhenius(T, gm.log_A, gm.beta, gm.Ea))
+k0 = np.asarray(gk._arrhenius(T, gm.log_A0, gm.beta0, gm.Ea0))
+cM = np.asarray(gm.eff @ conc)          # SI mol/m^3 incl. efficiencies
+cMc = cM * 1e-6                          # "cgs-valued" collider conc
+Pr = k0 / np.maximum(kinf, 1e-300) * cM
+L = Pr / (1 + Pr)
+F = np.asarray(gk._troe_F(jnp.asarray(T), jnp.asarray(Pr), gm.troe, gm.has_troe))
+# Pr variants
+Pr_cgs = k0 / np.maximum(kinf, 1e-300) * cMc    # cM mistakenly in mol/cm3
+L_cgs = Pr_cgs / (1 + Pr_cgs)
+F_cgs = np.asarray(gk._troe_F(jnp.asarray(T), jnp.asarray(Pr_cgs), gm.troe, gm.has_troe))
+
+g = np.asarray(gibbs_over_RT(T, th))
+dnu = np.asarray(gm.nu_r - gm.nu_f)
+dG = dnu @ g
+dn = dnu.sum(axis=1)
+
+KF = {
+  "kinf*L*F(phys)": kinf * L * F,
+  "kinf": kinf,
+  "kinf*L": kinf * L,
+  "kinf*F": kinf * F,
+  "kinf*Lc*Fc": kinf * L_cgs * F_cgs,
+  "kinf*Lc": kinf * L_cgs,
+  "k0*cM*L*F": k0 * cM * L * F,
+  "k0*cMc*L*F": k0 * cMc * L * F,
+  "k0*cM": k0 * cM,
+  "k0*cMc": k0 * cMc,
+  "k0": k0,
+  "kinf*cM*L*F": kinf * cM * L * F,
+  "kinf*cMc*L*F": kinf * cMc * L * F,
+  "kinf*cMc*L": kinf * cMc * L,
+  "kinf*cMc*F": kinf * cMc * F,
+  "kinf*cMc": kinf * cMc,
+  "kinf*cM": kinf * cM,
+}
+lc_atm = np.log(101325.0 / (R * T)); lc_bar = np.log(1e5 / (R * T))
+KC = {
+  "atm(phys)": -dG + dn * lc_atm,
+  "bar": -dG + dn * lc_bar,
+  "bar*1e6(quirk)": -dG + dn * (lc_bar + np.log(1e6)),
+  "bar/1e6": -dG + dn * (lc_bar - np.log(1e6)),
+  "Kp": -dG,
+}
+tA, tB = 1.1686e-3, 79.6
+print(f"\n(B) forward C2H6 target {tB:.4g}; candidate / target ratios:")
+for n, v in KF.items():
+    r = v[i_c2h6] / tB
+    flag = " <== " if 0.97 < r < 1.03 else ""
+    print(f"  {n:>16}: {v[i_c2h6]:.4e}  ratio {r:.4g}{flag}")
+print(f"\n(A) reverse CH4 target {tA:.4g}; ratios for each kf/Kc combo:")
+for nk, v in KF.items():
+    row = []
+    for nc, kc in KC.items():
+        kr = v[i_ch4] * np.exp(-kc[i_ch4])
+        r = kr / tA
+        row.append(f"{nc}:{r:.3g}")
+        if 0.97 < r < 1.03:
+            print(f"  MATCH {nk} / {nc}: k_rev={kr:.4e} ratio {r:.4f}")
+    print(f"  {nk:>16}: " + "  ".join(row))

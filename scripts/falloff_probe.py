@@ -1,0 +1,145 @@
+"""Reconstruct the reference RHS at t=0 from the golden trajectory and rank
+falloff-convention candidates against every active species at once.
+
+Golden: /root/reference/test/batch_gas_and_surf/gas_profile.csv rows 1-2
+(dt = 4.32e-16 s -> finite difference measures the RHS at the initial state
+to ~1e-4 relative).  Known-good conventions (PARITY.md): forward rates,
+third-body, kc_compat reverse for non-falloff.  Unknown: falloff fwd/rev.
+"""
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+import batchreactor_tpu as br
+from batchreactor_tpu.ops import gas_kinetics as gk
+from batchreactor_tpu.ops.thermo import gibbs_over_RT
+from batchreactor_tpu.utils.constants import R
+
+LIB = "/root/reference/test/lib"
+CSV = "/root/reference/test/batch_gas_and_surf/gas_profile.csv"
+
+gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+sp = list(gm.species)
+S = len(sp)
+
+rows = np.loadtxt(CSV, delimiter=",", skiprows=1, max_rows=3)
+hdr = open(CSV).readline().strip().split(",")
+assert hdr[4:] == [s if s != "CH2(S)" else "CH2(S)" for s in sp], "species order"
+T = rows[0, 1]
+molwt = np.asarray(th.molwt)
+
+def row_to_rhok(r):
+    x = r[4:]
+    rho = r[3]
+    wbar = (x * molwt).sum()
+    Y = x * molwt / wbar
+    return rho * Y
+
+r0, r1 = row_to_rhok(rows[0]), row_to_rhok(rows[1])
+dt = rows[1, 0] - rows[0, 0]
+rhs_gold = (r1 - r0) / dt  # kg/m^3/s per species; includes surface terms!
+
+# surface contribution at t=0 (conventions confirmed <0.1%): subtract it
+from batchreactor_tpu.ops import surface_kinetics
+from batchreactor_tpu.models.surface import compile_mech
+sm = compile_mech(f"{LIB}/ch4ni.xml", th, sp)
+x0 = rows[0, 4:]
+p0 = rows[0, 2]
+theta0 = np.asarray(sm.ini_covg)
+sg, ss = surface_kinetics.production_rates(T, p0, jnp.asarray(x0),
+                                           jnp.asarray(theta0), sm)
+rhs_surf = np.asarray(sg) * molwt  # Asv=1
+rhs_gas_gold = rhs_gold - rhs_surf
+
+conc = jnp.asarray(r0 / molwt)  # mol/m^3
+
+# --- candidate machinery ------------------------------------------------
+kinf = np.asarray(gk._arrhenius(T, gm.log_A, gm.beta, gm.Ea))
+k0 = np.asarray(gk._arrhenius(T, gm.log_A0, gm.beta0, gm.Ea0))
+cM = np.asarray(gm.eff @ conc)
+has_fall = np.asarray(gm.has_falloff) > 0
+ratio = k0 / np.maximum(kinf, 1e-300)
+Pr = ratio * np.maximum(cM, 0.0)
+L = Pr / (1 + Pr)
+F = np.asarray(gk._troe_F(jnp.asarray(T), jnp.asarray(Pr), gm.troe, gm.has_troe))
+g = np.asarray(gibbs_over_RT(T, th))
+dnu = np.asarray(gm.nu_r - gm.nu_f)
+dG = dnu @ g
+dn = dnu.sum(axis=1)
+nu_f = np.asarray(gm.nu_f); nu_r = np.asarray(gm.nu_r)
+tb = np.where(np.asarray(gm.has_tb) > 0, cM, 1.0)
+rev = np.asarray(gm.rev_mask) > 0
+concn = np.asarray(conc)
+
+def production(kf_fall, Kc_fall_log):
+    """omega_dot given falloff fwd rate constants + falloff ln Kc."""
+    kf = np.where(has_fall, kf_fall, kinf)
+    # non-falloff ln Kc: kc_compat quirk (confirmed)
+    log_c0 = np.log(1e5 / (R * T)) + np.log(1e6)
+    lKc = -dG + dn * log_c0
+    lKc = np.where(has_fall, Kc_fall_log, lKc)
+    kr = np.where(rev, kf * np.exp(-np.clip(lKc, -690, 690) * 1.0) ** 1.0, 0.0)
+    kr = np.where(rev, kf * np.exp(np.clip(-lKc, -690, 690)), 0.0)
+    def powprod(nu):
+        with np.errstate(divide="ignore"):
+            lp = nu @ np.log(np.maximum(concn, 1e-300))
+        return np.exp(lp)
+    q = tb * (kf * powprod(nu_f) - kr * powprod(nu_r))
+    return dnu.T @ q
+
+# candidate falloff fwd constants
+c0_si = 101325.0 / (R * T)
+cand_kf = {
+    "phys(kinf*L*F)": kinf * L * F,
+    "kinf": kinf,
+    "kinf*F": kinf * F,
+    "kinf*L": kinf * L,
+    "k0": k0,
+    "k0*cM": k0 * cM,
+    "k0*cM*L*F": k0 * cM * L * F,
+    "kinf*cM": kinf * cM,
+    "kinf*cM*L*F": kinf * cM * L * F,
+    "kinf/(1+Pr)*F": kinf / (1 + Pr) * F,
+    "lindemann(noF)": kinf * L,
+}
+# candidate falloff ln Kc
+log_c0_atm = np.log(101325.0 / (R * T))
+log_c0_bar = np.log(1e5 / (R * T))
+cand_kc = {
+    "phys(atm)": -dG + dn * log_c0_atm,
+    "bar": -dG + dn * log_c0_bar,
+    "quirk(bar*1e6)": -dG + dn * (log_c0_bar + np.log(1e6)),
+    "Kp": -dG,
+    "inv_quirk(bar/1e6)": -dG + dn * (log_c0_bar - np.log(1e6)),
+}
+
+mask_active = np.abs(rhs_gas_gold) > 1e-25
+print("species with nonzero golden gas RHS:",
+      [sp[i] for i in np.nonzero(mask_active)[0]])
+
+results = []
+for nk, kf_fall in cand_kf.items():
+    for nc, kc_fall in cand_kc.items():
+        w = production(kf_fall, kc_fall)
+        ours = w * molwt
+        # relative error on active species
+        rel = np.abs(ours[mask_active] - rhs_gas_gold[mask_active]) / np.abs(
+            rhs_gas_gold[mask_active])
+        results.append((float(np.max(rel)), float(np.median(rel)), nk, nc))
+results.sort()
+print(f"{'max_rel':>10} {'med_rel':>10}  kf_falloff / Kc_falloff")
+for mx, med, nk, nc in results[:15]:
+    print(f"{mx:10.3e} {med:10.3e}  {nk} / {nc}")
+
+# detailed per-species for the best
+mx, med, nk, nc = results[0]
+w = production(cand_kf[nk], cand_kc[nc])
+ours = w * molwt
+print(f"\nbest: {nk} / {nc}")
+for i in np.nonzero(mask_active)[0]:
+    print(f"  {sp[i]:>8}: gold {rhs_gas_gold[i]: .4e}  ours {ours[i]: .4e} "
+          f" ratio {ours[i]/rhs_gas_gold[i]: .4f}")

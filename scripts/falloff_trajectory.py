@@ -1,0 +1,103 @@
+"""Integrate the full 10 s batch_gas_and_surf config under candidate falloff
+conventions and score each against all 1919 golden rows."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from scipy.integrate import solve_ivp
+import batchreactor_tpu as br
+from batchreactor_tpu.models.surface import compile_mech
+from batchreactor_tpu.ops import gas_kinetics as gk, surface_kinetics
+from batchreactor_tpu.ops.thermo import gibbs_over_RT
+from batchreactor_tpu.utils.constants import R
+
+LIB = "/root/reference/test/lib"
+GOLD = "/root/reference/test/batch_gas_and_surf"
+gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+sp = list(gm.species)
+sm = compile_mech(f"{LIB}/ch4ni.xml", th, sp)
+molwt = np.asarray(th.molwt)
+T = 1173.0
+
+gold = np.loadtxt(f"{GOLD}/gas_profile.csv", delimiter=",", skiprows=1)
+gcov = np.loadtxt(f"{GOLD}/surface_covg.csv", delimiter=",", skiprows=1)
+
+def make_rhs(falloff_mode):
+    """gas+surf RHS with parameterized falloff: 'phys' | 'cmc' (xL xF xcMcgs)."""
+    def gas_wdot(conc):
+        kinf = gk._arrhenius(T, gm.log_A, gm.beta, gm.Ea)
+        k0 = gk._arrhenius(T, gm.log_A0, gm.beta0, gm.Ea0)
+        cM = gm.eff @ conc
+        Pr = k0 / jnp.maximum(kinf, 1e-300) * jnp.maximum(cM, 0.0)
+        L = Pr / (1 + Pr)
+        F = gk._troe_F(jnp.asarray(T), Pr, gm.troe, gm.has_troe)
+        kf_fall = kinf * L * F
+        if falloff_mode == "cmc":
+            kf_fall = kf_fall * jnp.maximum(cM, 0.0) * 1e-6
+        kf = jnp.where(gm.has_falloff > 0, kf_fall, kinf)
+        tb = jnp.where(gm.has_tb > 0, cM, 1.0)
+        g = gibbs_over_RT(T, th)
+        dnu = gm.nu_r - gm.nu_f
+        dG = dnu @ g
+        dn = dnu.sum(axis=1)
+        lKc = -dG + dn * (jnp.log(1e5 / (R * T)) + jnp.log(1e6))  # quirk
+        kr = jnp.where(gm.rev_mask > 0, kf * jnp.exp(jnp.clip(-lKc, -690, 690)), 0.0)
+        safe = jnp.maximum(conc, 0.0)
+        lg = jnp.log(jnp.maximum(safe, 1e-300))
+        qf = jnp.exp(gm.nu_f @ lg)
+        qr = jnp.exp(gm.nu_r @ lg)
+        q = tb * (kf * qf - kr * qr)
+        return dnu.T @ q
+
+    ng = len(sp)
+    def rhs(t, y):
+        y = jnp.asarray(y)
+        rho_k, theta = y[:ng], y[ng:]
+        rho = jnp.sum(rho_k)
+        Y = rho_k / rho
+        wbar = 1.0 / jnp.sum(Y / th.molwt)
+        x = Y * wbar / th.molwt
+        p = rho * R * T / wbar
+        sg, ss = surface_kinetics.production_rates(T, p, x, theta, sm)
+        conc = rho_k / th.molwt
+        w = gas_wdot(conc)
+        dy = (sg + w) * th.molwt
+        dth = ss * sm.site_coordination / (sm.site_density * 1e4)
+        return jnp.concatenate([dy, dth])
+    return jax.jit(rhs)
+
+x0 = gold[0, 4:]
+rho0 = gold[0, 3]
+wbar0 = (x0 * molwt).sum()
+y0 = np.concatenate([rho0 * x0 * molwt / wbar0, np.asarray(sm.ini_covg)])
+
+sample = np.unique(np.concatenate([
+    np.searchsorted(gold[:, 0], [1e-6, 1e-4, 1e-3, 1e-2, 0.1, 0.5, 1, 2, 4, 6, 8]),
+    [len(gold) - 1]]))
+t_eval = gold[sample, 0]
+
+for mode in ["phys", "cmc"]:
+    f = make_rhs(mode)
+    fn = lambda t, y: np.asarray(f(t, y))
+    t0 = time.time()
+    sol = solve_ivp(fn, (0, 10.0), y0, method="BDF", rtol=1e-8, atol=1e-12,
+                    t_eval=t_eval)
+    print(f"\n=== falloff={mode}: {time.time()-t0:.0f}s, ok={sol.success}")
+    for j, it in enumerate(sample):
+        yk = sol.y[:53, j]
+        x = (yk / molwt) / (yk / molwt).sum()
+        gx = gold[it, 4:]
+        key = [("CH4", None), ("H2O", None), ("CO2", None), ("CO", None),
+               ("H2", None), ("C2H6", None)]
+        line = f"t={gold[it,0]:.3g}: "
+        for name, _ in key:
+            i = sp.index(name)
+            if abs(gx[i]) > 1e-12:
+                line += f"{name} {x[i]/gx[i]:.3f} "
+            else:
+                line += f"{name} ours={x[i]:.1e}|g={gx[i]:.1e} "
+        print(line)

@@ -1,0 +1,52 @@
+"""Measure parity-mode error vs golden over all 1919 rows (native backend)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import batchreactor_tpu as br
+
+GOLD = "/root/reference/test/batch_gas_and_surf"
+t0 = time.time()
+ret = br.batch_reactor("/tmp/golden_run/batch.xml", "/root/reference/test/lib",
+                       gaschem=True, surfchem=True, kc_compat=True,
+                       backend="cpu")
+print("retcode:", ret, f"{time.time()-t0:.1f}s")
+
+def load(p):
+    hdr = open(p).readline().strip().split(",")
+    return hdr, np.loadtxt(p, delimiter=",", skiprows=1)
+
+gh, gold = load(f"{GOLD}/gas_profile.csv")
+oh, ours = load("/tmp/golden_run/gas_profile.csv")
+assert gh == oh
+print(f"golden rows {len(gold)}, ours {len(ours)}")
+tg = gold[:, 0]
+for name in ["CH4", "O2", "H2O", "CO2", "CO", "H2", "N2", "C2H6", "OH", "p", "rho"]:
+    i = gh.index(name)
+    oi = np.interp(tg, ours[:, 0], ours[:, i])
+    d = np.abs(oi - gold[:, i])
+    peak = np.abs(gold[:, i]).max()
+    mask = np.abs(gold[:, i]) > 1e-3 * max(peak, 1e-30)
+    rel = (d[mask] / np.abs(gold[mask, i])).max() if mask.any() else 0.0
+    print(f"{name:>5}: peak {peak:.3e}  max_abs {d.max():.3e} "
+          f" max_rel(>1e-3peak) {rel:.3e}")
+# ignition time: CH4 half-consumption crossing
+ich4 = gh.index("CH4")
+def cross(t, x):
+    j = np.argmax(x < 0.125)
+    return t[j]
+print(f"CH4-half time: gold {cross(tg, gold[:, ich4]):.5e} "
+      f"ours {cross(ours[:, 0], ours[:, ich4]):.5e}")
+ch, covg = load(f"{GOLD}/surface_covg.csv")
+co, covo = load("/tmp/golden_run/surface_covg.csv")
+assert ch == co
+tgc = covg[:, 0]
+worst = 0.0
+for i, name in enumerate(ch[2:], start=2):
+    oi = np.interp(tgc, covo[:, 0], covo[:, i])
+    d = np.abs(oi - covg[:, i]).max()
+    worst = max(worst, d)
+    if d > 1e-3:
+        print(f"covg {name}: max_abs {d:.3e} (peak {np.abs(covg[:, i]).max():.3e})")
+print(f"worst coverage abs err: {worst:.3e}")

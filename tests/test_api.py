@@ -252,3 +252,31 @@ def test_unknown_backend_raises(tmp_path, reference_dir, lib_dir):
     xml = _stage(tmp_path, reference_dir / "test" / "batch_h2o2")
     with pytest.raises(ValueError, match="backend"):
         br.batch_reactor(xml, lib_dir, gaschem=True, backend="gpu")
+
+
+def test_file_driven_segmented_matches_monolithic(tmp_path, reference_dir,
+                                                  lib_dir):
+    """The accelerator path (segmented=True) must reproduce the monolithic
+    run at solver-tolerance level.  (Not byte-identical: the segmented
+    program is a different XLA compilation — vmapped B=1 — whose last-ulp
+    rounding shifts individual accepted steps; the physics contract is
+    tolerance-scale agreement of the trajectory endpoints and a complete,
+    well-formed profile file.)"""
+    (tmp_path / "mono").mkdir()
+    (tmp_path / "seg").mkdir()
+    a = _stage(tmp_path / "mono", reference_dir / "test" / "batch_h2o2")
+    b = _stage(tmp_path / "seg", reference_dir / "test" / "batch_h2o2")
+    assert br.batch_reactor(a, lib_dir, gaschem=True,
+                            segmented=False) == "Success"
+    assert br.batch_reactor(b, lib_dir, gaschem=True,
+                            segmented=True) == "Success"
+    ra = np.loadtxt(tmp_path / "mono" / "gas_profile.csv", delimiter=",",
+                    skiprows=1)
+    rb = np.loadtxt(tmp_path / "seg" / "gas_profile.csv", delimiter=",",
+                    skiprows=1)
+    # same horizon, same initial row, similar resolution
+    np.testing.assert_allclose(rb[0], ra[0], rtol=1e-12)
+    assert ra[-1, 0] == pytest.approx(10.0) == rb[-1, 0]
+    assert abs(len(rb) - len(ra)) < 0.2 * len(ra)
+    # final compositions agree at tolerance scale
+    np.testing.assert_allclose(rb[-1, 1:], ra[-1, 1:], rtol=1e-5, atol=1e-10)

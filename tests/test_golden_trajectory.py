@@ -1,0 +1,159 @@
+"""Full-horizon golden-trajectory regression (VERDICT round-1 item #3).
+
+The reference's only committed numerical oracle is the batch_gas_and_surf
+run: 1919 CVODE-accepted steps over 10 s at reltol 1e-6 / abstol 1e-10
+(/root/reference/test/batch_gas_and_surf/{gas_profile,surface_covg}.csv).
+These tests integrate the same config end-to-end in reference-parity mode
+(``kc_compat=True`` — quirk Kc + falloff-collider convention, PARITY.md)
+and assert quantified bounds against every golden row.
+
+Error structure (measured, scripts/golden_measure.py): the only significant
+deviation is a ~0.8% shift of the ignition-front *time*; pointwise errors
+outside the front window are <7e-4 mole fraction and <2.6e-3 coverage.
+Bounds below carry ~5x margin over the measured values while remaining
+orders of magnitude tighter than any wrong falloff convention (the physical
+TROE convention misses pre-ignition radical pools by 20x-8e4x).
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+import batchreactor_tpu as br
+
+FRONT_LO, FRONT_HI = 0.8, 1.2   # excluded window around the ignition front
+
+
+def _load(path):
+    hdr = open(path).readline().strip().split(",")
+    return hdr, np.loadtxt(path, delimiter=",", skiprows=1)
+
+
+def _crossing(t, x, level):
+    j = int(np.argmax(x < level))
+    return t[j]
+
+
+@pytest.fixture(scope="module")
+def golden_run(tmp_path_factory, reference_dir, lib_dir):
+    """One native-backend 10 s parity run shared by the assertions below
+    (the native BDF is the fast CVODE-role solver; the JAX path is
+    cross-checked against it in test_jax_solver_matches_native_mid_ignition)."""
+    from batchreactor_tpu import native
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    out = tmp_path_factory.mktemp("golden")
+    shutil.copy(reference_dir / "test/batch_gas_and_surf/batch.xml",
+                out / "batch.xml")
+    ret = br.batch_reactor(str(out / "batch.xml"), lib_dir,
+                           gaschem=True, surfchem=True, kc_compat=True,
+                           backend="cpu")
+    assert ret == "Success"
+    gold_dir = reference_dir / "test" / "batch_gas_and_surf"
+    return {
+        "gas_gold": _load(str(gold_dir / "gas_profile.csv")),
+        "gas_ours": _load(str(out / "gas_profile.csv")),
+        "covg_gold": _load(str(gold_dir / "surface_covg.csv")),
+        "covg_ours": _load(str(out / "surface_covg.csv")),
+    }
+
+
+def test_ignition_front_time(golden_run):
+    """CH4 half-consumption instant within 2% of the golden 3.7583e-3 s
+    (measured deviation 0.8%)."""
+    gh, gold = golden_run["gas_gold"]
+    oh, ours = golden_run["gas_ours"]
+    i = gh.index("CH4")
+    t_gold = _crossing(gold[:, 0], gold[:, i], 0.125)
+    t_ours = _crossing(ours[:, 0], ours[:, i], 0.125)
+    assert t_gold == pytest.approx(3.7583e-3, rel=1e-3)  # oracle sanity
+    assert abs(t_ours - t_gold) / t_gold < 0.02
+
+
+def test_gas_profile_all_rows(golden_run):
+    """Every species column, all 1919 golden rows, outside the front window:
+    max abs mole-fraction error < 5e-3 (measured < 7e-4).  Density and
+    pressure tighter still."""
+    gh, gold = golden_run["gas_gold"]
+    oh, ours = golden_run["gas_ours"]
+    assert gh == oh
+    assert len(gold) == 1919  # 1920 lines incl. header (SURVEY.md §6)
+    tg = gold[:, 0]
+    i_ch4 = gh.index("CH4")
+    t_front = _crossing(tg, gold[:, i_ch4], 0.125)
+    outside = (tg < FRONT_LO * t_front) | (tg > FRONT_HI * t_front)
+    # CVODE concentrates ~1/3 of its steps inside the ignition front; the
+    # excluded window covers only that sliver of *time* (0.3% of horizon)
+    assert outside.sum() > 1300
+    for i, name in enumerate(gh):
+        oi = np.interp(tg, ours[:, 0], ours[:, i])
+        d = np.abs(oi - gold[:, i])[outside]
+        if name == "t":
+            continue
+        if name in ("p", "rho", "T"):
+            rel = d / np.abs(gold[outside, i])
+            assert rel.max() < 1e-3, f"{name}: max rel {rel.max():.2e}"
+        else:
+            assert d.max() < 5e-3, f"{name}: max abs {d.max():.2e}"
+
+
+def test_surface_coverages_all_rows(golden_run):
+    """All 13 coverages, all golden rows outside the front window:
+    max abs error < 2e-2 (measured < 2.6e-3)."""
+    ch, covg = golden_run["covg_gold"]
+    co, covo = golden_run["covg_ours"]
+    assert ch == co
+    tg = covg[:, 0]
+    gh, gold = golden_run["gas_gold"]
+    t_front = _crossing(gold[:, 0], gold[:, gh.index("CH4")], 0.125)
+    outside = (tg < FRONT_LO * t_front) | (tg > FRONT_HI * t_front)
+    for i, name in enumerate(ch):
+        if name in ("t", "T"):
+            continue
+        oi = np.interp(tg, covo[:, 0], covo[:, i])
+        d = np.abs(oi - covg[:, i])[outside]
+        assert d.max() < 2e-2, f"{name}: max abs {d.max():.2e}"
+
+
+def test_final_state_all_species(golden_run):
+    """End-of-horizon state (t=10 s): every golden mole fraction above 1e-6
+    matched to 1% relative (trace NOx channels at the 1e-8 level to 10%);
+    equilibrium is convention-sensitive, so this pins Kc handling across the
+    whole mechanism."""
+    gh, gold = golden_run["gas_gold"]
+    oh, ours = golden_run["gas_ours"]
+    for i, name in enumerate(gh):
+        if name == "t":
+            continue
+        g, o = gold[-1, i], ours[-1, i]
+        if abs(g) > 1e-6:
+            assert abs(o - g) / abs(g) < 0.01, f"{name}: {o} vs {g}"
+        elif abs(g) > 1e-8:
+            assert abs(o - g) / abs(g) < 0.10, f"{name}: {o} vs {g}"
+
+
+def test_jax_solver_matches_native_mid_ignition(reference_dir, lib_dir,
+                                                tmp_path):
+    """Cross-solver check in parity mode: the JAX SDIRK4 path reproduces the
+    native BDF mid-ignition state (t=1e-3, pre-front) to 0.5%."""
+    src = (reference_dir / "test/batch_gas_and_surf/batch.xml").read_text()
+    for sub in ("jax", "cpu"):
+        d = tmp_path / sub
+        d.mkdir()
+        (d / "batch.xml").write_text(
+            src.replace("<time>10</time>", "<time>1e-3</time>"))
+    from batchreactor_tpu import native
+    backends = ["jax"] + (["cpu"] if native.available() else [])
+    if len(backends) < 2:
+        pytest.skip("native runtime unavailable")
+    rows = {}
+    for b in backends:
+        ret = br.batch_reactor(str(tmp_path / b / "batch.xml"), lib_dir,
+                               gaschem=True, surfchem=True, kc_compat=True,
+                               backend=b)
+        assert ret == "Success"
+        rows[b] = np.loadtxt(tmp_path / b / "gas_profile.csv",
+                             delimiter=",", skiprows=1)[-1]
+    np.testing.assert_allclose(rows["jax"][1:], rows["cpu"][1:],
+                               rtol=5e-3, atol=1e-9)

@@ -244,3 +244,50 @@ def test_segmented_parks_failed_lanes(h2o2):
     status = np.asarray(res.status)
     assert status[0] == SUCCESS and status[2] == SUCCESS
     assert status[1] == DT_UNDERFLOW
+
+
+def test_segmented_trajectory_matches_unsegmented(h2o2):
+    """n_save under segmentation: per-segment device buffers drained to the
+    host must reproduce the monolithic trajectory row-for-row (same accepted
+    steps — segmentation does not alter step-size control)."""
+    from batchreactor_tpu.parallel import ensemble_solve_segmented
+
+    gm, th, y0 = h2o2
+    rhs = make_gas_rhs(gm, th)
+    B = 3
+    y0s = jnp.broadcast_to(y0, (B, 9))
+    cfgs = {"T": jnp.linspace(1200.0, 1300.0, B)}
+    # both sides use the first-step heuristic (segmented h<=0 carry-in
+    # resolves to the same formula) so accepted steps align exactly
+    full = ensemble_solve(rhs, y0s, 0.0, 2e-4, cfgs, n_save=4096)
+    seg = ensemble_solve_segmented(rhs, y0s, 0.0, 2e-4, cfgs,
+                                   segment_steps=64, n_save=4096)
+    assert np.all(np.asarray(seg.status) == SUCCESS)
+    n_full = np.asarray(full.n_saved)
+    n_seg = np.asarray(seg.n_saved)
+    np.testing.assert_array_equal(n_seg, n_full)
+    for b in range(B):
+        np.testing.assert_allclose(np.asarray(seg.ts)[b, :n_seg[b]],
+                                   np.asarray(full.ts)[b, :n_full[b]],
+                                   rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(seg.ys)[b, :n_seg[b]],
+                                   np.asarray(full.ys)[b, :n_full[b]],
+                                   rtol=1e-9, atol=1e-16)
+
+
+def test_segmented_n_save_saturates(h2o2):
+    """When total accepted steps exceed n_save, the first n_save rows are
+    kept (same semantics as the unsegmented buffer)."""
+    from batchreactor_tpu.parallel import ensemble_solve_segmented
+
+    gm, th, y0 = h2o2
+    rhs = make_gas_rhs(gm, th)
+    y0s = jnp.broadcast_to(y0, (2, 9))
+    cfgs = {"T": jnp.full((2,), 1250.0)}
+    full = ensemble_solve(rhs, y0s, 0.0, 2e-4, cfgs, n_save=40)
+    seg = ensemble_solve_segmented(rhs, y0s, 0.0, 2e-4, cfgs,
+                                   segment_steps=64, n_save=40)
+    assert int(seg.n_accepted[0]) > 40  # actually saturated
+    np.testing.assert_array_equal(np.asarray(seg.n_saved), [40, 40])
+    np.testing.assert_allclose(np.asarray(seg.ts), np.asarray(full.ts),
+                               rtol=1e-12)
