@@ -35,7 +35,8 @@ import numpy as np
 
 from .io.config import input_data, parse_composition_text
 from .io.writers import trim_trajectory, write_profiles
-from .ops.rhs import make_gas_jac, make_gas_rhs, make_surface_rhs, make_udf_rhs
+from .ops.rhs import (make_gas_jac, make_gas_rhs, make_surface_jac,
+                      make_surface_rhs, make_udf_rhs)
 from .solver import sdirk
 from .utils.composition import density, mole_to_mass
 
@@ -103,6 +104,20 @@ def _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk):
     raise ValueError("at least one of surfchem/gaschem/userchem required")
 
 
+def _make_jac(mode, gm, sm, thermo, kc_compat, asv_quirk):
+    """Closed-form Jacobian for every mechanism-driven chemistry mode (gas:
+    ops/rhs.make_gas_jac; surf and gas+surf: ops/rhs.make_surface_jac).
+    Only UDF mode falls back to jacfwd inside the solver — a user source
+    function has no closed form."""
+    if mode == "gas":
+        return make_gas_jac(gm, thermo, kc_compat)
+    if mode in ("surf", "gas+surf"):
+        return make_surface_jac(sm, thermo,
+                                gm=gm if mode == "gas+surf" else None,
+                                asv_quirk=asv_quirk, kc_compat=kc_compat)
+    return None
+
+
 @functools.lru_cache(maxsize=64)
 def _segmented_builder(mode, udf, kc_compat, asv_quirk):
     """Builder for the segmented sweep's bundle mode: mechanism tensors
@@ -114,7 +129,7 @@ def _segmented_builder(mode, udf, kc_compat, asv_quirk):
     def build(bundle):
         gm, sm, thermo = bundle
         rhs = _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk)
-        jacf = make_gas_jac(gm, thermo, kc_compat) if mode == "gas" else None
+        jacf = _make_jac(mode, gm, sm, thermo, kc_compat, asv_quirk)
         return rhs, jacf
 
     return build
@@ -131,9 +146,9 @@ def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
     operands, so repeated calls with any same-shaped mechanism (the
     reactor-network use case) reuse the compiled program."""
     rhs = _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk)
-    # gas-only chemistry has a closed-form Jacobian (ops/rhs.make_gas_jac);
-    # other modes fall back to jacfwd inside the solver
-    jac = make_gas_jac(gm, thermo, kc_compat) if mode == "gas" else None
+    # every mechanism-driven mode has a closed-form Jacobian; only UDF
+    # falls back to jacfwd inside the solver
+    jac = _make_jac(mode, gm, sm, thermo, kc_compat, asv_quirk)
     return sdirk.solve(
         rhs, y0, t0, t1, cfg,
         rtol=rtol, atol=atol, n_save=n_save, max_steps=max_steps, jac=jac,
@@ -351,10 +366,10 @@ def _sweep_fns(mode, md, thermo_obj, kc_compat, asv_quirk, marker_idx,
     hit = _SWEEP_FNS.get(key)
     if hit is not None and hit[0] is md and hit[1] is thermo_obj:
         return hit[2:]
-    rhs = _make_rhs(mode, None, md if mode == "gas" else None,
-                    md if mode == "surf" else None, thermo_obj,
-                    kc_compat, asv_quirk)
-    jac = make_gas_jac(md, thermo_obj, kc_compat) if mode == "gas" else None
+    gm = md if mode == "gas" else None
+    sm = md if mode == "surf" else None
+    rhs = _make_rhs(mode, None, gm, sm, thermo_obj, kc_compat, asv_quirk)
+    jac = _make_jac(mode, gm, sm, thermo_obj, kc_compat, asv_quirk)
     observer = obs0 = None
     if marker_idx is not None:
         observer, obs0 = ignition_observer(marker_idx, mode=ignition_mode)

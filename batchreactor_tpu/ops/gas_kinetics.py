@@ -200,7 +200,11 @@ def _stoich_prod_and_grad(conc, nu, int_stoich):
     else:
         safe_c = jnp.where(conc > _TINY, conc, _TINY)[None, :]
         f = jnp.exp(nu * jnp.log(safe_c))
-        d = nu * f / safe_c
+        # the forward path clamps at _TINY, so jacfwd through it sees a zero
+        # derivative there; match it exactly — the raw nu*f/safe_c quotient
+        # reaches ~1e150 for nu=0.5 at conc=0 and would poison the Newton
+        # matrix (fractional <order> overrides at zero coverage)
+        d = jnp.where(conc[None, :] > _TINY, nu * f / safe_c, 0.0)
     iszero = f == 0.0
     f_safe = jnp.where(iszero, 1.0, f)
     total_nz = jnp.prod(f_safe, axis=1, keepdims=True)      # (R, 1)

@@ -184,3 +184,26 @@ class TestAnalyticJacobian:
     def test_kc_compat_mode(self, gri_lib_dir):
         self._check("grimech.dat", gri_lib_dir,
                     {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, kc_compat=True)
+
+
+def test_frac_stoich_grad_at_zero_conc():
+    """Fractional exponents at clamped (zero) concentration: the derivative
+    must match jacfwd through the clamped forward path (= 0 there), not the
+    raw nu*f/c quotient (~1e150 for nu=0.5 at c=0), which would poison the
+    Newton matrix for mechanisms with fractional <order> overrides."""
+    import jax
+    from batchreactor_tpu.ops.gas_kinetics import (_stoich_prod,
+                                                   _stoich_prod_and_grad)
+
+    nu = jnp.asarray([[0.5, 1.0, 0.0], [1.5, 0.0, 2.0]])
+    conc = jnp.asarray([0.0, 2.0, 3.0])
+    P, dP = _stoich_prod_and_grad(conc, nu, False)
+    assert bool(jnp.all(jnp.isfinite(dP)))
+    J = jax.jacfwd(lambda c: _stoich_prod(c, nu, False))(conc)
+    np.testing.assert_allclose(np.asarray(dP), np.asarray(J),
+                               rtol=1e-12, atol=1e-300)
+    # nonzero entries still exact
+    conc2 = jnp.asarray([0.7, 2.0, 3.0])
+    P2, dP2 = _stoich_prod_and_grad(conc2, nu, False)
+    J2 = jax.jacfwd(lambda c: _stoich_prod(c, nu, False))(conc2)
+    np.testing.assert_allclose(np.asarray(dP2), np.asarray(J2), rtol=1e-12)
