@@ -188,7 +188,7 @@ def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
 
 def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
                atol, n_save, max_steps, kc_compat, asv_quirk,
-               segmented=None):
+               segmented=None, progress=None):
     """Dispatch one solve to the requested backend and normalize the result:
     returns (status_str, t_end, y_end, ts, ys, truncated, n_acc, n_rej)
     with ts/ys the saved trajectory *including* the initial row.
@@ -220,8 +220,6 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
         from .parallel.sweep import ensemble_solve_segmented
 
         builder = _segmented_builder(mode, udf, kc_compat, asv_quirk)
-        # honor small max_steps budgets exactly; larger ones may overshoot
-        # by < seg_steps attempts (the per-segment budget is compiled in)
         seg_steps = min(512, int(max_steps))
         resb = ensemble_solve_segmented(
             builder, jnp.asarray(y0)[None, :], float(t0), float(t1),
@@ -229,7 +227,8 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
             rtol=rtol, atol=atol, n_save=n_save,
             segment_steps=seg_steps,
             max_segments=max(1, -(-int(max_steps) // seg_steps)),
-            rhs_bundle=(gm, sm, thermo))
+            max_attempts=int(max_steps),
+            rhs_bundle=(gm, sm, thermo), progress=progress)
         res = jax.tree.map(
             lambda x: x[0] if hasattr(x, "ndim") and x.ndim >= 1 else x,
             resb)
@@ -278,10 +277,29 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
             species=id_.species, surface_species=surf_species,
         )
 
+    # the reference prints every accepted time to the terminal during the
+    # solve (@printf("%4e\n",t), :401; sample docs/src/index.md:136-155);
+    # segmented accelerator runs print live as each segment drains, other
+    # backends print post-hoc below — same lines either way
+    n_live = 0
+    prog = None
+    if verbose:
+        def prog(p):
+            nonlocal n_live
+            for tv in p.get("drained_ts", ()):
+                print(f"{tv:.4e}")
+            n_live += len(p.get("drained_ts", ()))
+
     status, t_end, _, ts, ys, truncated, n_acc, n_rej = _run_solve(
         backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
         0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-        segmented=segmented)
+        segmented=segmented, progress=prog)
+    if verbose and n_live == 0:
+        # ts[0] is the initial row, not an accepted step; a truncated run
+        # appends a final-state bridge row that is not an accepted step
+        # either (keeps parity with the segmented live path's output)
+        for tv in (ts[1:-1] if truncated else ts[1:]):
+            print(f"{tv:.4e}")
     if truncated:
         print(f"warning: trajectory buffer full "
               f"({n_acc} accepted steps > n_save={n_save}); "
@@ -291,8 +309,6 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
     write_profiles(out_dir, id_.species, ts, ys, id_.T,
                    np.asarray(id_.thermo.molwt), surface_species=surf_species)
     if verbose:
-        # the reference prints every accepted time (:401); one summary line
-        # is kinder to terminals at TPU step counts
         print(f"t = {t_end:.4e} s  "
               f"({n_acc} accepted / {n_rej} rejected steps)")
     return status
@@ -479,7 +495,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
 def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   Asv=1.0, chem=None, thermo_obj=None, md=None,
                   rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,
-                  kc_compat=False, asv_quirk=True, verbose=False,
+                  kc_compat=False, asv_quirk=True, verbose=True,
                   backend="jax", segmented=None):
     """Simulate an isothermal constant-volume batch reactor (three forms).
 
@@ -499,6 +515,10 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
     native/br_native.cpp — the SUNDIALS-role component) — and ``segmented``
     (None = auto: accelerators integrate in bounded device launches with
     the trajectory drained to host between segments; identical numerics).
+
+    File-driven runs print every accepted step time to the terminal by
+    default, exactly like the reference (:401); pass ``verbose=False`` to
+    opt out of both the per-step lines and the final summary line.
     """
     if args and isinstance(args[0], dict):
         if len(args) != 4:

@@ -15,7 +15,15 @@ Supported mechanism features (everything the reference's fixtures exercise):
   * pressure-dependent falloff ``(+M)`` (or a specific ``(+SP)`` collider)
     with LOW and 3-/4-parameter TROE blending (grimech.dat:36,80,104)
   * explicit-collider reactions like ``H+O2+O2=>HO2+O2`` (plain stoichiometry)
-  * DUPLICATE pairs (kept as independent rows; their rates add naturally)
+  * DUPLICATE pairs (kept as independent rows; their rates add naturally),
+    including negative-A duplicate rows (sign carried in a linear-domain
+    side channel next to the ln|A| storage; CHEMKIN-II requires such rows
+    to be DUPLICATE-marked and we enforce that)
+  * ``REV /A beta Ea/`` explicit reverse Arrhenius parameters (reverse rate
+    from the given parameters instead of the equilibrium constant)
+
+PLOG and CHEB pressure tables remain loud NotImplementedErrors — nothing in
+the reference stack exercises them.
 
 Everything is converted to SI at parse time: A -> (m^3/mol)^(n-1)/s, Ea ->
 J/mol, so the device kernels never see unit conversions.
@@ -56,6 +64,13 @@ class GasMechanism:
     has_troe: jnp.ndarray    # (R,) 1.0 where TROE blending applies
     troe: jnp.ndarray        # (R, 4) a, T3, T1, T2 (T2=+inf for 3-parameter)
     rev_mask: jnp.ndarray    # (R,) 1.0 where reversible
+    sign_A: jnp.ndarray      # (R,) +-1; negative-A DUPLICATE rows carry the
+                             #      sign here, ln|A| in log_A
+    has_rev: jnp.ndarray     # (R,) 1.0 where explicit REV parameters given
+    log_A_rev: jnp.ndarray   # (R,) ln|A_rev|, SI (reverse-order units)
+    beta_rev: jnp.ndarray    # (R,)
+    Ea_rev: jnp.ndarray      # (R,) J/mol
+    sign_A_rev: jnp.ndarray  # (R,) +-1
     species: tuple
     equations: tuple
     int_stoich: bool
@@ -90,6 +105,7 @@ class _Rxn:
     __slots__ = (
         "equation", "reactants", "products", "A", "beta", "Ea", "reversible",
         "third_body", "falloff", "collider", "eff", "low", "troe", "duplicate",
+        "rev",
     )
 
     def __init__(self):
@@ -100,6 +116,7 @@ class _Rxn:
         self.falloff = False
         self.collider = None
         self.duplicate = False
+        self.rev = None
 
 
 def _parse_side(side):
@@ -193,7 +210,20 @@ def _parse_reaction_line(line, rxns, e_factor):
         nums = [_tofloat(t) for t in re.findall(r"[-+0-9.EeDd]+", line[4:]) if _is_number(t)]
         rxns[-1].troe = tuple(nums)
         return
-    if up.startswith("REV") or up.startswith("PLOG") or up.startswith("CHEB"):
+    if up.startswith("REV"):
+        # REV /A beta Ea/ — explicit reverse Arrhenius (CHEMKIN-II); the
+        # reverse rate comes from these parameters, not the equilibrium
+        # constant.  Only meaningful on reversible reactions.
+        nums = [_tofloat(t) for t in re.findall(r"[-+0-9.EeDd]+", line[3:])
+                if _is_number(t)]
+        if len(nums) != 3:
+            raise ValueError(f"REV needs exactly 3 numbers: {line!r}")
+        if not rxns or not rxns[-1].reversible:
+            raise ValueError(f"REV without a preceding reversible reaction: "
+                             f"{line!r}")
+        rxns[-1].rev = (nums[0], nums[1], nums[2] * e_factor)
+        return
+    if up.startswith("PLOG") or up.startswith("CHEB"):
         raise NotImplementedError(f"auxiliary keyword not supported: {line}")
     # reaction line iff it contains '=' and ends with 3 numeric tokens
     toks = line.split()
@@ -260,6 +290,12 @@ def compile_gaschemistry(mech_file):
     # safe inert defaults keep F finite (and jacfwd NaN-free) on non-TROE rows
     troe = np.tile(np.array([0.6, 100.0, 1000.0, np.inf]), (Rn, 1))
     rev_mask = np.zeros(Rn)
+    sign_A = np.ones(Rn)
+    has_rev = np.zeros(Rn)
+    log_A_rev = np.full(Rn, _LOG_ZERO)
+    beta_rev = np.zeros(Rn)
+    Ea_rev = np.zeros(Rn)
+    sign_A_rev = np.ones(Rn)
     equations = []
 
     for i, rxn in enumerate(rxns):
@@ -273,20 +309,51 @@ def compile_gaschemistry(mech_file):
                 raise KeyError(f"unknown species {name!r} in {rxn.equation}")
             nu_r[i, index[name]] += coef
         order = nu_f[i].sum()
-        # ln-domain storage cannot represent A <= 0 (negative-A DUPLICATE
-        # tricks are not supported); fail loudly at the mechanism file.
-        if rxn.A <= 0 or (rxn.low is not None and rxn.low[0] <= 0):
+        # ln-domain storage carries |A|; the sign travels in a linear-domain
+        # side channel.  CHEMKIN-II semantics: a negative A is only valid on
+        # a DUPLICATE row (its partner supplies the dominant positive rate);
+        # A == 0 and negative falloff limits stay loud errors.
+        if rxn.A == 0 or (rxn.low is not None and rxn.low[0] <= 0):
             raise ValueError(
                 f"non-positive pre-exponential in {rxn.equation!r} "
                 f"(A={rxn.A}, LOW={rxn.low}); not representable in ln domain"
             )
+        if rxn.A < 0:
+            if not rxn.duplicate:
+                raise ValueError(
+                    f"negative pre-exponential A={rxn.A} in {rxn.equation!r} "
+                    f"requires a DUPLICATE marker (CHEMKIN-II)")
+            if rxn.falloff:
+                raise ValueError(
+                    f"negative-A falloff reaction unsupported: {rxn.equation!r}")
+            sign_A[i] = -1.0
         # cgs -> SI in ln domain: rate_SI = A_cgs (1e-6)^(order_tot - 1) prod c_SI^nu
         # (order_tot counts the +M collider for plain third-body reactions;
         #  k_inf of a falloff reaction carries no collider concentration)
-        log_A[i] = np.log(rxn.A) + (order + (1 if rxn.third_body else 0) - 1) * np.log(1e-6)
+        log_A[i] = np.log(abs(rxn.A)) + (order + (1 if rxn.third_body else 0) - 1) * np.log(1e-6)
         beta[i] = rxn.beta
         Ea[i] = rxn.Ea
         rev_mask[i] = 1.0 if rxn.reversible else 0.0
+        if rxn.rev is not None:
+            A_r, b_r, ea_r = rxn.rev
+            if A_r == 0:
+                raise ValueError(f"REV with A=0 in {rxn.equation!r}")
+            if rxn.falloff:
+                raise NotImplementedError(
+                    f"REV on a falloff reaction unsupported: {rxn.equation!r}")
+            if A_r < 0 and not rxn.duplicate:
+                raise ValueError(
+                    f"negative REV A={A_r} in {rxn.equation!r} requires a "
+                    f"DUPLICATE marker (CHEMKIN-II)")
+            has_rev[i] = 1.0
+            sign_A_rev[i] = -1.0 if A_r < 0 else 1.0
+            # reverse-direction order: products are the reactants of the
+            # reverse step (the +M collider counts exactly as forward)
+            order_r = nu_r[i].sum()
+            log_A_rev[i] = np.log(abs(A_r)) + (
+                order_r + (1 if rxn.third_body else 0) - 1) * np.log(1e-6)
+            beta_rev[i] = b_r
+            Ea_rev[i] = ea_r
         has_tb[i] = 1.0 if rxn.third_body else 0.0
         if rxn.third_body or (rxn.falloff and rxn.collider is None):
             for name, val in rxn.eff.items():
@@ -331,6 +398,12 @@ def compile_gaschemistry(mech_file):
         has_troe=jnp.asarray(has_troe),
         troe=jnp.asarray(troe),
         rev_mask=jnp.asarray(rev_mask),
+        sign_A=jnp.asarray(sign_A),
+        has_rev=jnp.asarray(has_rev),
+        log_A_rev=jnp.asarray(log_A_rev),
+        beta_rev=jnp.asarray(beta_rev),
+        Ea_rev=jnp.asarray(Ea_rev),
+        sign_A_rev=jnp.asarray(sign_A_rev),
         species=tuple(species),
         equations=tuple(equations),
         int_stoich=int_stoich,

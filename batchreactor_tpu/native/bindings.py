@@ -48,6 +48,12 @@ class _BrGasMech(ctypes.Structure):
         ("has_troe", ctypes.POINTER(ctypes.c_double)),
         ("troe", ctypes.POINTER(ctypes.c_double)),
         ("rev_mask", ctypes.POINTER(ctypes.c_double)),
+        ("sign_A", ctypes.POINTER(ctypes.c_double)),
+        ("has_rev", ctypes.POINTER(ctypes.c_double)),
+        ("log_A_rev", ctypes.POINTER(ctypes.c_double)),
+        ("beta_rev", ctypes.POINTER(ctypes.c_double)),
+        ("Ea_rev", ctypes.POINTER(ctypes.c_double)),
+        ("sign_A_rev", ctypes.POINTER(ctypes.c_double)),
         ("coeffs", ctypes.POINTER(ctypes.c_double)),
         ("T_mid", ctypes.POINTER(ctypes.c_double)),
         ("molwt", ctypes.POINTER(ctypes.c_double)),
@@ -182,7 +188,10 @@ def _pack_mech(gm, thermo, kc_compat):
         ("has_tb", gm.has_tb), ("has_falloff", gm.has_falloff),
         ("log_A0", gm.log_A0), ("beta0", gm.beta0), ("Ea0", gm.Ea0),
         ("has_troe", gm.has_troe), ("troe", gm.troe),
-        ("rev_mask", gm.rev_mask), ("coeffs", thermo.coeffs),
+        ("rev_mask", gm.rev_mask), ("sign_A", gm.sign_A),
+        ("has_rev", gm.has_rev), ("log_A_rev", gm.log_A_rev),
+        ("beta_rev", gm.beta_rev), ("Ea_rev", gm.Ea_rev),
+        ("sign_A_rev", gm.sign_A_rev), ("coeffs", thermo.coeffs),
         ("T_mid", thermo.T_mid), ("molwt", thermo.molwt),
     ]:
         arr, ptr = _carr(src)

@@ -110,10 +110,13 @@ def forward_rate_constants(T, conc, gm, with_grad=False,
     fc = cM_pos * 1e-6 if falloff_compat else 1.0
     if not with_grad:
         F = _troe_F(T, Pr, gm.troe, gm.has_troe)
-        kf = jnp.where(gm.has_falloff > 0, k_inf * L * F * fc, k_inf)
+        # sign_A: negative-A DUPLICATE rows (ln-domain stores |A|, the sign
+        # is a linear side channel; falloff rows are parse-time positive)
+        kf = gm.sign_A * jnp.where(gm.has_falloff > 0, k_inf * L * F * fc,
+                                   k_inf)
         return kf, tb_factor
     F, dF_dPr = _troe_F(T, Pr, gm.troe, gm.has_troe, with_grad=True)
-    kf = jnp.where(gm.has_falloff > 0, k_inf * L * F * fc, k_inf)
+    kf = gm.sign_A * jnp.where(gm.has_falloff > 0, k_inf * L * F * fc, k_inf)
     dkf_dPr = k_inf * (F / ((1.0 + Pr) * (1.0 + Pr)) + L * dF_dPr)
     # the forward path clamps Pr (and fc) at cM=0, so the true derivative is
     # 0 for transiently negative Newton iterates — match it exactly
@@ -154,6 +157,21 @@ def equilibrium_constants(T, gm, thermo, kc_compat=False):
     return log_Kc
 
 
+def reverse_rate_constants(T, kf, gm, thermo, kc_compat=False, log_Kc=None):
+    """Reverse rate constants kr (R,): kf/Kc for equilibrium-derived rows,
+    explicit Arrhenius for ``REV``-parameterized rows (CHEMKIN-II).
+    Pass a precomputed ``log_Kc`` to avoid re-evaluating the Gibbs
+    polynomials (the Jacobian path needs it separately anyway)."""
+    if log_Kc is None:
+        log_Kc = equilibrium_constants(T, gm, thermo, kc_compat)
+    # kr = kf/Kc evaluated as kf * exp(-ln Kc); clip keeps the unreachable
+    # far-from-equilibrium extreme finite without changing reachable physics
+    kr_eq = gm.rev_mask * kf * jnp.exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
+    kr_rev = gm.sign_A_rev * _arrhenius(T, gm.log_A_rev, gm.beta_rev,
+                                        gm.Ea_rev)
+    return jnp.where(gm.has_rev > 0, kr_rev, kr_eq)
+
+
 def reaction_rates(T, conc, gm, thermo, kc_compat=False, falloff_compat=None):
     """Net rate of progress q_i (R,) [mol/m^3/s].
 
@@ -164,10 +182,7 @@ def reaction_rates(T, conc, gm, thermo, kc_compat=False, falloff_compat=None):
         falloff_compat = kc_compat
     kf, tb = forward_rate_constants(T, conc, gm,
                                     falloff_compat=falloff_compat)
-    log_Kc = equilibrium_constants(T, gm, thermo, kc_compat)
-    # kr = kf/Kc evaluated as kf * exp(-ln Kc); clip keeps the unreachable
-    # far-from-equilibrium extreme finite without changing reachable physics
-    kr = gm.rev_mask * kf * jnp.exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
+    kr = reverse_rate_constants(T, kf, gm, thermo, kc_compat)
     rf = kf * _stoich_prod(conc, gm.nu_f, gm.int_stoich)
     rr = kr * _stoich_prod(conc, gm.nu_r, gm.int_stoich)
     return (rf - rr) * tb
@@ -240,16 +255,21 @@ def production_rates_and_jac(T, conc, gm, thermo, kc_compat=False,
     kf, tb, dkf_dcM, dtb_dcM = forward_rate_constants(
         T, conc, gm, with_grad=True, falloff_compat=falloff_compat)
     log_Kc = equilibrium_constants(T, gm, thermo, kc_compat)
+    kr = reverse_rate_constants(T, kf, gm, thermo, kc_compat, log_Kc=log_Kc)
+    # equilibrium-derived rows: kr = (rev_mask e^{-lnKc}) kf scales with kf,
+    # so dkr/dcM = (kr/kf) dkf/dcM; explicit-REV rows have no cM dependence
     rKc = gm.rev_mask * jnp.exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
+    dkr_dcM = jnp.where(gm.has_rev > 0, 0.0, rKc * dkf_dcM)
 
     Pf, dPf = _stoich_prod_and_grad(conc, gm.nu_f, gm.int_stoich)
     Prp, dPrp = _stoich_prod_and_grad(conc, gm.nu_r, gm.int_stoich)
 
-    net = Pf - rKc * Prp                                     # (R,)
-    q = tb * kf * net
-    # dq_jk = tb kf (dPf - rKc dPrp) + (tb dkf/dcM + dtb/dcM kf) net eff_jk
-    dq = (tb * kf)[:, None] * (dPf - rKc[:, None] * dPrp) + (
-        (tb * dkf_dcM + dtb_dcM * kf) * net)[:, None] * gm.eff
+    net = kf * Pf - kr * Prp                                 # (R,)
+    q = tb * net
+    # dq_jk = tb (kf dPf - kr dPrp)
+    #       + (dtb/dcM net + tb (dkf/dcM Pf - dkr/dcM Prp)) eff_jk
+    dq = tb[:, None] * (kf[:, None] * dPf - kr[:, None] * dPrp) + (
+        dtb_dcM * net + tb * (dkf_dcM * Pf - dkr_dcM * Prp))[:, None] * gm.eff
 
     dnu = gm.nu_r - gm.nu_f
     return dnu.T @ q, dnu.T @ dq

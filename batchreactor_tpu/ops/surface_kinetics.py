@@ -14,27 +14,15 @@ mechanism's A values are cgs; the single x1e4 conversion happens at the end.
 import jax.numpy as jnp
 
 from ..utils.constants import R
-from .gas_kinetics import _stoich_prod_and_grad
+# the forward rates and the analytic Jacobian share ONE stoichiometric-
+# product implementation (clamps included) so the 'Jacobian == derivative
+# of the RHS' invariant cannot drift between two copies of the math
+from .gas_kinetics import _stoich_prod, _stoich_prod_and_grad
 
 _EXP_MAX = 690.0
 # cgs gas constant for the sticking flux sqrt(R T / 2 pi M): erg/(mol K)
 _R_CGS = R * 1e7
 _PI = 3.141592653589793
-
-
-def _pow_prod(base, expo, int_expo):
-    """prod_k base_k^expo_ik rows.  ``int_expo`` is static (decided at
-    compile_mech time) so XLA materializes exactly one branch: the masked
-    integer path for mechanisms whose exponents are all in {0,1,2,3}, or the
-    log/exp general path for fractional/negative <order> overrides."""
-    b = base[None, :]
-    if int_expo:
-        p = jnp.where(expo >= 1, b, 1.0)
-        p = jnp.where(expo >= 2, p * b, p)
-        p = jnp.where(expo >= 3, p * b, p)
-        return jnp.prod(p, axis=1)
-    safe = jnp.maximum(b, 1e-300)
-    return jnp.exp(jnp.sum(expo * jnp.log(safe), axis=1))
 
 
 def rate_constants(T, theta, sm, with_grad=False):
@@ -74,10 +62,10 @@ def reaction_rates(T, p, mole_fracs, theta, sm):
     c_gas = mole_fracs * p / (R * T) * 1e-6           # mol/cm^3
     c_surf = theta * sm.site_density / sm.site_coordination  # mol/cm^2
     k = rate_constants(T, theta, sm)
-    gas_part = _pow_prod(c_gas, sm.expo_gas, sm.int_expo)
+    gas_part = _stoich_prod(c_gas, sm.expo_gas, sm.int_expo)
     # stick rows use raw coverages; Arrhenius rows use surface concentrations
-    surf_conc_part = _pow_prod(c_surf, sm.expo_surf, sm.int_expo)
-    surf_theta_part = _pow_prod(theta, sm.expo_surf, sm.int_expo)
+    surf_conc_part = _stoich_prod(c_surf, sm.expo_surf, sm.int_expo)
+    surf_theta_part = _stoich_prod(theta, sm.expo_surf, sm.int_expo)
     surf_part = jnp.where(sm.stick > 0, surf_theta_part, surf_conc_part)
     return k * gas_part * surf_part
 

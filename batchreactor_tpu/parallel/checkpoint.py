@@ -111,8 +111,16 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
     mechanism tensors), initial states, per-lane conditions, and solver
     settings.  A resume into a checkpoint dir whose fingerprint differs
     fails loudly instead of silently serving chunks from a different
-    sweep."""
+    sweep.
+
+    The leading schema tag versions the *hash recipe itself*: bumping it
+    (as round 2 did implicitly when kwarg names and opaque-value reprs
+    entered the hash) invalidates every checkpoint written under the old
+    recipe, so stale resumes restart from scratch — the safe direction —
+    but now the invalidation is explicit and greppable rather than a
+    silent by-product of the recipe change."""
     h = hashlib.sha256()
+    h.update(b"br-sweep-fingerprint-v2")
     _hash_callable(h, rhs)
     h.update(np.ascontiguousarray(np.asarray(y0s)).tobytes())
     for k in sorted(cfgs):

@@ -132,7 +132,8 @@ def temperature_sweep(rhs, y0, T_grid, t1, base_cfg=None, **kw):
 
 
 def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
-                             max_segments=10_000, mesh=None, axis="batch",
+                             max_segments=10_000, max_attempts=None,
+                             mesh=None, axis="batch",
                              progress=None, rtol=1e-6, atol=1e-10,
                              linsolve="auto", jac=None, observer=None,
                              observer_init=None, dt_min_factor=1e-22,
@@ -166,7 +167,19 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     fresh same-shaped bundles (e.g. re-parsed mechanisms in file-driven
     runs) reuse one executable instead of recompiling.  ``jac`` is ignored
     in this form.
+
+    ``max_attempts`` bounds the total step attempts per lane across
+    segments, tracked host-side: a lane still running once its accepted +
+    rejected attempts reach the budget is parked with MAX_STEPS_REACHED —
+    the same exact budget semantics as the monolithic path's ``max_steps``.
+    (One asymmetry remains: a lane that *finishes* inside its final segment
+    keeps its success even if the finish came within the up-to-
+    ``segment_steps - 1`` attempts past the budget; the monolithic path
+    would have reported MaxIters.  The failing direction — the resource
+    bound — is exact.)
     """
+    if max_segments < 1:
+        raise ValueError(f"max_segments must be >= 1, got {max_segments}")
     y0s = jnp.asarray(y0s)
     B = y0s.shape[0]
     # a segment can accept at most segment_steps rows, so this buffer never
@@ -216,16 +229,25 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         n_acc += np.where(running, np.asarray(res.n_accepted), 0)
         n_rej += np.where(running, np.asarray(res.n_rejected), 0)
         if n_save:
-            # drain this segment's device buffer into the host trajectory
+            # drain this segment's device buffer into the host trajectory —
+            # vectorized masked scatter, no per-lane Python loop, and the
+            # (B, seg_save, S) transfer is skipped entirely for segments
+            # that saved nothing (only the small n_saved vector moves)
             seg_n = np.asarray(res.n_saved)
-            seg_ts = np.asarray(res.ts)
-            seg_ys = np.asarray(res.ys)
-            for b in np.nonzero(running & (seg_n > 0))[0]:
-                take = min(int(seg_n[b]), int(n_save) - int(saved[b]))
-                if take > 0:
-                    all_ts[b, saved[b]:saved[b] + take] = seg_ts[b, :take]
-                    all_ys[b, saved[b]:saved[b] + take] = seg_ys[b, :take]
-                    saved[b] += take
+            take = np.where(running, np.minimum(seg_n, int(n_save) - saved),
+                            0)
+            drained_ts = None
+            if take.max() > 0:
+                seg_ts = np.asarray(res.ts)
+                seg_ys = np.asarray(res.ys)
+                col = np.arange(seg_ts.shape[1])
+                src = col[None, :] < take[:, None]           # (B, seg_save)
+                b_idx, c_idx = np.nonzero(src)
+                dst = saved[b_idx] + c_idx
+                all_ts[b_idx, dst] = seg_ts[b_idx, c_idx]
+                all_ys[b_idx, dst] = seg_ys[b_idx, c_idx]
+                saved += take
+                drained_ts = seg_ts[b_idx, c_idx]  # lane-major, in-lane order
         terminal = status != int(sdirk.MAX_STEPS_REACHED)
         newly_terminal = running & terminal
         final_status = np.where(newly_terminal, status, final_status)
@@ -233,6 +255,15 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         # first terminated (for DT_UNDERFLOW that is the failure time, same
         # as the unsegmented path reports) — not the t1 it gets parked at
         final_t = np.where(newly_terminal, np.asarray(res.t), final_t)
+        if max_attempts is not None:
+            # exact per-lane attempt budget (monolithic max_steps parity):
+            # park still-running lanes whose budget is spent as MaxSteps
+            exhausted = (final_status == int(sdirk.RUNNING)) & (
+                n_acc + n_rej >= int(max_attempts))
+            final_status = np.where(exhausted,
+                                    int(sdirk.MAX_STEPS_REACHED),
+                                    final_status)
+            final_t = np.where(exhausted, np.asarray(res.t), final_t)
         parked = jnp.asarray(final_status != int(sdirk.RUNNING))
         t = jnp.where(parked, t1, res.t)
         y = res.y
@@ -245,9 +276,15 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
             obs = res.observed
         done = not bool(np.any(final_status == int(sdirk.RUNNING)))
         if progress is not None:
-            progress({"segment": seg, "lanes_done": int(
+            payload = {"segment": seg, "lanes_done": int(
                 (final_status != int(sdirk.RUNNING)).sum()), "n_lanes": B,
-                "accepted_total": int(n_acc.sum())})
+                "accepted_total": int(n_acc.sum())}
+            if n_save and drained_ts is not None:
+                # accepted times drained this segment (lane-major) — the
+                # live per-step terminal progress the file-driven API
+                # prints (reference /root/reference/src/BatchReactor.jl:401)
+                payload["drained_ts"] = drained_ts
+            progress(payload)
         if done:
             break
     else:

@@ -67,6 +67,12 @@ struct BrGasMech {
   const double* has_troe;    // (R,)
   const double* troe;        // (R,4) a, T3, T1, T2
   const double* rev_mask;    // (R,)
+  const double* sign_A;      // (R,) +-1; negative-A DUPLICATE rows
+  const double* has_rev;     // (R,) 1.0 where explicit REV parameters
+  const double* log_A_rev;   // (R,) ln|A_rev|, SI
+  const double* beta_rev;    // (R,)
+  const double* Ea_rev;      // (R,) J/mol
+  const double* sign_A_rev;  // (R,) +-1
   const double* coeffs;      // (S,2,7) NASA-7 low/high ranges
   const double* T_mid;       // (S,)
   const double* molwt;       // (S,) kg/mol
@@ -141,11 +147,19 @@ void br_gas_rhs(const BrGasMech* m, double T, const double* y, double* dy) {
       dG += d * g[k];
       dn += d;
     }
+    kf *= m->sign_A[i];  // negative-A DUPLICATE rows (ln-domain stores |A|)
+
     const double log_c0 =
         m->kc_compat ? log_c0_ref + std::log(1e6) : log_c0_phys;
     const double log_Kc = -dG + dn * log_c0;
+    // reverse: explicit REV Arrhenius where given, else kf/Kc
     const double kr =
-        m->rev_mask[i] * kf * std::exp(clamp(-log_Kc, -kExpMax, kExpMax));
+        m->has_rev[i] > 0
+            ? m->sign_A_rev[i] *
+                  std::exp(clamp(m->log_A_rev[i] + m->beta_rev[i] * logT -
+                                     m->Ea_rev[i] / rt,
+                                 -kExpMax, kExpMax))
+            : m->rev_mask[i] * kf * std::exp(clamp(-log_Kc, -kExpMax, kExpMax));
 
     // stoichiometric concentration products (ops/gas_kinetics._stoich_prod:
     // integer powers keep transient negative concentrations NaN-free)

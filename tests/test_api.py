@@ -280,3 +280,34 @@ def test_file_driven_segmented_matches_monolithic(tmp_path, reference_dir,
     assert abs(len(rb) - len(ra)) < 0.2 * len(ra)
     # final compositions agree at tolerance scale
     np.testing.assert_allclose(rb[-1, 1:], ra[-1, 1:], rtol=1e-5, atol=1e-10)
+
+
+def test_default_per_step_progress(tmp_path, reference_dir, lib_dir, capsys):
+    """File-driven runs print every accepted step time by default, like the
+    reference's per-step @printf (/root/reference/src/BatchReactor.jl:401);
+    verbose=False opts out entirely."""
+    xml = _stage(tmp_path, reference_dir / "test" / "batch_h2o2")
+    ret = br.batch_reactor(xml, lib_dir, gaschem=True)
+    assert ret == "Success"
+    out = capsys.readouterr().out
+    lines = out.strip().splitlines()
+    # many per-step lines in %.4e format, then one summary line
+    step_lines = [ln for ln in lines if not ln.startswith("t = ")]
+    assert len(step_lines) > 50
+    ts = [float(ln) for ln in step_lines]
+    assert ts == sorted(ts) and ts[-1] <= 10.0 + 1e-9
+    assert lines[-1].startswith("t = ")
+
+    ret = br.batch_reactor(xml, lib_dir, gaschem=True, verbose=False)
+    assert ret == "Success"
+    assert capsys.readouterr().out == ""
+
+
+def test_segmented_max_steps_budget_exact(tmp_path, reference_dir, lib_dir,
+                                          capsys):
+    """The segmented path parks lanes at the exact max_steps attempt budget
+    (host-side tracking), matching the monolithic backends' semantics."""
+    xml = _stage(tmp_path, reference_dir / "test" / "batch_h2o2")
+    ret = br.batch_reactor(xml, lib_dir, gaschem=True, max_steps=40,
+                           segmented=True, verbose=False)
+    assert ret == "MaxIters"

@@ -8,6 +8,7 @@ conversions for specific reaction lines.
 import numpy as np
 import pytest
 
+import batchreactor_tpu as br
 from batchreactor_tpu.models.gas import compile_gaschemistry
 from batchreactor_tpu.utils.constants import CAL_TO_J
 
@@ -136,3 +137,99 @@ def test_duplicates_kept_as_rows(gri):
 def test_irreversible(gri):
     irrev = 325 - int(gri.rev_mask.sum())
     assert irrev == 16  # GRI-Mech 3.0 has 16 '=>' reactions
+
+
+# --- REV keyword + negative-A duplicates (CHEMKIN-II breadth) ---
+
+def _mini_mech(tmp_path, body):
+    p = tmp_path / "mini.dat"
+    p.write_text("ELEMENTS\nH O N\nEND\nSPECIES\nH2 O2 OH H2O N2\nEND\n"
+                 "REACTIONS\n" + body + "END\n")
+    return str(p)
+
+
+def test_rev_keyword_hand_computed(tmp_path, fixtures_dir):
+    """REV /A b Ea/: reverse rate from explicit Arrhenius, not Kc.
+    Hand-computed: kf = A T^b exp(-Ea/RT), kr likewise with REV params;
+    q = kf [H2][O2] - kr [OH]^2 (SI after cgs conversion)."""
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import reaction_rates
+    from batchreactor_tpu.utils.constants import CAL_TO_J, R
+
+    mech = _mini_mech(tmp_path,
+                      "H2+O2=2OH   4.0E13  0.5  1000.\n"
+                      "REV /2.0E11  0.3  500./\n")
+    gm = br.compile_gaschemistry(mech)
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    assert int(np.asarray(gm.has_rev).sum()) == 1
+    T = 1100.0
+    conc = np.array([2.0, 1.5, 0.7, 0.0, 3.0])  # mol/m^3, species order
+    q = np.asarray(reaction_rates(T, jnp.asarray(conc), gm, th))
+    # hand: cgs A for a bimolecular step -> SI factor 1e-6
+    kf = 4.0e13 * 1e-6 * T**0.5 * np.exp(-1000.0 * CAL_TO_J / (R * T))
+    kr = 2.0e11 * 1e-6 * T**0.3 * np.exp(-500.0 * CAL_TO_J / (R * T))
+    q_hand = kf * conc[0] * conc[1] - kr * conc[2] ** 2
+    np.testing.assert_allclose(float(q[0]), q_hand, rtol=1e-12)
+
+
+def test_negative_A_duplicate_hand_computed(tmp_path, fixtures_dir):
+    """Negative-A DUPLICATE pair: rates add with sign; the pair total stays
+    positive at this T.  A negative A without DUPLICATE is rejected."""
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import production_rates
+    from batchreactor_tpu.utils.constants import CAL_TO_J, R
+
+    mech = _mini_mech(tmp_path,
+                      "H2+O2=>2OH   4.0E13  0.0  1000.\n"
+                      "DUPLICATE\n"
+                      "H2+O2=>2OH  -1.0E13  0.0  2000.\n"
+                      "DUPLICATE\n")
+    gm = br.compile_gaschemistry(mech)
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    assert np.asarray(gm.sign_A).tolist() == [1.0, -1.0]
+    T = 1000.0
+    conc = np.array([2.0, 1.5, 0.0, 0.0, 3.0])
+    wdot = np.asarray(production_rates(T, jnp.asarray(conc), gm, th))
+    k1 = 4.0e13 * 1e-6 * np.exp(-1000.0 * CAL_TO_J / (R * T))
+    k2 = -1.0e13 * 1e-6 * np.exp(-2000.0 * CAL_TO_J / (R * T))
+    q_hand = (k1 + k2) * conc[0] * conc[1]
+    assert q_hand > 0
+    np.testing.assert_allclose(wdot[2], 2 * q_hand, rtol=1e-12)  # OH
+    np.testing.assert_allclose(wdot[0], -q_hand, rtol=1e-12)     # H2
+
+    bad = _mini_mech(tmp_path, "H2+O2=>2OH  -1.0E13  0.0  2000.\n")
+    with pytest.raises(ValueError, match="DUPLICATE"):
+        br.compile_gaschemistry(bad)
+
+
+def test_rev_and_negA_jacobian_matches_jacfwd(tmp_path, fixtures_dir):
+    """The closed-form Jacobian handles REV rows (no Kc-scaling of dkr) and
+    signed rows exactly."""
+    import jax
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import (production_rates,
+                                                   production_rates_and_jac)
+
+    mech = _mini_mech(tmp_path,
+                      "H2+O2=2OH   4.0E13  0.5  1000.\n"
+                      "REV /2.0E11  0.3  500./\n"
+                      "2OH=H2O+O2  1.0E12  0.0  300.\n"
+                      "H2+O2=>2OH   3.0E13  0.0  1500.\n"
+                      "DUPLICATE\n"
+                      "H2+O2=>2OH  -1.0E12  0.0  2500.\n"
+                      "DUPLICATE\n")
+    gm = br.compile_gaschemistry(mech)
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    T = 1200.0
+    conc = jnp.asarray([2.0, 1.5, 0.7, 0.4, 3.0])
+    _, J = production_rates_and_jac(T, conc, gm, th)
+    J_fd = jax.jacfwd(lambda c: production_rates(T, c, gm, th))(conc)
+    np.testing.assert_allclose(np.asarray(J), np.asarray(J_fd), rtol=1e-10,
+                               atol=1e-10 * float(jnp.abs(J_fd).max()))
+
+
+def test_plog_cheb_still_loud(tmp_path):
+    for kw in ("PLOG /1. 1. 1. 1./", "CHEB /1. 1./"):
+        mech = _mini_mech(tmp_path, f"H2+O2=2OH 1.0E13 0. 0.\n{kw}\n")
+        with pytest.raises(NotImplementedError):
+            br.compile_gaschemistry(mech)

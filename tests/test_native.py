@@ -225,3 +225,25 @@ class TestNativeSurface:
         np.testing.assert_allclose(last_cpu[0], last_jax[0], rtol=1e-12)
         np.testing.assert_allclose(last_cpu[1:], last_jax[1:], rtol=5e-4,
                                    atol=1e-12)
+
+
+def test_gas_rhs_rev_and_negative_A_matches_jax(tmp_path, fixtures_dir):
+    """REV rows and negative-A DUPLICATE rows: C++ RHS == JAX RHS (the two
+    independent implementations pin the CHEMKIN-II semantics)."""
+    p = tmp_path / "mini.dat"
+    p.write_text(
+        "ELEMENTS\nH O N\nEND\nSPECIES\nH2 O2 OH H2O N2\nEND\nREACTIONS\n"
+        "H2+O2=2OH   4.0E13  0.5  1000.\n"
+        "REV /2.0E11  0.3  500./\n"
+        "2OH=H2O+O2  1.0E12  0.0  300.\n"
+        "H2+O2=>2OH   3.0E13  0.0  1500.\n"
+        "DUPLICATE\n"
+        "H2+O2=>2OH  -1.0E12  0.0  2500.\n"
+        "DUPLICATE\nEND\n")
+    gm = br.compile_gaschemistry(str(p))
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    y = np.array([0.05, 0.4, 0.01, 0.02, 0.6])  # rho_k, kg/m^3
+    rhs = make_gas_rhs(gm, th)
+    d_jax = np.asarray(rhs(0.0, jnp.asarray(y), {"T": jnp.asarray(1200.0)}))
+    d_nat = native.gas_rhs(gm, th, 1200.0, y)
+    np.testing.assert_allclose(d_nat, d_jax, rtol=1e-10)
