@@ -261,7 +261,11 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
     (reference :152-217)."""
     import sys
 
-    id_ = input_data(input_file, lib_dir, chem)
+    from .utils.profiling import Phases
+
+    ph = Phases()
+    with ph("parse"):
+        id_ = input_data(input_file, lib_dir, chem)
     mode = _mode(chem)
     surf_species = id_.smd.species if chem.surfchem else None
     covg0 = id_.smd.ini_covg if chem.surfchem else None
@@ -287,30 +291,36 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
         def prog(p):
             nonlocal n_live
             for tv in p.get("drained_ts", ()):
-                print(f"{tv:.4e}")
+                print(f"{tv:4e}")  # C %4e: width 4, default 6-digit precision
             n_live += len(p.get("drained_ts", ()))
 
-    status, t_end, _, ts, ys, truncated, n_acc, n_rej = _run_solve(
-        backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
-        0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-        segmented=segmented, progress=prog)
+    with ph("solve"):
+        status, t_end, _, ts, ys, truncated, n_acc, n_rej = _run_solve(
+            backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
+            0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat,
+            asv_quirk, segmented=segmented, progress=prog)
     if verbose and n_live == 0:
         # ts[0] is the initial row, not an accepted step; a truncated run
         # appends a final-state bridge row that is not an accepted step
         # either (keeps parity with the segmented live path's output)
         for tv in (ts[1:-1] if truncated else ts[1:]):
-            print(f"{tv:.4e}")
+            print(f"{tv:4e}")  # reference @printf("%4e\n",t), :401
     if truncated:
         print(f"warning: trajectory buffer full "
               f"({n_acc} accepted steps > n_save={n_save}); "
               f"profile files skip the overflow but end at the true final "
               f"state", file=sys.stderr)
     out_dir = os.path.dirname(os.path.abspath(input_file))
-    write_profiles(out_dir, id_.species, ts, ys, id_.T,
-                   np.asarray(id_.thermo.molwt), surface_species=surf_species)
+    with ph("write"):
+        write_profiles(out_dir, id_.species, ts, ys, id_.T,
+                       np.asarray(id_.thermo.molwt),
+                       surface_species=surf_species)
     if verbose:
         print(f"t = {t_end:.4e} s  "
               f"({n_acc} accepted / {n_rej} rejected steps)")
+        # phase breakdown to stderr (SURVEY.md §5 tracing plan); the solve
+        # span includes compile on a cold cache — rerun to see it cached
+        print("phases:\n" + ph.pretty(), file=sys.stderr)
     return status
 
 

@@ -116,9 +116,11 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
     The leading schema tag versions the *hash recipe itself*: bumping it
     (as round 2 did implicitly when kwarg names and opaque-value reprs
     entered the hash) invalidates every checkpoint written under the old
-    recipe, so stale resumes restart from scratch — the safe direction —
-    but now the invalidation is explicit and greppable rather than a
-    silent by-product of the recipe change."""
+    recipe.  A resume into such a directory raises the manifest-mismatch
+    ValueError (``use a fresh directory``) — the safe, loud direction:
+    the operator deletes or repoints the checkpoint dir to restart, and
+    the invalidation is explicit and greppable rather than a silent
+    by-product of the recipe change."""
     h = hashlib.sha256()
     h.update(b"br-sweep-fingerprint-v2")
     _hash_callable(h, rhs)
@@ -159,6 +161,11 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     re-solved (the manifest pins B/chunk_size so a mismatched resume fails
     loudly rather than silently mixing sweeps).  Returns the full
     concatenated SolveResult.
+
+    ``segment_steps > 0`` in ``solve_kw`` runs each chunk through
+    ``ensemble_solve_segmented`` (bounded device launches — the safe mode
+    on tunneled TPU runtimes); ``max_steps`` then maps onto the segmented
+    path's exact per-lane attempt budget.
     """
     y0s = jnp.asarray(y0s)
     B = y0s.shape[0]
@@ -193,7 +200,29 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
             y0c = jnp.concatenate([y0c, jnp.repeat(y0c[-1:], pad, axis=0)])
             cfgc = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
                     for k, v in cfgc.items()}
-        res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **solve_kw)
+        seg_steps = int(solve_kw.get("segment_steps", 0) or 0)
+        if seg_steps > 0:
+            import inspect
+
+            from .sweep import ensemble_solve_segmented
+
+            handled = {"segment_steps", "max_steps"}
+            allowed = set(
+                inspect.signature(ensemble_solve_segmented).parameters)
+            unsupported = set(solve_kw) - handled - allowed
+            if unsupported:
+                raise TypeError(
+                    f"solve kwargs {sorted(unsupported)} are not supported "
+                    f"by the segmented sweep path (segment_steps > 0)")
+            kw = {k: v for k, v in solve_kw.items() if k not in handled}
+            ms = int(solve_kw.get("max_steps", 200_000))
+            res = ensemble_solve_segmented(
+                rhs, y0c, t0, t1, cfgc, segment_steps=seg_steps,
+                max_segments=max(1, -(-ms // seg_steps)), max_attempts=ms,
+                **kw)
+        else:
+            kw = {k: v for k, v in solve_kw.items() if k != "segment_steps"}
+            res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **kw)
         if pad:
             res = jax.tree.map(
                 lambda x: x[:n] if hasattr(x, "ndim") and x.ndim >= 1 else x,

@@ -378,13 +378,24 @@ def ignition_observer(marker, mode="half", frac=0.5):
     (NaN where never crossed — e.g. lanes that did not ignite).
     """
     if mode == "half":
-        init = {"m0": jnp.nan, "tau": jnp.nan}
+        init = {"m0": jnp.nan, "tau": jnp.nan, "t_prev": jnp.nan,
+                "m_prev": jnp.nan}
 
         def observer(t, y, acc):
             m = y[marker]
             m0 = jnp.where(jnp.isnan(acc["m0"]), m, acc["m0"])
-            crossed = jnp.isnan(acc["tau"]) & (m < frac * m0)
-            return {"m0": m0, "tau": jnp.where(crossed, t, acc["tau"])}
+            thr = frac * m0
+            crossed = jnp.isnan(acc["tau"]) & (m < thr)
+            # linear interpolation between the bracketing accepted steps:
+            # the accepted-step spacing near a fast ignition front is wide
+            # enough that first-step-past-threshold alone costs ~1% tau
+            denom = acc["m_prev"] - m
+            w = jnp.where(denom != 0, (acc["m_prev"] - thr) / denom, 1.0)
+            w = jnp.clip(w, 0.0, 1.0)
+            t_x = jnp.where(jnp.isnan(acc["t_prev"]), t,
+                            acc["t_prev"] + w * (t - acc["t_prev"]))
+            return {"m0": m0, "tau": jnp.where(crossed, t_x, acc["tau"]),
+                    "t_prev": t, "m_prev": m}
 
     elif mode == "peak":
         init = {"m_max": -jnp.inf, "tau": jnp.nan}

@@ -32,6 +32,7 @@ Prints ONE JSON line:
 Diagnostics go to stderr.
 """
 
+import contextlib
 import json
 import os
 import subprocess
@@ -169,9 +170,13 @@ def rung_main():
     from batchreactor_tpu.solver.sdirk import SUCCESS
     from batchreactor_tpu.utils.composition import density, mole_to_mass
 
+    from batchreactor_tpu.utils.profiling import Phases, device_trace
+
+    ph = Phases()
     B = int(os.environ.get("BENCH_B", "64"))
-    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
-    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    with ph("parse"):
+        gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+        th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
     sp = list(gm.species)
     x0 = np.zeros(len(sp))
     # the reference's batch_ch4 mixture (/root/reference/test/batch_ch4/batch.xml)
@@ -197,17 +202,23 @@ def rung_main():
 
     log(f"[rung B={B}] devices: {jax.devices()}")
     t0 = time.perf_counter()
-    res = sweep()
-    jax.block_until_ready(res.y)
+    with ph("compile+first_solve"):
+        res = sweep()
+        jax.block_until_ready(res.y)
     t_warm = time.perf_counter() - t0
     n_ok = int((np.asarray(res.status) == SUCCESS).sum())
     log(f"[rung B={B}] warm-up (incl. compile): {t_warm:.1f}s ok={n_ok}/{B} "
         f"mean steps {float(np.asarray(res.n_accepted).mean()):.0f}")
 
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    trace_ctx = (device_trace(trace_dir) if trace_dir
+                 else contextlib.nullcontext())
     t0 = time.perf_counter()
-    res = sweep()
-    jax.block_until_ready(res.y)
+    with trace_ctx, ph("solve"):
+        res = sweep()
+        jax.block_until_ready(res.y)
     wall = time.perf_counter() - t0
+    log(f"[rung B={B}] phases:\n{ph.pretty()}")
     tau = np.asarray(res.observed["tau"])
     print(json.dumps({
         "B": B, "wall_s": round(wall, 3),

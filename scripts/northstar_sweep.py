@@ -1,0 +1,183 @@
+"""North-star workload: 4096-condition GRI-Mech 3.0 ignition map on TPU.
+
+The BASELINE.md target: >= 50x wall-clock vs single-CPU CVODE-class BDF on a
+4096-condition GRI ignition sweep, < 1% ignition-delay error.  The reference
+can only do this as 4096 serial CVODE calls (one condition per call,
+/root/reference/src/BatchReactor.jl:210); here it is ONE checkpointed,
+mesh-shardable, segmented ensemble program.
+
+Grid: 64 T0 x 64 phi (equivalence ratio), CH4/O2/N2 with the oxidizer
+stream carrying N2 at the reference batch_ch4 ratio (phi=1 reproduces its
+0.25/0.5/0.25 mixture, /root/reference/test/batch_ch4/batch.xml), 1 bar,
+t1 = 8e-4 s, rtol 1e-6 / atol 1e-10 (the reference's CVODE tolerances).
+Ignition delay tau = first accepted time CH4 drops below half its initial
+value, extracted in-loop by the O(B) observer fold (no trajectory buffer).
+
+Outputs NORTHSTAR.json: conditions/sec, tau parity vs the native C++ BDF
+(independent implementation) on spot-check lanes, per-status lane counts,
+and the phase-timer breakdown (parse / build / solve).
+
+Usage:
+  python scripts/northstar_sweep.py                 # full 4096 on the device
+  NORTHSTAR_NT=4 NORTHSTAR_NPHI=2 ...               # small grids (tests/CI)
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+if not os.path.isdir(LIB):
+    LIB = os.path.join(REPO, "tests", "fixtures")
+
+
+def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
+              phi_hi=1.6, t1=8e-4, p=1e5, ckpt_dir=None, chunk_size=512,
+              segment_steps=256, mesh=None, rtol=1e-6, atol=1e-10,
+              n_spot=8, log=print):
+    """Run the T x phi GRI ignition map; return the result record dict."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+    from batchreactor_tpu.parallel import ignition_observer
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+    from batchreactor_tpu.parallel.grid import (condition_grid,
+                                                premixed_mole_fracs,
+                                                sweep_solution_vectors)
+    from batchreactor_tpu.parallel.sweep import ensemble_solve_segmented
+    from batchreactor_tpu.parallel import sweep_report
+    from batchreactor_tpu.solver.sdirk import SUCCESS
+    from batchreactor_tpu.utils.profiling import Phases
+
+    ph = Phases()
+    with ph("parse"):
+        gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+        th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sp = list(gm.species)
+
+    with ph("build"):
+        grid = condition_grid(T=jnp.linspace(T_lo, T_hi, n_T),
+                              phi=jnp.linspace(phi_lo, phi_hi, n_phi))
+        B = grid["T"].shape[0]
+        # oxidizer stream carries N2 at 0.5 mol per mol O2: phi=1 gives the
+        # reference batch_ch4 mixture CH4/O2/N2 = 0.25/0.5/0.25
+        X = premixed_mole_fracs(sp, "CH4", grid["phi"], stoich_o2=2.0,
+                                diluent="N2", o2_to_diluent=0.5)
+        y0s = sweep_solution_vectors(X, th.molwt, grid["T"], p)
+        rhs = make_gas_rhs(gm, th)
+        jac = make_gas_jac(gm, th)
+        obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
+        cfgs = {"T": grid["T"]}
+
+    solve_kw = dict(rtol=rtol, atol=atol, jac=jac, observer=obs,
+                    observer_init=obs0, mesh=mesh,
+                    segment_steps=segment_steps)
+    t_start = time.perf_counter()
+    with ph("solve"):
+        if ckpt_dir:
+            res = checkpointed_sweep(rhs, y0s, 0.0, t1, cfgs, ckpt_dir,
+                                     chunk_size=chunk_size, **solve_kw)
+        else:
+            kw = {k: v for k, v in solve_kw.items() if k != "segment_steps"}
+            res = ensemble_solve_segmented(rhs, y0s, 0.0, t1, cfgs,
+                                           segment_steps=segment_steps, **kw)
+        jax.block_until_ready(res.y)
+    wall = time.perf_counter() - t_start
+
+    tau = np.asarray(res.observed["tau"])
+    status = np.asarray(res.status)
+    report = sweep_report(res, cfgs)
+    log(f"[northstar] B={B} wall={wall:.1f}s -> {B / wall:.2f} cond/s "
+        f"({int((status == SUCCESS).sum())}/{B} ok, "
+        f"{int(np.isnan(tau).sum())} no-ignition)")
+    log("[northstar] phases:\n" + ph.pretty())
+
+    # --- tau parity spot-check against the independent native C++ BDF ----
+    parity = None
+    spot = []
+    if n_spot:
+        from batchreactor_tpu import native
+
+        ign = np.nonzero(~np.isnan(tau) & (status == SUCCESS))[0]
+        idx = ign[np.linspace(0, ign.size - 1, min(n_spot, ign.size))
+                  .astype(int)] if ign.size else []
+        x_np = np.asarray(X)
+        ch4 = sp.index("CH4")
+        with ph("spot_check"):
+            for b in idx:
+                y0b = np.asarray(y0s[b])
+                rn = native.solve_gas_bdf(gm, th, float(grid["T"][b]), y0b,
+                                          0.0, t1, rtol=rtol, atol=atol,
+                                          n_save=100_000)
+                ts = np.concatenate([[0.0], np.asarray(rn.ts)])
+                ys = np.concatenate([y0b[None, :], np.asarray(rn.ys)])
+                thr = 0.5 * y0b[ch4]
+                below = ys[:, ch4] < thr
+                if below.any():
+                    i = int(np.argmax(below))
+                    if i == 0:
+                        tau_n = float(ts[0])
+                    else:  # interpolate the crossing like the observer does
+                        m_a, m_b = ys[i - 1, ch4], ys[i, ch4]
+                        w = (m_a - thr) / (m_a - m_b) if m_a != m_b else 1.0
+                        tau_n = float(ts[i - 1] + w * (ts[i] - ts[i - 1]))
+                else:
+                    tau_n = np.nan
+                rel = abs(tau_n - tau[b]) / tau_n if tau_n else np.nan
+                spot.append({"lane": int(b), "T": float(grid["T"][b]),
+                             "phi": float(grid["phi"][b]),
+                             "tau_tpu": float(tau[b]), "tau_native": tau_n,
+                             "rel_err": float(rel)})
+                log(f"[spot] lane {b}: T={float(grid['T'][b]):.0f} "
+                    f"phi={float(grid['phi'][b]):.2f} "
+                    f"tau={float(tau[b]):.4e} native={tau_n:.4e} "
+                    f"rel={rel:.2%}")
+        parity = max(s["rel_err"] for s in spot) if spot else None
+
+    return {
+        "workload": f"GRI30 {n_T}x{n_phi} TxPhi ignition map, 1 bar, "
+                    f"t1={t1}, rtol={rtol} atol={atol}",
+        "B": int(B),
+        "wall_s": round(wall, 2),
+        "cond_per_s": round(B / wall, 3),
+        "device": jax.default_backend(),
+        "counts": report["counts"],
+        "n_no_ignition": int(np.isnan(tau).sum()),
+        "tau_range_s": [float(np.nanmin(tau)), float(np.nanmax(tau))],
+        "tau_parity_max_rel_err": parity,
+        "spot_checks": spot,
+        "phases_s": {k: round(v, 2) for k, v in ph.summary().items()},
+    }
+
+
+def main():
+    import jax
+
+    if os.environ.get("NORTHSTAR_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    n_T = int(os.environ.get("NORTHSTAR_NT", "64"))
+    n_phi = int(os.environ.get("NORTHSTAR_NPHI", "64"))
+    ckpt = os.environ.get("NORTHSTAR_CKPT", "")
+    rec = run_sweep(n_T=n_T, n_phi=n_phi, ckpt_dir=ckpt or None,
+                    segment_steps=int(os.environ.get("NORTHSTAR_SEG", "256")),
+                    chunk_size=int(os.environ.get("NORTHSTAR_CHUNK", "512")),
+                    log=lambda m: print(m, file=sys.stderr, flush=True))
+    out = os.environ.get("NORTHSTAR_OUT", os.path.join(REPO,
+                                                       "NORTHSTAR.json"))
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

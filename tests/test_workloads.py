@@ -8,6 +8,8 @@ user-defined rate function.  Sizes are kept small for CPU CI; bench.py runs
 the full-scale versions on TPU.
 """
 
+import pathlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -222,3 +224,27 @@ class TestSweepAPI:
         assert out["report"]["counts"]["success"] == 2
         # richer lane makes more water
         assert out["x"]["H2O"][1] > out["x"]["H2O"][0]
+
+
+def test_northstar_sweep_small(gri_lib_dir, tmp_path):
+    """CPU-sized regression of the north-star workload machinery
+    (scripts/northstar_sweep.py): T x phi GRI grid through the checkpointed
+    + segmented sweep, observer tau interpolated, native-BDF parity < 0.1%,
+    and chunk-level resume serving from disk."""
+    import sys
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "scripts"))
+    import northstar_sweep
+
+    rec = northstar_sweep.run_sweep(
+        n_T=3, n_phi=2, T_lo=1700.0, T_hi=2000.0, t1=4e-4,
+        ckpt_dir=str(tmp_path / "ck"), chunk_size=4, segment_steps=512,
+        n_spot=3, log=lambda m: None)
+    assert rec["B"] == 6
+    assert rec["counts"].get("success", 0) == 6
+    assert rec["tau_parity_max_rel_err"] < 1e-3
+    # resume: all chunks on disk -> no device work, same record
+    rec2 = northstar_sweep.run_sweep(
+        n_T=3, n_phi=2, T_lo=1700.0, T_hi=2000.0, t1=4e-4,
+        ckpt_dir=str(tmp_path / "ck"), chunk_size=4, segment_steps=512,
+        n_spot=0, log=lambda m: None)
+    assert rec2["tau_range_s"] == rec["tau_range_s"]
