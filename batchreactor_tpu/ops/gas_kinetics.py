@@ -17,12 +17,37 @@ Rate law (CHEMKIN-II semantics):
   wdot_k = sum_i dnu_ik (ratef_i - rater_i),  dnu = nu_r - nu_f
 """
 
+import os
+
 import jax.numpy as jnp
 
 from ..utils.constants import P_ATM, R
 from .thermo import gibbs_over_RT
 
 _LOG10 = 2.302585092994046
+
+
+def _exp(x):
+    """exp for rate expressions.  BR_EXP32=1 evaluates the transcendental in
+    f32 (carriers stay f64): on TPU, f64 exp is double-double emulation (a
+    long scalar chain per element) while f32 exp is native.  Relative error
+    ~1e-6 on the rate CONSTANTS — far below both mechanism A-factor
+    uncertainty and the 1e-6 rtol the error controller runs at; the RHS and
+    the analytic Jacobian share this function, so Newton consistency holds.
+
+    Arguments span the full +-690 clip window, which f32 exp cannot
+    represent (overflow past ~88.7, flush below ~-87 — a naive cast turns
+    kr = kf * exp(-lnKc) into 0 * inf = NaN for dissociation reactions at
+    low T).  exp(x) = exp(x/8)^8 keeps the f32 argument within +-86.25 over
+    the whole window; the three squarings happen in f64, where e^{+-690} is
+    representable.  Off by default; scripts/perf_probe.py measures it.
+    """
+    if os.environ.get("BR_EXP32") == "1":
+        e = jnp.exp((x * 0.125).astype(jnp.float32)).astype(jnp.float64)
+        e2 = e * e
+        e4 = e2 * e2
+        return e4 * e4
+    return jnp.exp(x)
 # clamps: keep exponentials/logs finite under jacfwd without changing physics.
 # 690 ~ ln(f64 max); physical rate constants in SI units never approach e^690,
 # so the clip only engages on unreachable branches that `where` discards.
@@ -50,7 +75,7 @@ def _arrhenius(T, log_A, beta, Ea):
     """k = exp(ln A + beta ln T - Ea/RT); parameters live in ln domain
     (GasMechanism docstring explains the TPU range rationale)."""
     logk = log_A + beta * jnp.log(T) - Ea / (R * T)
-    return jnp.exp(jnp.clip(logk, -_EXP_MAX, _EXP_MAX))
+    return _exp(jnp.clip(logk, -_EXP_MAX, _EXP_MAX))
 
 
 def _troe_F(T, Pr, troe, has_troe, with_grad=False):
@@ -61,7 +86,7 @@ def _troe_F(T, Pr, troe, has_troe, with_grad=False):
     the 'Jacobian matches jacfwd to roundoff' invariant cannot drift.
     """
     a, T3, T1, T2 = troe[:, 0], troe[:, 1], troe[:, 2], troe[:, 3]
-    Fcent = (1.0 - a) * jnp.exp(-T / T3) + a * jnp.exp(-T / T1) + jnp.exp(-T2 / T)
+    Fcent = (1.0 - a) * _exp(-T / T3) + a * _exp(-T / T1) + _exp(-T2 / T)
     log_fc = jnp.log(jnp.maximum(Fcent, _TINY)) / _LOG10
     c = -0.4 - 0.67 * log_fc
     n = 0.75 - 1.27 * log_fc
@@ -70,7 +95,7 @@ def _troe_F(T, Pr, troe, has_troe, with_grad=False):
     denom = n - 0.14 * (log_pr + c)
     f1 = (log_pr + c) / denom
     one_f1 = 1.0 + f1 * f1
-    F_troe = jnp.exp(_LOG10 * log_fc / one_f1)
+    F_troe = _exp(_LOG10 * log_fc / one_f1)
     F = jnp.where(has_troe > 0, F_troe, 1.0)
     if not with_grad:
         return F
@@ -166,7 +191,7 @@ def reverse_rate_constants(T, kf, gm, thermo, kc_compat=False, log_Kc=None):
         log_Kc = equilibrium_constants(T, gm, thermo, kc_compat)
     # kr = kf/Kc evaluated as kf * exp(-ln Kc); clip keeps the unreachable
     # far-from-equilibrium extreme finite without changing reachable physics
-    kr_eq = gm.rev_mask * kf * jnp.exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
+    kr_eq = gm.rev_mask * kf * _exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
     kr_rev = gm.sign_A_rev * _arrhenius(T, gm.log_A_rev, gm.beta_rev,
                                         gm.Ea_rev)
     return jnp.where(gm.has_rev > 0, kr_rev, kr_eq)
@@ -258,7 +283,7 @@ def production_rates_and_jac(T, conc, gm, thermo, kc_compat=False,
     kr = reverse_rate_constants(T, kf, gm, thermo, kc_compat, log_Kc=log_Kc)
     # equilibrium-derived rows: kr = (rev_mask e^{-lnKc}) kf scales with kf,
     # so dkr/dcM = (kr/kf) dkf/dcM; explicit-REV rows have no cM dependence
-    rKc = gm.rev_mask * jnp.exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
+    rKc = gm.rev_mask * _exp(jnp.clip(-log_Kc, -_EXP_MAX, _EXP_MAX))
     dkr_dcM = jnp.where(gm.has_rev > 0, 0.0, rKc * dkf_dcM)
 
     Pf, dPf = _stoich_prod_and_grad(conc, gm.nu_f, gm.int_stoich)

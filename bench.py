@@ -196,6 +196,7 @@ def rung_main():
         return ensemble_solve_segmented(
             rhs, y0s, 0.0, T1, {"T": T_grid}, rtol=RTOL, atol=ATOL,
             segment_steps=seg_steps, jac=jac,
+            linsolve=os.environ.get("BENCH_LINSOLVE", "auto"),
             observer=obs, observer_init=obs0,
             progress=lambda p: log(f"  segment {p['segment']}: "
                                    f"{p['lanes_done']}/{p['n_lanes']} lanes"))
@@ -229,6 +230,9 @@ def rung_main():
         "mean_steps": float(np.asarray(res.n_accepted).mean()),
         "tau_min": float(np.nanmin(tau)), "tau_max": float(np.nanmax(tau)),
         "n_no_ignition": int(np.isnan(tau).sum()),
+        # full per-lane delays so variant probes can assert tau parity;
+        # NaN (no ignition) maps to null to keep the line RFC-8259 JSON
+        "tau": [None if v != v else round(float(v), 12) for v in tau],
     }))
 
 

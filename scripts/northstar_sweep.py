@@ -142,6 +142,11 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
                     f"phi={float(grid['phi'][b]):.2f} "
                     f"tau={float(tau[b]):.4e} native={tau_n:.4e} "
                     f"rel={rel:.2%}")
+        # a NaN rel_err (native BDF disagreed about ignition itself) must fail
+    # the parity claim loudly, not vanish in max()'s NaN ordering
+    if spot and any(s["rel_err"] != s["rel_err"] for s in spot):
+        parity = float("inf")
+    else:
         parity = max(s["rel_err"] for s in spot) if spot else None
 
     return {

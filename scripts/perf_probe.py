@@ -1,0 +1,122 @@
+"""Measure solver-variant throughput on the live accelerator.
+
+Round-3 analysis (PERF.md): at B=128 a batched SDIRK step attempt costs
+~22 ms on (128, 53) tensors — far below compute limits — so the candidate
+levers are kernel-count and f64-emulation reductions.  This probe measures
+them head-to-head on the bench workload (GRI ignition sweep, B=128,
+t1=8e-4 s, rtol 1e-6 / atol 1e-10), each variant in its own subprocess via
+bench.py's rung mode:
+
+  base     inv32 Newton solve (f32 inverse + f64 refinement), f64 exp
+  nr       inv32nr — drop the two refinement matvecs per Newton iteration
+  exp32    BR_EXP32=1 — rate-expression exponentials evaluated in f32
+  exp32nr  both
+
+Correctness gate: every variant's per-lane ignition delays must match the
+base variant (max rel diff reported; < 1e-3 expected — the variants perturb
+rate constants by ~1e-7 at most).  Results land in PERF_PROBE.json.
+
+Run only on a healthy chip (the probe pre-flights like bench.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+OUT = os.path.join(REPO, "PERF_PROBE.json")
+
+VARIANTS = {
+    "base": {},
+    "nr": {"BENCH_LINSOLVE": "inv32nr"},
+    "exp32": {"BR_EXP32": "1"},
+    "exp32nr": {"BENCH_LINSOLVE": "inv32nr", "BR_EXP32": "1"},
+}
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def child(mode, timeout, extra_env):
+    env = {**os.environ, "BENCH_MODE": mode, **extra_env}
+    proc = subprocess.Popen([sys.executable, BENCH], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.terminate()  # SIGTERM — a SIGKILLed TPU client wedges the chip
+        try:
+            stdout, stderr = proc.communicate(timeout=45)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+        return 124, None, (stderr or "")[-1500:]
+    parsed = None
+    for ln in reversed((stdout or "").strip().splitlines() or [""]):
+        try:
+            parsed = json.loads(ln)
+            break
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return proc.returncode, parsed, (stderr or "")[-1500:]
+
+
+def main():
+    B = os.environ.get("PERF_B", "128")
+    results = {"B": int(B), "t_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
+               "variants": {}}
+
+    log("pre-flight accelerator probe (90s) ...")
+    rc, probe, err = child("probe", 90, {})
+    if rc != 0 or probe is None or probe.get("platform") == "cpu":
+        log(f"chip not healthy (rc={rc}, {probe}); aborting probe")
+        sys.exit(1)
+    log(f"probe ok: {probe}")
+
+    base_tau = None
+    for name, env in VARIANTS.items():
+        log(f"--- variant {name} ({env or 'defaults'})")
+        rc, r, err = child("rung", int(os.environ.get("PERF_TIMEOUT", "1500")),
+                           {"BENCH_B": B, **env})
+        if rc != 0 or r is None:
+            log(f"variant {name} FAILED rc={rc}: {err[-300:]}")
+            results["variants"][name] = {"rc": rc, "error": err[-300:]}
+            with open(OUT, "w") as f:
+                json.dump(results, f, indent=1)
+            continue
+        rec = {"cps": r["cps"], "wall_s": r["wall_s"], "warm_s": r["warm_s"],
+               "n_ok": r["n_ok"], "mean_steps": r["mean_steps"]}
+        tau = r.get("tau")
+        if name == "base":
+            base_tau = tau
+        elif base_tau and tau:
+            # None = no-ignition lane; a variant flipping a lane's ignition
+            # state is a hard correctness regression, not a small drift
+            mismatch = sum((a is None) != (b is None)
+                           for a, b in zip(base_tau, tau))
+            rels = [abs(a - b) / a for a, b in zip(base_tau, tau)
+                    if a is not None and b is not None and a > 0]
+            rec["tau_max_rel_diff_vs_base"] = max(rels) if rels else None
+            rec["tau_ignition_mismatch_lanes"] = mismatch
+            if mismatch:
+                log(f"variant {name}: WARNING {mismatch} lanes flipped "
+                    f"ignition state vs base — correctness regression")
+        results["variants"][name] = rec
+        log(f"variant {name}: {r['cps']} cond/s (wall {r['wall_s']}s, "
+            f"mean steps {r['mean_steps']:.0f})"
+            + (f", tau drift {rec.get('tau_max_rel_diff_vs_base', 0):.2e}"
+               if name != "base" else ""))
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+
+    log(f"wrote {OUT}")
+    print(json.dumps(results["variants"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -207,3 +207,22 @@ def test_frac_stoich_grad_at_zero_conc():
     P2, dP2 = _stoich_prod_and_grad(conc2, nu, False)
     J2 = jax.jacfwd(lambda c: _stoich_prod(c, nu, False))(conc2)
     np.testing.assert_allclose(np.asarray(dP2), np.asarray(J2), rtol=1e-12)
+
+
+def test_exp32_full_clip_window(monkeypatch):
+    """BR_EXP32 path: exp(x) = exp32(x/8)^8 must stay finite and ~1e-6
+    accurate over the whole +-690 clip window (a naive f32 cast overflows
+    past ~88.7 and flushes below ~-87, yielding 0*inf = NaN in kr)."""
+    from batchreactor_tpu.ops.gas_kinetics import _exp
+
+    x = jnp.asarray([-690.0, -124.0, -87.0, 0.0, 87.0, 160.0, 690.0])
+    monkeypatch.setenv("BR_EXP32", "1")
+    got = np.asarray(_exp(x))
+    ref = np.exp(np.asarray(x))
+    assert np.all(np.isfinite(got))
+    np.testing.assert_allclose(got, ref, rtol=5e-6)
+    # product pattern that NaNs under the naive cast: e^-124 * e^160
+    kf = np.asarray(_exp(jnp.asarray(-124.0)))
+    fac = np.asarray(_exp(jnp.asarray(160.0)))
+    assert np.isfinite(kf * fac)
+    np.testing.assert_allclose(kf * fac, np.exp(36.0), rtol=1e-5)
