@@ -41,13 +41,22 @@ def _exp(x):
     low T).  exp(x) = exp(x/8)^8 keeps the f32 argument within +-86.25 over
     the whole window; the three squarings happen in f64, where e^{+-690} is
     representable.  Off by default; scripts/perf_probe.py measures it.
+
+    Read ONCE at import: compiled-executable caches (parallel/sweep.py
+    lru_caches) key on solver arguments, not env vars, so a trace-time read
+    would let an in-process toggle silently serve the stale variant.  Set
+    BR_EXP32 before importing the package (the perf probe uses fresh
+    subprocesses).
     """
-    if os.environ.get("BR_EXP32") == "1":
+    if _EXP32:
         e = jnp.exp((x * 0.125).astype(jnp.float32)).astype(jnp.float64)
         e2 = e * e
         e4 = e2 * e2
         return e4 * e4
     return jnp.exp(x)
+
+
+_EXP32 = os.environ.get("BR_EXP32") == "1"
 # clamps: keep exponentials/logs finite under jacfwd without changing physics.
 # 690 ~ ln(f64 max); physical rate constants in SI units never approach e^690,
 # so the clip only engages on unreachable branches that `where` discards.

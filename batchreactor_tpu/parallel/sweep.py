@@ -66,7 +66,8 @@ def unpad_result(res, B):
 def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
                    dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
-                   observer=None, observer_init=None):
+                   observer=None, observer_init=None, jac_window=1,
+                   newton_tol=0.03):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
@@ -81,7 +82,8 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     a full recompile every call, minutes at GRI scale on TPU.
     """
     jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
-                            dt_min_factor, linsolve, jac, observer)
+                            dt_min_factor, linsolve, jac, observer,
+                            jac_window, newton_tol)
     t0 = jnp.asarray(t0, dtype=y0s.dtype)
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     obs0 = observer_init if observer is not None else 0.0
@@ -99,7 +101,8 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
 
 @functools.lru_cache(maxsize=64)
 def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
-                   linsolve, jac=None, observer=None):
+                   linsolve, jac=None, observer=None, jac_window=1,
+                   newton_tol=0.03):
     """One compiled batched solve per (rhs, solver-settings) combination.
 
     Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
@@ -114,7 +117,8 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
             rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
             n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
             linsolve=linsolve, jac=jac, observer=observer,
-            observer_init=obs0 if observer is not None else None)
+            observer_init=obs0 if observer is not None else None,
+            jac_window=jac_window, newton_tol=newton_tol)
 
     return jax.jit(jax.vmap(one, in_axes=(0, None, None, 0, None)))
 
@@ -137,7 +141,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              progress=None, rtol=1e-6, atol=1e-10,
                              linsolve="auto", jac=None, observer=None,
                              observer_init=None, dt_min_factor=1e-22,
-                             n_save=0, rhs_bundle=None):
+                             n_save=0, rhs_bundle=None, jac_window=1,
+                             newton_tol=0.03):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -189,7 +194,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                                       dt_min_factor, linsolve,
                                       None if rhs_bundle is not None else jac,
                                       observer, seg_save,
-                                      rhs_bundle is not None)
+                                      rhs_bundle is not None, jac_window,
+                                      newton_tol)
     bundle_arg = rhs_bundle if rhs_bundle is not None else 0.0
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     t = jnp.full((B,), t0, dtype=y0s.dtype)
@@ -310,7 +316,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
 @functools.lru_cache(maxsize=64)
 def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
                              linsolve, jac, observer, n_save=0,
-                             bundle_mode=False):
+                             bundle_mode=False, jac_window=1,
+                             newton_tol=0.03):
     """Compiled per-segment batched solve: per-lane t0 and carried-in step
     size are traced operands (vmap axis 0), so every segment reuses one
     executable.  In ``bundle_mode`` the first operand is a mechanism-bundle
@@ -326,7 +333,8 @@ def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
             max_steps=segment_steps, n_save=n_save, dt0=h0, err0=e0,
             dt_min_factor=dt_min_factor, linsolve=linsolve, jac=jac_fn,
             observer=observer,
-            observer_init=obs0 if observer is not None else None)
+            observer_init=obs0 if observer is not None else None,
+            jac_window=jac_window, newton_tol=newton_tol)
 
     return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0)))
 

@@ -90,6 +90,7 @@ def solve(
     observer=None,
     observer_init=None,
     err0=None,
+    jac_window=1,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` from t0 to t1.
 
@@ -115,6 +116,22 @@ def solve(
 
     ``jac(t, y, cfg) -> (n, n)`` supplies an analytic Jacobian (e.g.
     ops.rhs.make_gas_jac); default is ``jax.jacfwd`` of ``rhs``.
+
+    ``jac_window=K`` (K > 1) evaluates the Jacobian once per K step
+    attempts instead of every attempt — CVODE's quasi-constant iteration
+    matrix economy (it holds J for tens of steps).  The iteration matrix
+    M = I - h*gamma*J and its factorization are still rebuilt with the
+    CURRENT h every attempt, so only J itself goes stale; Newton's
+    divergence guard owns the (rare) case where K steps moved the state
+    far enough to matter.  The step-attempt loop then advances in windows
+    of K: lanes that finish mid-window idle for the remainder (their carry
+    held by the per-write ``running`` gate); ``max_steps`` is still
+    enforced exactly, per attempt.  The segmented driver's exact-resume
+    property (a carried-in h/err0 reproducing the monolithic step
+    sequence) holds only for ``jac_window=1``: the window phase resets at
+    segment boundaries, so with K > 1 the refresh cadence — and hence the
+    exact accept/reject sequence — depends on ``segment_steps`` (results
+    remain within tolerance either way).
 
     ``observer(t, y, acc) -> acc`` folds an arbitrary pytree over accepted
     steps (initialized from ``observer_init``), landing in
@@ -215,9 +232,8 @@ def solve(
 
         return solve_m
 
-    def attempt_step(t, y, h):
+    def attempt_step(t, y, h, J):
         """One SDIRK4 step attempt: returns (y_new, err, newton_ok)."""
-        J = jac(t, y)
         M = eye - h * _GAMMA * J
         solve_m = make_solve_m(M)
 
@@ -249,11 +265,18 @@ def solve(
         t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved, obs = carry
         return status == RUNNING
 
-    def body(carry):
+    def step_once(carry, J):
         t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved, obs = carry
+        # running gates every write below, so a terminated lane's carry is
+        # untouched WITHOUT a whole-carry select — masking the (n_save, n)
+        # trajectory buffers per attempt would reintroduce the O(n_save*n)
+        # batched-select trap the row scatter exists to avoid.  In the
+        # monolithic while_loop running is identically True (the loop cond);
+        # it only bites inside a jac_window inner loop.
+        running = status == RUNNING
         h_eff = jnp.minimum(h, t1 - t)
-        y_new, err, ok = attempt_step(t, y, h_eff)
-        accept = ok & (err <= 1.0)
+        y_new, err, ok = attempt_step(t, y, h_eff, J)
+        accept = ok & (err <= 1.0) & running
 
         # PI step-size controller (embedded order 3 -> exponent base 1/4)
         err_c = jnp.maximum(err, 1e-16)
@@ -263,11 +286,12 @@ def solve(
         h_next = jnp.where(ok, h_eff * fac, h_eff * 0.25)
         h_next = jnp.where(accept, jnp.maximum(h_next, span * dt_min_factor), h_next)
 
+        h_next = jnp.where(running, h_next, h)
         t_new = jnp.where(accept, t + h_eff, t)
         y_out = jnp.where(accept, y_new, y)
         err_prev_new = jnp.where(accept, err_c, err_prev)
         n_acc2 = n_acc + accept
-        n_rej2 = n_rej + (~accept)
+        n_rej2 = n_rej + (~accept & running)
 
         # trajectory buffer: record accepted states while capacity remains.
         # The guard select happens on the *row*, not the buffer: a whole-
@@ -300,8 +324,21 @@ def solve(
                 too_small, DT_UNDERFLOW, jnp.where(out_of_steps, MAX_STEPS_REACHED, RUNNING)
             ),
         ).astype(jnp.int32)
+        status2 = jnp.where(running, status2, status)
         return (t_new, y_out, h_next, err_prev_new, status2, n_acc2, n_rej2,
                 ts2, ys2, n_saved2, obs)
+
+    if jac_window == 1:
+        def body(carry):
+            return step_once(carry, jac(carry[0], carry[1]))
+    else:
+        def body(carry):
+            # one Jacobian serves the whole window; a lane that terminates
+            # mid-window idles for the remainder (step_once's `running`
+            # gate holds its carry — no whole-carry select)
+            J = jac(carry[0], carry[1])
+            return lax.fori_loop(0, jac_window,
+                                 lambda _, c: step_once(c, J), carry)
 
     # PI controller memory: a carried-in err0 (segmented resume) reproduces
     # the monolithic step sequence exactly; non-positive means "fresh start"

@@ -197,6 +197,8 @@ def rung_main():
             rhs, y0s, 0.0, T1, {"T": T_grid}, rtol=RTOL, atol=ATOL,
             segment_steps=seg_steps, jac=jac,
             linsolve=os.environ.get("BENCH_LINSOLVE", "auto"),
+            jac_window=int(os.environ.get("BENCH_JAC_WINDOW", "1")),
+            newton_tol=float(os.environ.get("BENCH_NEWTON_TOL", "0.03")),
             observer=obs, observer_init=obs0,
             progress=lambda p: log(f"  segment {p['segment']}: "
                                    f"{p['lanes_done']}/{p['n_lanes']} lanes"))

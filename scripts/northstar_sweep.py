@@ -143,11 +143,11 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
                     f"tau={float(tau[b]):.4e} native={tau_n:.4e} "
                     f"rel={rel:.2%}")
         # a NaN rel_err (native BDF disagreed about ignition itself) must fail
-    # the parity claim loudly, not vanish in max()'s NaN ordering
-    if spot and any(s["rel_err"] != s["rel_err"] for s in spot):
-        parity = float("inf")
-    else:
-        parity = max(s["rel_err"] for s in spot) if spot else None
+    # the parity claim loudly, not vanish in max()'s NaN ordering; None +
+    # a failure count keeps the JSON RFC-8259 (inf/nan are not valid JSON)
+    failed_spots = sum(s["rel_err"] != s["rel_err"] for s in spot)
+    finite = [s["rel_err"] for s in spot if s["rel_err"] == s["rel_err"]]
+    parity = None if failed_spots else (max(finite) if finite else None)
 
     return {
         "workload": f"GRI30 {n_T}x{n_phi} TxPhi ignition map, 1 bar, "
@@ -160,6 +160,8 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
         "n_no_ignition": int(np.isnan(tau).sum()),
         "tau_range_s": [float(np.nanmin(tau)), float(np.nanmax(tau))],
         "tau_parity_max_rel_err": parity,
+        "tau_parity_failed_spots": (sum(s["rel_err"] != s["rel_err"]
+                                        for s in spot) if spot else 0),
         "spot_checks": spot,
         "phases_s": {k: round(v, 2) for k, v in ph.summary().items()},
     }

@@ -34,6 +34,14 @@ VARIANTS = {
     "nr": {"BENCH_LINSOLVE": "inv32nr"},
     "exp32": {"BR_EXP32": "1"},
     "exp32nr": {"BENCH_LINSOLVE": "inv32nr", "BR_EXP32": "1"},
+    # Jacobian held for 4 step attempts (CVODE's quasi-constant iteration
+    # matrix economy; M/inverse stay h-correct every attempt)
+    "jw4": {"BENCH_JAC_WINDOW": "4"},
+    # looser Newton displacement tolerance (CVODE uses ~0.1-0.33)
+    "nt01": {"BENCH_NEWTON_TOL": "0.1"},
+    # the full stack
+    "all": {"BENCH_LINSOLVE": "inv32nr", "BR_EXP32": "1",
+            "BENCH_JAC_WINDOW": "4", "BENCH_NEWTON_TOL": "0.1"},
 }
 
 

@@ -181,3 +181,25 @@ def test_observer_requires_init():
     with pytest.raises(ValueError):
         solve(lambda t, y, cfg: -y, jnp.array([1.0]), 0.0, 1.0, None,
               observer=lambda t, y, a: a)
+
+
+def test_jac_window_matches_every_step():
+    """jac_window=K (stale Jacobian, h-correct iteration matrix) integrates
+    the stiff Robertson problem to the same answer and step counts stay
+    comparable — staleness may cost a few extra Newton rejections at most."""
+
+    def rob(t, y, cfg):
+        k1, k2, k3 = 0.04, 3e7, 1e4
+        d0 = -k1 * y[0] + k3 * y[1] * y[2]
+        d2 = k2 * y[1] * y[1]
+        return jnp.stack([d0, -d0 - d2, d2])
+
+    y0 = jnp.asarray([1.0, 0.0, 0.0])
+    base = solve(rob, y0, 0.0, 1e4, {}, rtol=1e-8, atol=1e-12)
+    assert int(base.status) == SUCCESS
+    for K in (2, 4, 8):
+        r = solve(rob, y0, 0.0, 1e4, {}, rtol=1e-8, atol=1e-12, jac_window=K)
+        assert int(r.status) == SUCCESS, K
+        np.testing.assert_allclose(np.asarray(r.y), np.asarray(base.y),
+                                   rtol=1e-6, atol=1e-14)
+        assert int(r.n_accepted) <= int(base.n_accepted) * 1.5

@@ -241,6 +241,7 @@ def test_northstar_sweep_small(gri_lib_dir, tmp_path):
         n_spot=3, log=lambda m: None)
     assert rec["B"] == 6
     assert rec["counts"].get("success", 0) == 6
+    assert rec["tau_parity_failed_spots"] == 0
     assert rec["tau_parity_max_rel_err"] < 1e-3
     # resume: all chunks on disk -> no device work, same record
     rec2 = northstar_sweep.run_sweep(
