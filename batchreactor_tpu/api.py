@@ -383,17 +383,16 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
 _SWEEP_FNS = {}
 
 
-def _sweep_fns(mode, md, thermo_obj, kc_compat, asv_quirk, marker_idx,
+def _sweep_fns(mode, gm, sm, thermo_obj, kc_compat, asv_quirk, marker_idx,
                ignition_mode):
     from .parallel import ignition_observer
 
-    key = (mode, id(md), id(thermo_obj), kc_compat, asv_quirk, marker_idx,
-           ignition_mode)
+    key = (mode, id(gm), id(sm), id(thermo_obj), kc_compat, asv_quirk,
+           marker_idx, ignition_mode)
     hit = _SWEEP_FNS.get(key)
-    if hit is not None and hit[0] is md and hit[1] is thermo_obj:
-        return hit[2:]
-    gm = md if mode == "gas" else None
-    sm = md if mode == "surf" else None
+    if (hit is not None and hit[0] is gm and hit[1] is sm
+            and hit[2] is thermo_obj):
+        return hit[3:]
     rhs = _make_rhs(mode, None, gm, sm, thermo_obj, kc_compat, asv_quirk)
     jac = _make_jac(mode, gm, sm, thermo_obj, kc_compat, asv_quirk)
     observer = obs0 = None
@@ -401,12 +400,13 @@ def _sweep_fns(mode, md, thermo_obj, kc_compat, asv_quirk, marker_idx,
         observer, obs0 = ignition_observer(marker_idx, mode=ignition_mode)
     if len(_SWEEP_FNS) >= 64:
         _SWEEP_FNS.pop(next(iter(_SWEEP_FNS)))
-    _SWEEP_FNS[key] = (md, thermo_obj, rhs, jac, observer, obs0)
+    _SWEEP_FNS[key] = (gm, sm, thermo_obj, rhs, jac, observer, obs0)
     return rhs, jac, observer, obs0
 
 
 def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
-                        md=None, Asv=1.0, mesh=None, rtol=1e-6, atol=1e-10,
+                        md=None, gmd=None, smd=None, Asv=1.0, mesh=None,
+                        rtol=1e-6, atol=1e-10,
                         max_steps=200_000, segment_steps=0, kc_compat=False,
                         asv_quirk=True, ignition_marker=None,
                         ignition_mode="half"):
@@ -425,20 +425,49 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     /root/reference/src/BatchReactor.jl:210); this is the TPU-native scaling
     surface (BASELINE.md workloads).  ``segment_steps > 0`` bounds each
     device launch and continues on host (parallel.ensemble_solve_segmented).
+
+    Chemistry modes: gas (``md=`` or ``gmd=``), surface (``md=`` or
+    ``smd=``), or coupled gas+surf (``gmd=`` AND ``smd=`` with both chem
+    flags — e.g. the catalyst-loading Asv sweep on the batch_gas_and_surf
+    workload).  Coupled mode is net-new relative to the reference's
+    programmatic form, whose params collision forbids it (SURVEY.md §3.3).
     """
     from .parallel import (ensemble_solve, ensemble_solve_segmented,
                            sweep_report)
     from .parallel.grid import sweep_solution_vectors
     from .parallel.sweep import pad_to_mesh, unpad_result
 
-    if chem is None or thermo_obj is None or md is None:
-        raise TypeError("batch_reactor_sweep needs chem=, thermo_obj=, md=")
+    if chem is None or thermo_obj is None:
+        raise TypeError("batch_reactor_sweep needs chem= and thermo_obj=")
     if chem.surfchem and chem.gaschem:
-        raise ValueError("sweep API supports exactly one of surfchem/gaschem "
-                         "per call (as the programmatic reference form does)")
+        # coupled mode (net-new vs the reference's programmatic form, whose
+        # params collision forbids it — SURVEY.md §3.3): both mechanisms
+        # come in explicitly
+        if gmd is None or smd is None:
+            raise TypeError("coupled gas+surf sweep needs gmd= (gas "
+                            "mechanism) and smd= (surface mechanism)")
+        if tuple(gmd.species) != tuple(thermo_obj.species):
+            # the y0 gas block is laid out over thermo_obj.species while the
+            # RHS slices at gmd.n_species — a mismatch would die deep in jit
+            # tracing (or worse, silently misalign if shapes coincide)
+            raise ValueError(
+                "gmd.species and thermo_obj.species must match in order: "
+                f"{list(gmd.species)[:4]}... vs "
+                f"{list(thermo_obj.species)[:4]}...")
+        mode, gm, sm, covg0 = "gas+surf", gmd, smd, smd.ini_covg
+    elif chem.surfchem:
+        sm = smd if smd is not None else md
+        if sm is None:
+            raise TypeError("surface sweep needs md= or smd=")
+        mode, gm, covg0 = "surf", None, sm.ini_covg
+    elif chem.gaschem:
+        gm = gmd if gmd is not None else md
+        if gm is None:
+            raise TypeError("gas sweep needs md= or gmd=")
+        mode, sm, covg0 = "gas", None, None
+    else:
+        raise ValueError("batch_reactor_sweep needs surfchem and/or gaschem")
     species = thermo_obj.species
-    mode = "surf" if chem.surfchem else "gas"
-    covg0 = md.ini_covg if chem.surfchem else None
 
     T = jnp.atleast_1d(jnp.asarray(T, dtype=jnp.float64))
     Asv = jnp.asarray(Asv, dtype=jnp.float64)
@@ -466,8 +495,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
             raise KeyError(f"ignition_marker {ignition_marker!r} not in "
                            f"species list")
         marker_idx = idx[key]
-    rhs, jac, observer, obs0 = _sweep_fns(mode, md, thermo_obj, kc_compat,
-                                          asv_quirk, marker_idx,
+    rhs, jac, observer, obs0 = _sweep_fns(mode, gm, sm, thermo_obj,
+                                          kc_compat, asv_quirk, marker_idx,
                                           ignition_mode)
 
     if mesh is not None:
