@@ -456,11 +456,19 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                 f"{list(thermo_obj.species)[:4]}...")
         mode, gm, sm, covg0 = "gas+surf", gmd, smd, smd.ini_covg
     elif chem.surfchem:
+        if gmd is not None:
+            raise TypeError("gmd= passed without chem.gaschem — a silently "
+                            "ignored gas mechanism would make this a "
+                            "surface-only run; set gaschem=True for coupled")
         sm = smd if smd is not None else md
         if sm is None:
             raise TypeError("surface sweep needs md= or smd=")
         mode, gm, covg0 = "surf", None, sm.ini_covg
     elif chem.gaschem:
+        if smd is not None:
+            raise TypeError("smd= passed without chem.surfchem — a silently "
+                            "ignored surface mechanism would make this a "
+                            "gas-only run; set surfchem=True for coupled")
         gm = gmd if gmd is not None else md
         if gm is None:
             raise TypeError("gas sweep needs md= or gmd=")

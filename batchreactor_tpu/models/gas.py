@@ -21,9 +21,15 @@ Supported mechanism features (everything the reference's fixtures exercise):
     to be DUPLICATE-marked and we enforce that)
   * ``REV /A beta Ea/`` explicit reverse Arrhenius parameters (reverse rate
     from the given parameters instead of the equilibrium constant)
+  * ``PLOG /p A beta Ea/`` pressure-dependent rates (piecewise-linear
+    interpolation of ln k in ln p between per-pressure Arrhenius fits,
+    clamped to the table ends; p in atm).  The reactor's pressure is
+    algebraic in the state (p = sum(c) R T), so the kernel recovers it
+    from the concentration vector — no extra state.  Duplicate pressure
+    points and PLOG-on-falloff/third-body rows are loud errors.
 
-PLOG and CHEB pressure tables remain loud NotImplementedErrors — nothing in
-the reference stack exercises them.
+CHEB pressure tables remain loud NotImplementedErrors — nothing in the
+reference stack exercises them.
 
 Everything is converted to SI at parse time: A -> (m^3/mol)^(n-1)/s, Ea ->
 J/mol, so the device kernels never see unit conversions.
@@ -38,7 +44,8 @@ from ..utils.constants import CAL_TO_J, R
 from ..utils.pytree import pytree_dataclass
 
 
-@pytree_dataclass(meta_fields=("species", "equations", "int_stoich"))
+@pytree_dataclass(meta_fields=("species", "equations", "int_stoich",
+                               "any_plog"))
 class GasMechanism:
     """Frozen tensor bundle for gas-phase kinetics (R reactions, S species).
 
@@ -71,9 +78,16 @@ class GasMechanism:
     beta_rev: jnp.ndarray    # (R,)
     Ea_rev: jnp.ndarray      # (R,) J/mol
     sign_A_rev: jnp.ndarray  # (R,) +-1
+    has_plog: jnp.ndarray    # (R,) 1.0 where PLOG table attached
+    plog_lnp: jnp.ndarray    # (R, P) ln(p/Pa) grid, +inf padded
+    plog_logA: jnp.ndarray   # (R, P) ln A (SI), _LOG_ZERO padded
+    plog_beta: jnp.ndarray   # (R, P)
+    plog_Ea: jnp.ndarray     # (R, P) J/mol
     species: tuple
     equations: tuple
     int_stoich: bool
+    any_plog: bool = False   # static: mechanisms without PLOG compile the
+                             # exact pre-PLOG program (no interp kernels)
 
     @property
     def n_species(self):
@@ -105,7 +119,7 @@ class _Rxn:
     __slots__ = (
         "equation", "reactants", "products", "A", "beta", "Ea", "reversible",
         "third_body", "falloff", "collider", "eff", "low", "troe", "duplicate",
-        "rev",
+        "rev", "plog",
     )
 
     def __init__(self):
@@ -117,6 +131,7 @@ class _Rxn:
         self.collider = None
         self.duplicate = False
         self.rev = None
+        self.plog = None
 
 
 def _parse_side(side):
@@ -223,7 +238,20 @@ def _parse_reaction_line(line, rxns, e_factor):
                              f"{line!r}")
         rxns[-1].rev = (nums[0], nums[1], nums[2] * e_factor)
         return
-    if up.startswith("PLOG") or up.startswith("CHEB"):
+    if up.startswith("PLOG"):
+        # PLOG /p A beta Ea/ — one rate point at pressure p [atm]
+        nums = [_tofloat(t) for t in re.findall(r"[-+0-9.EeDd]+", line[4:])
+                if _is_number(t)]
+        if len(nums) != 4:
+            raise ValueError(f"PLOG needs exactly 4 numbers: {line!r}")
+        if not rxns:
+            raise ValueError(f"PLOG without a preceding reaction: {line!r}")
+        if rxns[-1].plog is None:
+            rxns[-1].plog = []
+        rxns[-1].plog.append((nums[0], nums[1], nums[2],
+                              nums[3] * e_factor))
+        return
+    if up.startswith("CHEB"):
         raise NotImplementedError(f"auxiliary keyword not supported: {line}")
     # reaction line iff it contains '=' and ends with 3 numeric tokens
     toks = line.split()
@@ -296,6 +324,14 @@ def compile_gaschemistry(mech_file):
     beta_rev = np.zeros(Rn)
     Ea_rev = np.zeros(Rn)
     sign_A_rev = np.ones(Rn)
+    P_max = max((len(r.plog) for r in rxns if r.plog), default=1)
+    has_plog = np.zeros(Rn)
+    # pad: +inf pressures never selected by the interval search; padded
+    # Arrhenius slots are _LOG_ZERO (never read — interp index is clamped)
+    plog_lnp = np.full((Rn, P_max), np.inf)
+    plog_logA = np.full((Rn, P_max), _LOG_ZERO)
+    plog_beta = np.zeros((Rn, P_max))
+    plog_Ea = np.zeros((Rn, P_max))
     equations = []
 
     for i, rxn in enumerate(rxns):
@@ -354,6 +390,32 @@ def compile_gaschemistry(mech_file):
                 order_r + (1 if rxn.third_body else 0) - 1) * np.log(1e-6)
             beta_rev[i] = b_r
             Ea_rev[i] = ea_r
+        if rxn.plog is not None:
+            if rxn.falloff or rxn.third_body:
+                raise ValueError(
+                    f"PLOG cannot combine with falloff/third-body: "
+                    f"{rxn.equation!r}")
+            if rxn.rev is not None:
+                raise NotImplementedError(
+                    f"PLOG with REV unsupported: {rxn.equation!r}")
+            if len(rxn.plog) < 2:
+                raise ValueError(
+                    f"PLOG needs >= 2 pressure points: {rxn.equation!r}")
+            pts = sorted(rxn.plog, key=lambda q: q[0])
+            ps = [q[0] for q in pts]
+            if len(set(ps)) != len(ps):
+                raise NotImplementedError(
+                    f"duplicate PLOG pressure points (summed-rate form) "
+                    f"unsupported: {rxn.equation!r}")
+            if any(q[1] <= 0 for q in pts):
+                raise ValueError(
+                    f"non-positive PLOG pre-exponential: {rxn.equation!r}")
+            has_plog[i] = 1.0
+            for j, (p_atm, A_j, b_j, ea_j) in enumerate(pts):
+                plog_lnp[i, j] = np.log(p_atm * 101325.0)  # atm -> ln(Pa)
+                plog_logA[i, j] = np.log(A_j) + (order - 1) * np.log(1e-6)
+                plog_beta[i, j] = b_j
+                plog_Ea[i, j] = ea_j
         has_tb[i] = 1.0 if rxn.third_body else 0.0
         if rxn.third_body or (rxn.falloff and rxn.collider is None):
             for name, val in rxn.eff.items():
@@ -404,7 +466,13 @@ def compile_gaschemistry(mech_file):
         beta_rev=jnp.asarray(beta_rev),
         Ea_rev=jnp.asarray(Ea_rev),
         sign_A_rev=jnp.asarray(sign_A_rev),
+        has_plog=jnp.asarray(has_plog),
+        plog_lnp=jnp.asarray(plog_lnp),
+        plog_logA=jnp.asarray(plog_logA),
+        plog_beta=jnp.asarray(plog_beta),
+        plog_Ea=jnp.asarray(plog_Ea),
         species=tuple(species),
         equations=tuple(equations),
         int_stoich=int_stoich,
+        any_plog=bool(has_plog.any()),
     )

@@ -54,6 +54,12 @@ class _BrGasMech(ctypes.Structure):
         ("beta_rev", ctypes.POINTER(ctypes.c_double)),
         ("Ea_rev", ctypes.POINTER(ctypes.c_double)),
         ("sign_A_rev", ctypes.POINTER(ctypes.c_double)),
+        ("plog_P", ctypes.c_int64),
+        ("has_plog", ctypes.POINTER(ctypes.c_double)),
+        ("plog_lnp", ctypes.POINTER(ctypes.c_double)),
+        ("plog_logA", ctypes.POINTER(ctypes.c_double)),
+        ("plog_beta", ctypes.POINTER(ctypes.c_double)),
+        ("plog_Ea", ctypes.POINTER(ctypes.c_double)),
         ("coeffs", ctypes.POINTER(ctypes.c_double)),
         ("T_mid", ctypes.POINTER(ctypes.c_double)),
         ("molwt", ctypes.POINTER(ctypes.c_double)),
@@ -191,12 +197,16 @@ def _pack_mech(gm, thermo, kc_compat):
         ("rev_mask", gm.rev_mask), ("sign_A", gm.sign_A),
         ("has_rev", gm.has_rev), ("log_A_rev", gm.log_A_rev),
         ("beta_rev", gm.beta_rev), ("Ea_rev", gm.Ea_rev),
-        ("sign_A_rev", gm.sign_A_rev), ("coeffs", thermo.coeffs),
+        ("sign_A_rev", gm.sign_A_rev), ("has_plog", gm.has_plog),
+        ("plog_lnp", gm.plog_lnp), ("plog_logA", gm.plog_logA),
+        ("plog_beta", gm.plog_beta), ("plog_Ea", gm.plog_Ea),
+        ("coeffs", thermo.coeffs),
         ("T_mid", thermo.T_mid), ("molwt", thermo.molwt),
     ]:
         arr, ptr = _carr(src)
         keep.append(arr)
         setattr(m, field, ptr)
+    m.plog_P = int(gm.plog_lnp.shape[1]) if gm.any_plog else 0
     m.kc_compat = 1 if kc_compat else 0
     m.int_stoich = 1 if gm.int_stoich else 0
     return m, keep

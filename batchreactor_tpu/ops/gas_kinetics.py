@@ -115,6 +115,43 @@ def _troe_F(T, Pr, troe, has_troe, with_grad=False):
     return F, dF_dPr
 
 
+def _plog_interp(T, conc, gm):
+    """PLOG rate interpolation: (ln k (R,), dlnk/dlnp slope (R,), Ctot).
+
+    k(T, p): piecewise-linear in (ln p, ln k) between per-pressure
+    Arrhenius fits, clamped to the table ends (Cantera semantics).  The
+    reactor's pressure is algebraic, p = Ctot R T with Ctot = sum(max(c,0))
+    — the same clamp the falloff collider uses for transient negative
+    Newton iterates.  Rows are +inf/(ln 0) padded to the widest table; the
+    interval search never lands on a pad (idx clamp + w clip), and a ragged
+    row's beyond-table query degrades to the clamped end point exactly.
+    """
+    Ctot = jnp.maximum(jnp.sum(jnp.maximum(conc, 0.0)), _TINY)
+    lnp = jnp.log(Ctot * R * T)
+    lnk_pts = (gm.plog_logA + gm.plog_beta * jnp.log(T)
+               - gm.plog_Ea / (R * T))                       # (R, P)
+    grid = gm.plog_lnp                                        # (R, P)
+    P = grid.shape[1]
+    idx = jnp.clip(jnp.sum(grid <= lnp, axis=1) - 1, 0, max(P - 2, 0))
+    lo = jnp.take_along_axis(grid, idx[:, None], axis=1)[:, 0]
+    hi = jnp.take_along_axis(grid, (idx + 1)[:, None] if P > 1
+                             else idx[:, None], axis=1)[:, 0]
+    klo = jnp.take_along_axis(lnk_pts, idx[:, None], axis=1)[:, 0]
+    khi = jnp.take_along_axis(lnk_pts, (idx + 1)[:, None] if P > 1
+                              else idx[:, None], axis=1)[:, 0]
+    span = hi - lo
+    w_raw = jnp.where(jnp.isfinite(span) & (span > 0),
+                      (lnp - lo) / jnp.where(span > 0, span, 1.0), 0.0)
+    w = jnp.clip(w_raw, 0.0, 1.0)
+    lnk = klo + w * (khi - klo)
+    # slope is live only strictly inside the table (clamped regions are
+    # pressure-independent — matches jacfwd through the clipped forward)
+    inside = (w_raw > 0.0) & (w_raw < 1.0)
+    slope = jnp.where(inside & jnp.isfinite(span) & (span > 0),
+                      (khi - klo) / jnp.where(span > 0, span, 1.0), 0.0)
+    return lnk, slope, Ctot
+
+
 def forward_rate_constants(T, conc, gm, with_grad=False,
                            falloff_compat=False):
     """Effective forward rate constants (R,) including third-body/falloff.
@@ -148,6 +185,10 @@ def forward_rate_constants(T, conc, gm, with_grad=False,
         # is a linear side channel; falloff rows are parse-time positive)
         kf = gm.sign_A * jnp.where(gm.has_falloff > 0, k_inf * L * F * fc,
                                    k_inf)
+        if gm.any_plog:  # static: non-PLOG mechanisms skip the interp
+            lnk, _, _ = _plog_interp(T, conc, gm)
+            kf = jnp.where(gm.has_plog > 0,
+                           _exp(jnp.clip(lnk, -_EXP_MAX, _EXP_MAX)), kf)
         return kf, tb_factor
     F, dF_dPr = _troe_F(T, Pr, gm.troe, gm.has_troe, with_grad=True)
     kf = gm.sign_A * jnp.where(gm.has_falloff > 0, k_inf * L * F * fc, k_inf)
@@ -163,7 +204,15 @@ def forward_rate_constants(T, conc, gm, with_grad=False,
         dkf_dcM = jnp.where((gm.has_falloff > 0) & (cM > 0.0),
                             dkf_dPr * ratio, 0.0)
     dtb_dcM = jnp.where(gm.has_tb > 0, 1.0, 0.0)
-    return kf, tb_factor, dkf_dcM, dtb_dcM
+    if not gm.any_plog:
+        return kf, tb_factor, dkf_dcM, dtb_dcM, None
+    lnk, slope, Ctot = _plog_interp(T, conc, gm)
+    k_plog = _exp(jnp.clip(lnk, -_EXP_MAX, _EXP_MAX))
+    kf = jnp.where(gm.has_plog > 0, k_plog, kf)
+    # p = Ctot R T, so dkf/dc_k = kf * (dlnk/dlnp) / Ctot on positive-c
+    # entries (the caller applies the (conc > 0) indicator chain)
+    dkf_dCtot = jnp.where(gm.has_plog > 0, k_plog * slope / Ctot, 0.0)
+    return kf, tb_factor, dkf_dcM, dtb_dcM, dkf_dCtot
 
 
 def equilibrium_constants(T, gm, thermo, kc_compat=False):
@@ -286,7 +335,7 @@ def production_rates_and_jac(T, conc, gm, thermo, kc_compat=False,
     """
     if falloff_compat is None:
         falloff_compat = kc_compat
-    kf, tb, dkf_dcM, dtb_dcM = forward_rate_constants(
+    kf, tb, dkf_dcM, dtb_dcM, dkf_dCtot = forward_rate_constants(
         T, conc, gm, with_grad=True, falloff_compat=falloff_compat)
     log_Kc = equilibrium_constants(T, gm, thermo, kc_compat)
     kr = reverse_rate_constants(T, kf, gm, thermo, kc_compat, log_Kc=log_Kc)
@@ -304,6 +353,11 @@ def production_rates_and_jac(T, conc, gm, thermo, kc_compat=False,
     #       + (dtb/dcM net + tb (dkf/dcM Pf - dkr/dcM Prp)) eff_jk
     dq = tb[:, None] * (kf[:, None] * dPf - kr[:, None] * dPrp) + (
         dtb_dcM * net + tb * (dkf_dcM * Pf - dkr_dcM * Prp))[:, None] * gm.eff
+    if gm.any_plog:  # static branch
+        # pressure chain: dCtot/dc_k = 1 on positive entries (the forward
+        # path clamps negatives out of Ctot); kr = rKc kf rides along
+        ind = (conc > 0.0).astype(kf.dtype)
+        dq = dq + (tb * dkf_dCtot * (Pf - rKc * Prp))[:, None] * ind[None, :]
 
     dnu = gm.nu_r - gm.nu_f
     return dnu.T @ q, dnu.T @ dq

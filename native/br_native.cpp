@@ -73,6 +73,12 @@ struct BrGasMech {
   const double* beta_rev;    // (R,)
   const double* Ea_rev;      // (R,) J/mol
   const double* sign_A_rev;  // (R,) +-1
+  int64_t plog_P;            // PLOG table width (padded); 0 disables
+  const double* has_plog;    // (R,)
+  const double* plog_lnp;    // (R,P) ln(p/Pa), +inf padded
+  const double* plog_logA;   // (R,P) ln A (SI)
+  const double* plog_beta;   // (R,P)
+  const double* plog_Ea;     // (R,P) J/mol
   const double* coeffs;      // (S,2,7) NASA-7 low/high ranges
   const double* T_mid;       // (S,)
   const double* molwt;       // (S,) kg/mol
@@ -101,6 +107,16 @@ void br_gas_rhs(const BrGasMech* m, double T, const double* y, double* dy) {
   const double rt = kR * T;
   const double log_c0_phys = std::log(kPAtm / rt);
   const double log_c0_ref = std::log(1e5 / rt);
+
+  // loop-invariant PLOG pressure (p = Ctot R T): hundreds of PLOG rows in a
+  // real pressure-dependent mechanism must not each rescan the species
+  double lnp = 0.0;
+  if (m->plog_P > 0) {
+    double Ctot = 0.0;
+    for (int64_t k = 0; k < S; ++k) Ctot += conc[k] > 0 ? conc[k] : 0.0;
+    if (Ctot < kTiny) Ctot = kTiny;
+    lnp = std::log(Ctot * kR * T);
+  }
 
   for (int64_t i = 0; i < R; ++i) {
     const double* nuf = m->nu_f + i * S;
@@ -148,6 +164,28 @@ void br_gas_rhs(const BrGasMech* m, double T, const double* y, double* dy) {
       dn += d;
     }
     kf *= m->sign_A[i];  // negative-A DUPLICATE rows (ln-domain stores |A|)
+
+    if (m->plog_P > 0 && m->has_plog[i] > 0) {
+      // PLOG: piecewise-linear ln k in ln p between per-pressure Arrhenius
+      // fits, clamped at the table ends (mirrors ops/gas_kinetics._plog_interp)
+      const int64_t P = m->plog_P;
+      const double* pg = m->plog_lnp + i * P;
+      int64_t idx = -1;
+      for (int64_t j = 0; j < P; ++j) idx += pg[j] <= lnp ? 1 : 0;
+      if (idx < 0) idx = 0;
+      if (idx > P - 2 && P > 1) idx = P - 2;
+      const int64_t j1 = P > 1 ? idx + 1 : idx;
+      const double lo = pg[idx], hi = pg[j1];
+      auto lnk_at = [&](int64_t j) {
+        return m->plog_logA[i * P + j] + m->plog_beta[i * P + j] * logT -
+               m->plog_Ea[i * P + j] / rt;
+      };
+      const double klo = lnk_at(idx), khi = lnk_at(j1);
+      const double span = hi - lo;
+      double w = (std::isfinite(span) && span > 0) ? (lnp - lo) / span : 0.0;
+      w = w < 0 ? 0.0 : (w > 1 ? 1.0 : w);
+      kf = std::exp(clamp(klo + w * (khi - klo), -kExpMax, kExpMax));
+    }
 
     const double log_c0 =
         m->kc_compat ? log_c0_ref + std::log(1e6) : log_c0_phys;

@@ -228,8 +228,85 @@ def test_rev_and_negA_jacobian_matches_jacfwd(tmp_path, fixtures_dir):
                                atol=1e-10 * float(jnp.abs(J_fd).max()))
 
 
-def test_plog_cheb_still_loud(tmp_path):
-    for kw in ("PLOG /1. 1. 1. 1./", "CHEB /1. 1./"):
-        mech = _mini_mech(tmp_path, f"H2+O2=2OH 1.0E13 0. 0.\n{kw}\n")
-        with pytest.raises(NotImplementedError):
-            br.compile_gaschemistry(mech)
+def test_cheb_still_loud(tmp_path):
+    mech = _mini_mech(tmp_path, "H2+O2=2OH 1.0E13 0. 0.\nCHEB /1. 1./\n")
+    with pytest.raises(NotImplementedError):
+        br.compile_gaschemistry(mech)
+
+
+def test_plog_hand_computed(tmp_path, fixtures_dir):
+    """PLOG: ln k piecewise-linear in ln p between per-pressure Arrhenius
+    fits, clamped at table ends; p recovered from conc (p = sum(c) R T)."""
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import reaction_rates
+    from batchreactor_tpu.utils.constants import CAL_TO_J, R
+
+    mech = _mini_mech(tmp_path,
+                      "H2+O2=>2OH   1.0E13  0.0  1000.\n"
+                      "PLOG / 0.1   1.0E12  0.0  1000. /\n"
+                      "PLOG / 1.0   1.0E13  0.0  1000. /\n"
+                      "PLOG / 10.0  1.0E14  0.0  1000. /\n")
+    gm = br.compile_gaschemistry(mech)
+    assert gm.any_plog and int(np.asarray(gm.has_plog).sum()) == 1
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    T = 1000.0
+
+    def rate_at_pressure(p_atm):
+        # uniform mixture with total concentration matching the pressure
+        Ctot = p_atm * 101325.0 / (R * T)
+        conc = np.zeros(5)
+        conc[0], conc[1], conc[4] = 0.3 * Ctot, 0.2 * Ctot, 0.5 * Ctot
+        q = np.asarray(reaction_rates(T, jnp.asarray(conc), gm, th))
+        return float(q[0]) / (conc[0] * conc[1])  # recover k
+
+    arr = np.exp(-1000.0 * CAL_TO_J / (R * T)) * 1e-6  # shared exp + cgs->SI
+    # on-grid points hit the table values exactly
+    np.testing.assert_allclose(rate_at_pressure(1.0), 1.0e13 * arr, rtol=1e-10)
+    # geometric midpoint p = sqrt(0.1*1.0): ln-linear interp -> sqrt(k1 k2)
+    np.testing.assert_allclose(rate_at_pressure(np.sqrt(0.1)),
+                               np.sqrt(1.0e12 * 1.0e13) * arr, rtol=1e-10)
+    # clamped outside the table
+    np.testing.assert_allclose(rate_at_pressure(0.001), 1.0e12 * arr,
+                               rtol=1e-10)
+    np.testing.assert_allclose(rate_at_pressure(100.0), 1.0e14 * arr,
+                               rtol=1e-10)
+
+
+def test_plog_jacobian_matches_jacfwd(tmp_path, fixtures_dir):
+    """The pressure chain (dk/dc_k through Ctot) makes PLOG Jacobians dense
+    in the concentration vector; closed form == jacfwd."""
+    import jax
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import (production_rates,
+                                                   production_rates_and_jac)
+
+    mech = _mini_mech(tmp_path,
+                      "H2+O2=2OH   1.0E13  0.0  1000.\n"
+                      "PLOG / 0.1   1.0E12  0.5  900. /\n"
+                      "PLOG / 1.0   1.0E13  0.2  1100. /\n"
+                      "PLOG / 10.0  1.0E14  0.0  1300. /\n"
+                      "2OH=H2O+O2  1.0E12  0.0  300.\n")
+    gm = br.compile_gaschemistry(mech)
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    T = 1100.0
+    for scale in (0.3, 3.0, 30.0):  # below/inside/inside table intervals
+        conc = jnp.asarray([2.0, 1.5, 0.7, 0.4, 3.0]) * scale
+        _, J = production_rates_and_jac(T, conc, gm, th)
+        J_fd = jax.jacfwd(lambda c: production_rates(T, c, gm, th))(conc)
+        np.testing.assert_allclose(
+            np.asarray(J), np.asarray(J_fd), rtol=1e-10,
+            atol=1e-12 * float(jnp.abs(J_fd).max()))
+
+
+def test_plog_validation(tmp_path):
+    with pytest.raises(ValueError, match="PLOG cannot combine"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2+M=>2OH+M 1.0E13 0. 0.\nPLOG /1. 1.E12 0. 0./\n"
+                      "PLOG /10. 1.E13 0. 0./\n"))
+    with pytest.raises(ValueError, match=">= 2 pressure"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2=>2OH 1.0E13 0. 0.\nPLOG /1. 1.E12 0. 0./\n"))
+    with pytest.raises(NotImplementedError, match="duplicate PLOG"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2=>2OH 1.0E13 0. 0.\nPLOG /1. 1.E12 0. 0./\n"
+                      "PLOG /1. 2.E12 0. 0./\n"))
