@@ -28,8 +28,11 @@ Supported mechanism features (everything the reference's fixtures exercise):
     from the concentration vector — no extra state.  Duplicate pressure
     points and PLOG-on-falloff/third-body rows are loud errors.
 
-CHEB pressure tables remain loud NotImplementedErrors — nothing in the
-reference stack exercises them.
+  * ``CHEB``/``TCHEB``/``PCHEB`` Chebyshev rate tables:
+    log10 k = sum_ij a_ij T_i(Ttil) T_j(Ptil) over Chebyshev polynomials of
+    the scaled inverse temperature and log10 pressure, clamped to the
+    declared (T, P) window; limits default to CHEMKIN's 300-2500 K /
+    0.001-100 atm when TCHEB/PCHEB are omitted.
 
 Everything is converted to SI at parse time: A -> (m^3/mol)^(n-1)/s, Ea ->
 J/mol, so the device kernels never see unit conversions.
@@ -45,7 +48,7 @@ from ..utils.pytree import pytree_dataclass
 
 
 @pytree_dataclass(meta_fields=("species", "equations", "int_stoich",
-                               "any_plog"))
+                               "any_plog", "any_cheb"))
 class GasMechanism:
     """Frozen tensor bundle for gas-phase kinetics (R reactions, S species).
 
@@ -83,11 +86,17 @@ class GasMechanism:
     plog_logA: jnp.ndarray   # (R, P) ln A (SI), _LOG_ZERO padded
     plog_beta: jnp.ndarray   # (R, P)
     plog_Ea: jnp.ndarray     # (R, P) J/mol
+    has_cheb: jnp.ndarray    # (R,) 1.0 where Chebyshev table attached
+    cheb_coef: jnp.ndarray   # (R, NT, NP) a_ij, zero padded
+    cheb_invT: jnp.ndarray   # (R, 2) 1/Tmin, 1/Tmax
+    cheb_logP: jnp.ndarray   # (R, 2) log10(Pmin/Pa), log10(Pmax/Pa)
+    cheb_si_ln: jnp.ndarray  # (R,) ln units factor cgs -> SI
     species: tuple
     equations: tuple
     int_stoich: bool
     any_plog: bool = False   # static: mechanisms without PLOG compile the
                              # exact pre-PLOG program (no interp kernels)
+    any_cheb: bool = False   # static: same economy for Chebyshev tables
 
     @property
     def n_species(self):
@@ -119,7 +128,7 @@ class _Rxn:
     __slots__ = (
         "equation", "reactants", "products", "A", "beta", "Ea", "reversible",
         "third_body", "falloff", "collider", "eff", "low", "troe", "duplicate",
-        "rev", "plog",
+        "rev", "plog", "cheb", "tcheb", "pcheb",
     )
 
     def __init__(self):
@@ -132,6 +141,9 @@ class _Rxn:
         self.duplicate = False
         self.rev = None
         self.plog = None
+        self.cheb = None
+        self.tcheb = None
+        self.pcheb = None
 
 
 def _parse_side(side):
@@ -251,8 +263,25 @@ def _parse_reaction_line(line, rxns, e_factor):
         rxns[-1].plog.append((nums[0], nums[1], nums[2],
                               nums[3] * e_factor))
         return
+    if up.startswith("TCHEB") or up.startswith("PCHEB"):
+        nums = [_tofloat(t) for t in re.findall(r"[-+0-9.EeDd]+", line[5:])
+                if _is_number(t)]
+        if len(nums) != 2 or not rxns:
+            raise ValueError(f"malformed {line!r}")
+        setattr(rxns[-1], "tcheb" if up.startswith("T") else "pcheb",
+                (nums[0], nums[1]))
+        return
     if up.startswith("CHEB"):
-        raise NotImplementedError(f"auxiliary keyword not supported: {line}")
+        # first CHEB line carries N M then coefficients; continuation CHEB
+        # lines carry more coefficients (row-major a_ij)
+        nums = [_tofloat(t) for t in re.findall(r"[-+0-9.EeDd]+", line[4:])
+                if _is_number(t)]
+        if not rxns:
+            raise ValueError(f"CHEB without a preceding reaction: {line!r}")
+        if rxns[-1].cheb is None:
+            rxns[-1].cheb = []
+        rxns[-1].cheb.extend(nums)
+        return
     # reaction line iff it contains '=' and ends with 3 numeric tokens
     toks = line.split()
     if "=" in line and len(toks) >= 4 and all(_is_number(t) for t in toks[-3:]):
@@ -326,6 +355,27 @@ def compile_gaschemistry(mech_file):
     sign_A_rev = np.ones(Rn)
     P_max = max((len(r.plog) for r in rxns if r.plog), default=1)
     has_plog = np.zeros(Rn)
+    cheb_dims = []
+    for r in rxns:
+        if r.cheb:
+            # validate declared dims BEFORE sizing arrays from them: a
+            # malformed/negative/huge N must raise the friendly error, not
+            # IndexError or a multi-GB np.zeros
+            if len(r.cheb) < 2:
+                raise ValueError(f"CHEB needs N M dims: {r.equation!r}")
+            N_, M_ = int(round(r.cheb[0])), int(round(r.cheb[1]))
+            if not (1 <= N_ <= 16 and 1 <= M_ <= 16):
+                raise ValueError(
+                    f"CHEB degree {N_}x{M_} outside the supported 1..16: "
+                    f"{r.equation!r}")
+            cheb_dims.append((N_, M_))
+    NT_max = max((d[0] for d in cheb_dims), default=1)
+    NP_max = max((d[1] for d in cheb_dims), default=1)
+    has_cheb = np.zeros(Rn)
+    cheb_coef = np.zeros((Rn, NT_max, NP_max))
+    cheb_invT = np.tile(np.array([1 / 300.0, 1 / 2500.0]), (Rn, 1))
+    cheb_logP = np.tile(np.array([0.0, 1.0]), (Rn, 1))
+    cheb_si_ln = np.zeros(Rn)
     # pad: +inf pressures never selected by the interval search; padded
     # Arrhenius slots are _LOG_ZERO (never read — interp index is clamped)
     plog_lnp = np.full((Rn, P_max), np.inf)
@@ -417,12 +467,50 @@ def compile_gaschemistry(mech_file):
                 plog_beta[i, j] = b_j
                 plog_Ea[i, j] = ea_j
         has_tb[i] = 1.0 if rxn.third_body else 0.0
-        if rxn.third_body or (rxn.falloff and rxn.collider is None):
+        if rxn.cheb is not None:
+            # Chebyshev reactions: the (+M) is pure notation — k(T,p)
+            # carries the whole pressure dependence, no collider efficiencies
+            if rxn.third_body or rxn.low is not None or rxn.troe is not None:
+                raise ValueError(
+                    f"CHEB cannot combine with +M/LOW/TROE: {rxn.equation!r}")
+            if rxn.collider is not None or rxn.eff:
+                # a (+SP) collider or efficiency lines would silently change
+                # the meaning: CHEB k(T,p) is defined on TOTAL pressure
+                raise ValueError(
+                    f"CHEB with a specific collider/efficiencies is "
+                    f"unsupported (k(T,p) uses total pressure): "
+                    f"{rxn.equation!r}")
+            if rxn.plog is not None:
+                raise ValueError(
+                    f"CHEB and PLOG on one reaction: {rxn.equation!r}")
+            if rxn.rev is not None:
+                raise NotImplementedError(
+                    f"CHEB with REV unsupported: {rxn.equation!r}")
+            # dims were validated (1..16) in the sizing pass above
+            nums = rxn.cheb
+            N, M = int(round(nums[0])), int(round(nums[1]))
+            coefs = nums[2:]
+            if len(coefs) != N * M:
+                raise ValueError(
+                    f"CHEB expects {N}x{M} coefficients, got {len(coefs)}: "
+                    f"{rxn.equation!r}")
+            has_cheb[i] = 1.0
+            cheb_coef[i, :N, :M] = np.asarray(coefs).reshape(N, M)
+            Tmin, Tmax = rxn.tcheb or (300.0, 2500.0)
+            Pmin, Pmax = rxn.pcheb or (0.001, 100.0)  # atm (CHEMKIN default)
+            if not (0 < Tmin < Tmax) or not (0 < Pmin < Pmax):
+                raise ValueError(f"bad TCHEB/PCHEB limits: {rxn.equation!r}")
+            cheb_invT[i] = (1.0 / Tmin, 1.0 / Tmax)
+            cheb_logP[i] = (np.log10(Pmin * 101325.0),
+                            np.log10(Pmax * 101325.0))
+            cheb_si_ln[i] = (order - 1) * np.log(1e-6)
+        if rxn.third_body or (rxn.falloff and rxn.collider is None
+                              and rxn.cheb is None):
             for name, val in rxn.eff.items():
                 if name not in index:
                     raise KeyError(f"unknown collider {name!r} in {rxn.equation}")
                 eff[i, index[name]] = val
-        if rxn.falloff:
+        if rxn.falloff and rxn.cheb is None:
             has_falloff[i] = 1.0
             if rxn.collider is not None:
                 eff[i, :] = 0.0
@@ -471,8 +559,14 @@ def compile_gaschemistry(mech_file):
         plog_logA=jnp.asarray(plog_logA),
         plog_beta=jnp.asarray(plog_beta),
         plog_Ea=jnp.asarray(plog_Ea),
+        has_cheb=jnp.asarray(has_cheb),
+        cheb_coef=jnp.asarray(cheb_coef),
+        cheb_invT=jnp.asarray(cheb_invT),
+        cheb_logP=jnp.asarray(cheb_logP),
+        cheb_si_ln=jnp.asarray(cheb_si_ln),
         species=tuple(species),
         equations=tuple(equations),
         int_stoich=int_stoich,
         any_plog=bool(has_plog.any()),
+        any_cheb=bool(has_cheb.any()),
     )

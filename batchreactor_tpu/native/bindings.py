@@ -60,6 +60,13 @@ class _BrGasMech(ctypes.Structure):
         ("plog_logA", ctypes.POINTER(ctypes.c_double)),
         ("plog_beta", ctypes.POINTER(ctypes.c_double)),
         ("plog_Ea", ctypes.POINTER(ctypes.c_double)),
+        ("cheb_NT", ctypes.c_int64),
+        ("cheb_NP", ctypes.c_int64),
+        ("has_cheb", ctypes.POINTER(ctypes.c_double)),
+        ("cheb_coef", ctypes.POINTER(ctypes.c_double)),
+        ("cheb_invT", ctypes.POINTER(ctypes.c_double)),
+        ("cheb_logP", ctypes.POINTER(ctypes.c_double)),
+        ("cheb_si_ln", ctypes.POINTER(ctypes.c_double)),
         ("coeffs", ctypes.POINTER(ctypes.c_double)),
         ("T_mid", ctypes.POINTER(ctypes.c_double)),
         ("molwt", ctypes.POINTER(ctypes.c_double)),
@@ -200,6 +207,9 @@ def _pack_mech(gm, thermo, kc_compat):
         ("sign_A_rev", gm.sign_A_rev), ("has_plog", gm.has_plog),
         ("plog_lnp", gm.plog_lnp), ("plog_logA", gm.plog_logA),
         ("plog_beta", gm.plog_beta), ("plog_Ea", gm.plog_Ea),
+        ("has_cheb", gm.has_cheb), ("cheb_coef", gm.cheb_coef),
+        ("cheb_invT", gm.cheb_invT), ("cheb_logP", gm.cheb_logP),
+        ("cheb_si_ln", gm.cheb_si_ln),
         ("coeffs", thermo.coeffs),
         ("T_mid", thermo.T_mid), ("molwt", thermo.molwt),
     ]:
@@ -207,6 +217,8 @@ def _pack_mech(gm, thermo, kc_compat):
         keep.append(arr)
         setattr(m, field, ptr)
     m.plog_P = int(gm.plog_lnp.shape[1]) if gm.any_plog else 0
+    m.cheb_NT = int(gm.cheb_coef.shape[1]) if gm.any_cheb else 0
+    m.cheb_NP = int(gm.cheb_coef.shape[2]) if gm.any_cheb else 0
     m.kc_compat = 1 if kc_compat else 0
     m.int_stoich = 1 if gm.int_stoich else 0
     return m, keep

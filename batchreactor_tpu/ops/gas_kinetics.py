@@ -152,6 +152,50 @@ def _plog_interp(T, conc, gm):
     return lnk, slope, Ctot
 
 
+def _cheb_eval(T, conc, gm):
+    """Chebyshev rate tables: (ln k (R,), d ln k / d log10 p (R,), Ctot).
+
+    log10 k = sum_ij a_ij T_i(Ttil) T_j(Ptil) with Ttil the scaled inverse
+    temperature and Ptil the scaled log10 pressure, both clamped to [-1, 1]
+    (rates outside the declared window hold their boundary value, and the
+    pressure derivative vanishes there — matching jacfwd through the
+    clamp).  The polynomial degrees are static (table shapes), so the
+    Chebyshev recurrence unrolls at trace time.
+    """
+    Ctot = jnp.maximum(jnp.sum(jnp.maximum(conc, 0.0)), _TINY)
+    log10p = jnp.log(Ctot * R * T) / _LOG10
+    iT_lo, iT_hi = gm.cheb_invT[:, 0], gm.cheb_invT[:, 1]
+    p_lo, p_hi = gm.cheb_logP[:, 0], gm.cheb_logP[:, 1]
+    Ttil = (2.0 / T - iT_lo - iT_hi) / (iT_hi - iT_lo)
+    Ptil_raw = (2.0 * log10p - p_lo - p_hi) / (p_hi - p_lo)
+    Ttil = jnp.clip(Ttil, -1.0, 1.0)
+    inside_p = (Ptil_raw > -1.0) & (Ptil_raw < 1.0)
+    Ptil = jnp.clip(Ptil_raw, -1.0, 1.0)
+    NT, NP = gm.cheb_coef.shape[1], gm.cheb_coef.shape[2]
+
+    def cheb_basis(x, n):
+        out = [jnp.ones_like(x), x]
+        for _ in range(2, n):
+            out.append(2.0 * x * out[-1] - out[-2])
+        return jnp.stack(out[:n], axis=-1)               # (R, n)
+
+    Tb = cheb_basis(Ttil, max(NT, 2))[:, :NT]            # (R, NT)
+    Pb = cheb_basis(Ptil, max(NP, 2))[:, :NP]            # (R, NP)
+    log10k = jnp.einsum("rij,ri,rj->r", gm.cheb_coef, Tb, Pb)
+    lnk = log10k * _LOG10 + gm.cheb_si_ln
+    # dT_j/dx = j U_{j-1}(x) via the derivative recurrence; unrolled too
+    dPb = [jnp.zeros_like(Ptil), jnp.ones_like(Ptil)]
+    U_prev, U_cur = jnp.ones_like(Ptil), 2.0 * Ptil      # U0, U1
+    for j in range(2, NP):
+        dPb.append(j * U_cur)                            # U_cur == U_{j-1}
+        U_prev, U_cur = U_cur, 2.0 * Ptil * U_cur - U_prev
+    dPb = jnp.stack(dPb[:max(NP, 1)], axis=-1)[:, :NP]   # (R, NP)
+    dlog10k_dPtil = jnp.einsum("rij,ri,rj->r", gm.cheb_coef, Tb, dPb)
+    dlnk_dlog10p = jnp.where(
+        inside_p, dlog10k_dPtil * _LOG10 * 2.0 / (p_hi - p_lo), 0.0)
+    return lnk, dlnk_dlog10p, Ctot
+
+
 def forward_rate_constants(T, conc, gm, with_grad=False,
                            falloff_compat=False):
     """Effective forward rate constants (R,) including third-body/falloff.
@@ -189,6 +233,10 @@ def forward_rate_constants(T, conc, gm, with_grad=False,
             lnk, _, _ = _plog_interp(T, conc, gm)
             kf = jnp.where(gm.has_plog > 0,
                            _exp(jnp.clip(lnk, -_EXP_MAX, _EXP_MAX)), kf)
+        if gm.any_cheb:  # static
+            lnk_c, _, _ = _cheb_eval(T, conc, gm)
+            kf = jnp.where(gm.has_cheb > 0,
+                           _exp(jnp.clip(lnk_c, -_EXP_MAX, _EXP_MAX)), kf)
         return kf, tb_factor
     F, dF_dPr = _troe_F(T, Pr, gm.troe, gm.has_troe, with_grad=True)
     kf = gm.sign_A * jnp.where(gm.has_falloff > 0, k_inf * L * F * fc, k_inf)
@@ -204,14 +252,25 @@ def forward_rate_constants(T, conc, gm, with_grad=False,
         dkf_dcM = jnp.where((gm.has_falloff > 0) & (cM > 0.0),
                             dkf_dPr * ratio, 0.0)
     dtb_dcM = jnp.where(gm.has_tb > 0, 1.0, 0.0)
-    if not gm.any_plog:
+    if not (gm.any_plog or gm.any_cheb):
         return kf, tb_factor, dkf_dcM, dtb_dcM, None
-    lnk, slope, Ctot = _plog_interp(T, conc, gm)
-    k_plog = _exp(jnp.clip(lnk, -_EXP_MAX, _EXP_MAX))
-    kf = jnp.where(gm.has_plog > 0, k_plog, kf)
     # p = Ctot R T, so dkf/dc_k = kf * (dlnk/dlnp) / Ctot on positive-c
     # entries (the caller applies the (conc > 0) indicator chain)
-    dkf_dCtot = jnp.where(gm.has_plog > 0, k_plog * slope / Ctot, 0.0)
+    dkf_dCtot = jnp.zeros_like(kf)
+    if gm.any_plog:
+        lnk, slope, Ctot = _plog_interp(T, conc, gm)
+        k_plog = _exp(jnp.clip(lnk, -_EXP_MAX, _EXP_MAX))
+        kf = jnp.where(gm.has_plog > 0, k_plog, kf)
+        dkf_dCtot = jnp.where(gm.has_plog > 0, k_plog * slope / Ctot,
+                              dkf_dCtot)
+    if gm.any_cheb:
+        lnk_c, dlnk_dlog10p, Ctot = _cheb_eval(T, conc, gm)
+        k_cheb = _exp(jnp.clip(lnk_c, -_EXP_MAX, _EXP_MAX))
+        kf = jnp.where(gm.has_cheb > 0, k_cheb, kf)
+        # dlog10 p / dCtot = 1 / (ln10 Ctot)
+        dkf_dCtot = jnp.where(
+            gm.has_cheb > 0, k_cheb * dlnk_dlog10p / (_LOG10 * Ctot),
+            dkf_dCtot)
     return kf, tb_factor, dkf_dcM, dtb_dcM, dkf_dCtot
 
 
@@ -353,7 +412,7 @@ def production_rates_and_jac(T, conc, gm, thermo, kc_compat=False,
     #       + (dtb/dcM net + tb (dkf/dcM Pf - dkr/dcM Prp)) eff_jk
     dq = tb[:, None] * (kf[:, None] * dPf - kr[:, None] * dPrp) + (
         dtb_dcM * net + tb * (dkf_dcM * Pf - dkr_dcM * Prp))[:, None] * gm.eff
-    if gm.any_plog:  # static branch
+    if gm.any_plog or gm.any_cheb:  # static branch
         # pressure chain: dCtot/dc_k = 1 on positive entries (the forward
         # path clamps negatives out of Ctot); kr = rKc kf rides along
         ind = (conc > 0.0).astype(kf.dtype)

@@ -79,6 +79,13 @@ struct BrGasMech {
   const double* plog_logA;   // (R,P) ln A (SI)
   const double* plog_beta;   // (R,P)
   const double* plog_Ea;     // (R,P) J/mol
+  int64_t cheb_NT;           // Chebyshev table rows (0 disables)
+  int64_t cheb_NP;           // Chebyshev table cols
+  const double* has_cheb;    // (R,)
+  const double* cheb_coef;   // (R,NT,NP)
+  const double* cheb_invT;   // (R,2) 1/Tmin, 1/Tmax
+  const double* cheb_logP;   // (R,2) log10(Pmin/Pa), log10(Pmax/Pa)
+  const double* cheb_si_ln;  // (R,) ln cgs->SI factor
   const double* coeffs;      // (S,2,7) NASA-7 low/high ranges
   const double* T_mid;       // (S,)
   const double* molwt;       // (S,) kg/mol
@@ -108,10 +115,10 @@ void br_gas_rhs(const BrGasMech* m, double T, const double* y, double* dy) {
   const double log_c0_phys = std::log(kPAtm / rt);
   const double log_c0_ref = std::log(1e5 / rt);
 
-  // loop-invariant PLOG pressure (p = Ctot R T): hundreds of PLOG rows in a
-  // real pressure-dependent mechanism must not each rescan the species
+  // loop-invariant PLOG/CHEB pressure (p = Ctot R T): hundreds of
+  // pressure-dependent rows must not each rescan the species
   double lnp = 0.0;
-  if (m->plog_P > 0) {
+  if (m->plog_P > 0 || m->cheb_NT > 0) {
     double Ctot = 0.0;
     for (int64_t k = 0; k < S; ++k) Ctot += conc[k] > 0 ? conc[k] : 0.0;
     if (Ctot < kTiny) Ctot = kTiny;
@@ -185,6 +192,29 @@ void br_gas_rhs(const BrGasMech* m, double T, const double* y, double* dy) {
       double w = (std::isfinite(span) && span > 0) ? (lnp - lo) / span : 0.0;
       w = w < 0 ? 0.0 : (w > 1 ? 1.0 : w);
       kf = std::exp(clamp(klo + w * (khi - klo), -kExpMax, kExpMax));
+    }
+
+    if (m->cheb_NT > 0 && m->has_cheb[i] > 0) {
+      // Chebyshev tables (mirrors ops/gas_kinetics._cheb_eval): log10 k =
+      // sum a_ij T_i(Ttil) T_j(Ptil), window-clamped
+      const double iT_lo = m->cheb_invT[i * 2], iT_hi = m->cheb_invT[i * 2 + 1];
+      const double p_lo = m->cheb_logP[i * 2], p_hi = m->cheb_logP[i * 2 + 1];
+      double Ttil = (2.0 / T - iT_lo - iT_hi) / (iT_hi - iT_lo);
+      double Ptil = (2.0 * lnp / kLog10 - p_lo - p_hi) / (p_hi - p_lo);
+      Ttil = Ttil < -1 ? -1.0 : (Ttil > 1 ? 1.0 : Ttil);
+      Ptil = Ptil < -1 ? -1.0 : (Ptil > 1 ? 1.0 : Ptil);
+      const int64_t NT = m->cheb_NT, NP = m->cheb_NP;
+      double Tb[16], Pb[16];  // parse caps table degrees well below this
+      Tb[0] = 1.0; if (NT > 1) Tb[1] = Ttil;
+      for (int64_t a = 2; a < NT; ++a) Tb[a] = 2.0 * Ttil * Tb[a-1] - Tb[a-2];
+      Pb[0] = 1.0; if (NP > 1) Pb[1] = Ptil;
+      for (int64_t a = 2; a < NP; ++a) Pb[a] = 2.0 * Ptil * Pb[a-1] - Pb[a-2];
+      double log10k = 0.0;
+      const double* c = m->cheb_coef + i * NT * NP;
+      for (int64_t a = 0; a < NT; ++a)
+        for (int64_t b = 0; b < NP; ++b) log10k += c[a * NP + b] * Tb[a] * Pb[b];
+      kf = std::exp(clamp(log10k * kLog10 + m->cheb_si_ln[i],
+                          -kExpMax, kExpMax));
     }
 
     const double log_c0 =

@@ -228,9 +228,9 @@ def test_rev_and_negA_jacobian_matches_jacfwd(tmp_path, fixtures_dir):
                                atol=1e-10 * float(jnp.abs(J_fd).max()))
 
 
-def test_cheb_still_loud(tmp_path):
+def test_malformed_cheb_loud(tmp_path):
     mech = _mini_mech(tmp_path, "H2+O2=2OH 1.0E13 0. 0.\nCHEB /1. 1./\n")
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="coefficients"):
         br.compile_gaschemistry(mech)
 
 
@@ -310,3 +310,95 @@ def test_plog_validation(tmp_path):
         br.compile_gaschemistry(_mini_mech(
             tmp_path, "H2+O2=>2OH 1.0E13 0. 0.\nPLOG /1. 1.E12 0. 0./\n"
                       "PLOG /1. 2.E12 0. 0./\n"))
+
+
+def test_cheb_hand_computed(tmp_path, fixtures_dir):
+    """CHEB: log10 k = sum a_ij T_i(Ttil) T_j(Ptil); hand-computed at window
+    center (Ttil, Ptil = ...) and clamped outside the pressure window."""
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import reaction_rates
+    from batchreactor_tpu.utils.constants import R
+
+    # 2x2 table: log10k = a00 + a01*Ptil + a10*Ttil + a11*Ttil*Ptil
+    mech = _mini_mech(tmp_path,
+                      "H2+O2=>2OH   1.0 0.0 0.0\n"
+                      "TCHEB / 500. 2000. /\n"
+                      "PCHEB / 0.1 10. /\n"
+                      "CHEB / 2 2 8.0 0.5 -0.3 0.1 /\n")
+    gm = br.compile_gaschemistry(mech)
+    assert gm.any_cheb
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+
+    def k_at(T, p_atm):
+        Ctot = p_atm * 101325.0 / (R * T)
+        conc = np.zeros(5)
+        conc[0], conc[1], conc[4] = 0.3 * Ctot, 0.2 * Ctot, 0.5 * Ctot
+        q = np.asarray(reaction_rates(T, jnp.asarray(conc), gm, th))
+        return float(q[0]) / (conc[0] * conc[1])
+
+    def hand(T, p_atm, clampP=True):
+        Ttil = (2.0 / T - 1 / 500.0 - 1 / 2000.0) / (1 / 2000.0 - 1 / 500.0)
+        lo, hi = np.log10(0.1 * 101325.0), np.log10(10.0 * 101325.0)
+        Ptil = (2 * np.log10(p_atm * 101325.0) - lo - hi) / (hi - lo)
+        if clampP:
+            Ptil = np.clip(Ptil, -1, 1)
+        log10k = 8.0 + 0.5 * Ptil - 0.3 * Ttil + 0.1 * Ttil * Ptil
+        return 10.0 ** log10k * 1e-6  # cgs -> SI (bimolecular)
+
+    for T, p in [(1000.0, 1.0), (700.0, 0.3), (1800.0, 5.0)]:
+        np.testing.assert_allclose(k_at(T, p), hand(T, p), rtol=1e-10)
+    # below/above the pressure window: clamped to the boundary value
+    np.testing.assert_allclose(k_at(1000.0, 0.001), hand(1000.0, 0.1),
+                               rtol=1e-10)
+    np.testing.assert_allclose(k_at(1000.0, 100.0), hand(1000.0, 10.0),
+                               rtol=1e-10)
+
+
+def test_cheb_jacobian_matches_jacfwd(tmp_path, fixtures_dir):
+    """Chebyshev pressure chain in the closed-form Jacobian == jacfwd,
+    including a higher-degree table (exercises the T'_j = j U_{j-1}
+    recurrence) and the clamped window edge."""
+    import jax
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import (production_rates,
+                                                   production_rates_and_jac)
+
+    mech = _mini_mech(tmp_path,
+                      "H2+O2=2OH   1.0 0.0 0.0\n"
+                      "TCHEB / 500. 2000. /\n"
+                      "PCHEB / 0.1 10. /\n"
+                      "CHEB / 3 4 7.0 0.5 -0.1 0.05 -0.3 0.1 0.02 -0.01 "
+                      "0.04 -0.02 0.01 0.005 /\n"
+                      "2OH=H2O+O2  1.0E12  0.0  300.\n")
+    gm = br.compile_gaschemistry(mech)
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    T = 1100.0
+    for scale in (1.0, 0.001):  # inside window; clamped below it
+        conc = jnp.asarray([2.0, 1.5, 0.7, 0.4, 3.0]) * scale
+        _, J = production_rates_and_jac(T, conc, gm, th)
+        J_fd = jax.jacfwd(lambda c: production_rates(T, c, gm, th))(conc)
+        np.testing.assert_allclose(
+            np.asarray(J), np.asarray(J_fd), rtol=1e-9,
+            atol=1e-12 * float(jnp.abs(J_fd).max()))
+
+
+def test_cheb_validation(tmp_path):
+    with pytest.raises(ValueError, match="CHEB cannot combine"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2+M=>2OH+M 1.0 0. 0.\nCHEB / 1 1 8.0 /\n"))
+    with pytest.raises(ValueError, match="coefficients"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2=>2OH 1.0 0. 0.\nCHEB / 2 2 8.0 0.5 /\n"))
+
+
+def test_cheb_collider_and_bad_dims_loud(tmp_path):
+    with pytest.raises(ValueError, match="total pressure"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2(+H2O)=2OH(+H2O) 1.0 0. 0.\n"
+                      "CHEB / 1 1 8.0 /\n"))
+    with pytest.raises(ValueError, match="N M dims"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2=>2OH 1.0 0. 0.\nCHEB / 2. /\n"))
+    with pytest.raises(ValueError, match="1..16"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2=>2OH 1.0 0. 0.\nCHEB / 9999999 1 8.0 /\n"))

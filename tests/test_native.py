@@ -269,3 +269,25 @@ def test_gas_rhs_plog_matches_jax(tmp_path, fixtures_dir):
                                {"T": jnp.asarray(1100.0)}))
         d_nat = native.gas_rhs(gm, th, 1100.0, y)
         np.testing.assert_allclose(d_nat, d_jax, rtol=1e-10)
+
+
+def test_gas_rhs_cheb_matches_jax(tmp_path, fixtures_dir):
+    """Chebyshev tables: C++ RHS == JAX RHS inside and outside the window."""
+    p = tmp_path / "cheb.dat"
+    p.write_text(
+        "ELEMENTS\nH O N\nEND\nSPECIES\nH2 O2 OH H2O N2\nEND\nREACTIONS\n"
+        "H2+O2=2OH   1.0 0.0 0.0\n"
+        "TCHEB / 500. 2000. /\n"
+        "PCHEB / 0.1 10. /\n"
+        "CHEB / 3 4 7.0 0.5 -0.1 0.05 -0.3 0.1 0.02 -0.01 "
+        "0.04 -0.02 0.01 0.005 /\n"
+        "2OH=H2O+O2  1.0E12  0.0  300.\nEND\n")
+    gm = br.compile_gaschemistry(str(p))
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    rhs = make_gas_rhs(gm, th)
+    for scale in (0.001, 1.0, 50.0):
+        y = np.array([0.05, 0.4, 0.01, 0.02, 0.6]) * scale
+        d_jax = np.asarray(rhs(0.0, jnp.asarray(y),
+                               {"T": jnp.asarray(1100.0)}))
+        d_nat = native.gas_rhs(gm, th, 1100.0, y)
+        np.testing.assert_allclose(d_nat, d_jax, rtol=1e-10)
