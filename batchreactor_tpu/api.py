@@ -409,7 +409,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         rtol=1e-6, atol=1e-10,
                         max_steps=200_000, segment_steps=0, kc_compat=False,
                         asv_quirk=True, ignition_marker=None,
-                        ignition_mode="half"):
+                        ignition_mode="half", method="sdirk"):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -513,7 +513,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         y0s, cfgs, B = pad_to_mesh(y0s, cfgs, mesh)
 
     common = dict(mesh=mesh, rtol=rtol, atol=atol, jac=jac,
-                  observer=observer, observer_init=obs0)
+                  observer=observer, observer_init=obs0, method=method)
     if segment_steps > 0:
         res = ensemble_solve_segmented(rhs, y0s, 0.0, float(time), cfgs,
                                        segment_steps=segment_steps, **common)

@@ -23,7 +23,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..solver import sdirk
+from ..solver import bdf, sdirk
+
+_SOLVERS = {"sdirk": sdirk.solve, "bdf": bdf.solve}
 
 
 def make_mesh(devices=None, axis="batch"):
@@ -67,7 +69,7 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
                    dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
                    observer=None, observer_init=None, jac_window=1,
-                   newton_tol=0.03):
+                   newton_tol=0.03, method="sdirk"):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
@@ -81,9 +83,10 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     closure per call (e.g. ``ignition_observer(...)`` inside a loop) forces
     a full recompile every call, minutes at GRI scale on TPU.
     """
+    _check_method(method, jac_window, newton_tol)
     jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
                             dt_min_factor, linsolve, jac, observer,
-                            jac_window, newton_tol)
+                            jac_window, newton_tol, method)
     t0 = jnp.asarray(t0, dtype=y0s.dtype)
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     obs0 = observer_init if observer is not None else 0.0
@@ -99,10 +102,21 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     return jitted(y0s, t0, t1, cfgs, obs0)
 
 
+def _check_method(method, jac_window, newton_tol):
+    if method not in _SOLVERS:
+        raise ValueError(f"unknown method {method!r}; use "
+                         f"{sorted(_SOLVERS)}")
+    if method != "sdirk" and (jac_window != 1 or newton_tol != 0.03):
+        # fail loudly instead of silently dropping the sdirk-only knobs
+        raise ValueError(
+            f"jac_window/newton_tol are sdirk-only knobs; method={method!r} "
+            f"got jac_window={jac_window}, newton_tol={newton_tol}")
+
+
 @functools.lru_cache(maxsize=64)
 def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
                    linsolve, jac=None, observer=None, jac_window=1,
-                   newton_tol=0.03):
+                   newton_tol=0.03, method="sdirk"):
     """One compiled batched solve per (rhs, solver-settings) combination.
 
     Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
@@ -113,12 +127,13 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
     """
 
     def one(y0, t0, t1, cfg, obs0):
-        return sdirk.solve(
+        kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
+              if method == "sdirk" else {})
+        return _SOLVERS[method](
             rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
             n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
             linsolve=linsolve, jac=jac, observer=observer,
-            observer_init=obs0 if observer is not None else None,
-            jac_window=jac_window, newton_tol=newton_tol)
+            observer_init=obs0 if observer is not None else None, **kw)
 
     return jax.jit(jax.vmap(one, in_axes=(0, None, None, 0, None)))
 
@@ -142,7 +157,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              linsolve="auto", jac=None, observer=None,
                              observer_init=None, dt_min_factor=1e-22,
                              n_save=0, rhs_bundle=None, jac_window=1,
-                             newton_tol=0.03):
+                             newton_tol=0.03, method="sdirk"):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -190,12 +205,13 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     # a segment can accept at most segment_steps rows, so this buffer never
     # drops a row the host still has capacity for
     seg_save = min(int(n_save), int(segment_steps)) if n_save else 0
+    _check_method(method, jac_window, newton_tol)
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
                                       dt_min_factor, linsolve,
                                       None if rhs_bundle is not None else jac,
                                       observer, seg_save,
                                       rhs_bundle is not None, jac_window,
-                                      newton_tol)
+                                      newton_tol, method)
     bundle_arg = rhs_bundle if rhs_bundle is not None else 0.0
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     t = jnp.full((B,), t0, dtype=y0s.dtype)
@@ -209,6 +225,15 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
             observer_init)
     else:
         obs = jnp.zeros((B,))
+    if method == "bdf":
+        # all-zero difference history = per-lane cold start (bdf.solve)
+        sstate = (jnp.zeros((B, bdf.MAXORD + 3) + y0s.shape[1:],
+                            dtype=y0s.dtype),
+                  jnp.ones((B,), dtype=jnp.int32),
+                  jnp.full((B,), -1.0, dtype=y0s.dtype),
+                  jnp.zeros((B,), dtype=jnp.int32))
+    else:
+        sstate = jnp.zeros((B,), dtype=y0s.dtype)  # unused dummy
     if mesh is not None:
         spec = NamedSharding(mesh, P(axis))
         y = jax.device_put(y, spec)
@@ -217,6 +242,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         e = jax.device_put(e, spec)
         cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
         obs = jax.tree.map(lambda x: jax.device_put(x, spec), obs)
+        sstate = jax.tree.map(lambda x: jax.device_put(x, spec), sstate)
 
     final_status = np.full((B,), int(sdirk.RUNNING), dtype=np.int32)
     final_t = np.full((B,), np.nan)
@@ -227,7 +253,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         all_ys = np.zeros((B, int(n_save)) + y0s.shape[1:])
         saved = np.zeros((B,), dtype=np.int64)
     for seg in range(max_segments):
-        res = jitted(bundle_arg, y, t, t1, cfgs, h, e, obs)
+        res = jitted(bundle_arg, y, t, t1, cfgs, h, e, obs, sstate)
         status = np.asarray(res.status)
         # only lanes still live this segment contribute step counts: parked
         # lanes re-enter as zero-span solves that burn one rejected attempt
@@ -278,6 +304,10 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         # terminated this segment take res.h (their final adapted step size)
         h = jnp.where(jnp.asarray(~running), h, res.h)
         e = jnp.where(jnp.asarray(~running), e, res.err_prev)
+        if method == "bdf":
+            # the multistep history resumes across segments (the zero-span
+            # `already` guard holds parked lanes' carry unchanged)
+            sstate = res.solver_state
         if observer is not None:
             obs = res.observed
         done = not bool(np.any(final_status == int(sdirk.RUNNING)))
@@ -317,26 +347,27 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
 def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
                              linsolve, jac, observer, n_save=0,
                              bundle_mode=False, jac_window=1,
-                             newton_tol=0.03):
+                             newton_tol=0.03, method="sdirk"):
     """Compiled per-segment batched solve: per-lane t0 and carried-in step
     size are traced operands (vmap axis 0), so every segment reuses one
     executable.  In ``bundle_mode`` the first operand is a mechanism-bundle
     pytree (broadcast, not vmapped) and ``rhs`` is a builder."""
 
-    def one(bundle, y0, t0, t1, cfg, h0, e0, obs0):
+    def one(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate):
         if bundle_mode:
             rhs_fn, jac_fn = rhs(bundle)
         else:
             rhs_fn, jac_fn = rhs, jac
-        return sdirk.solve(
+        kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
+              if method == "sdirk" else {"solver_state": sstate})
+        return _SOLVERS[method](
             rhs_fn, y0, t0, t1, cfg, rtol=rtol, atol=atol,
             max_steps=segment_steps, n_save=n_save, dt0=h0, err0=e0,
             dt_min_factor=dt_min_factor, linsolve=linsolve, jac=jac_fn,
             observer=observer,
-            observer_init=obs0 if observer is not None else None,
-            jac_window=jac_window, newton_tol=newton_tol)
+            observer_init=obs0 if observer is not None else None, **kw)
 
-    return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0)))
+    return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0, 0)))
 
 
 def sweep_report(res, cfgs=None):

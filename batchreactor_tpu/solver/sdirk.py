@@ -63,6 +63,8 @@ class SolveResult:
     h: jnp.ndarray = None   # step size the controller would try next
     observed: object = None  # observer fold state (None without observer)
     err_prev: jnp.ndarray = None  # PI controller memory (segmented resume)
+    solver_state: object = None  # opaque multistep carry (solver/bdf.py);
+    #                              None for the single-step SDIRK
 
 
 def _scaled_norm(e, y, rtol, atol):

@@ -199,6 +199,7 @@ def rung_main():
             linsolve=os.environ.get("BENCH_LINSOLVE", "auto"),
             jac_window=int(os.environ.get("BENCH_JAC_WINDOW", "1")),
             newton_tol=float(os.environ.get("BENCH_NEWTON_TOL", "0.03")),
+            method=os.environ.get("BENCH_METHOD", "bdf"),
             observer=obs, observer_init=obs0,
             progress=lambda p: log(f"  segment {p['segment']}: "
                                    f"{p['lanes_done']}/{p['n_lanes']} lanes"))
@@ -289,6 +290,10 @@ def bank_tpu_rung(r):
     to the artifact.  A fingerprint change overwrites unconditionally (the
     old number is for an incomparable workload)."""
     if r.get("platform", "cpu") == "cpu":
+        return
+    if r.get("n_ok", 0) < r.get("B", 1):
+        log(f"not banking rung B={r.get('B')}: only {r.get('n_ok')} lanes "
+            f"succeeded")
         return
     cur = load_tpu_cache()  # None unless same workload fingerprint
     if cur is not None and cur["cps"] >= r["cps"]:

@@ -29,19 +29,28 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 OUT = os.path.join(REPO, "PERF_PROBE.json")
 
+# every variant pins BENCH_METHOD explicitly — bench.py's own default is
+# 'bdf', and an unpinned variant would silently measure the wrong solver
 VARIANTS = {
-    "base": {},
-    "nr": {"BENCH_LINSOLVE": "inv32nr"},
-    "exp32": {"BR_EXP32": "1"},
-    "exp32nr": {"BENCH_LINSOLVE": "inv32nr", "BR_EXP32": "1"},
+    "base": {"BENCH_METHOD": "sdirk"},
+    "nr": {"BENCH_METHOD": "sdirk", "BENCH_LINSOLVE": "inv32nr"},
+    "exp32": {"BENCH_METHOD": "sdirk", "BR_EXP32": "1"},
+    "exp32nr": {"BENCH_METHOD": "sdirk", "BENCH_LINSOLVE": "inv32nr",
+                "BR_EXP32": "1"},
     # Jacobian held for 4 step attempts (CVODE's quasi-constant iteration
     # matrix economy; M/inverse stay h-correct every attempt)
-    "jw4": {"BENCH_JAC_WINDOW": "4"},
+    "jw4": {"BENCH_METHOD": "sdirk", "BENCH_JAC_WINDOW": "4"},
     # looser Newton displacement tolerance (CVODE uses ~0.1-0.33)
-    "nt01": {"BENCH_NEWTON_TOL": "0.1"},
-    # the full stack
-    "all": {"BENCH_LINSOLVE": "inv32nr", "BR_EXP32": "1",
-            "BENCH_JAC_WINDOW": "4", "BENCH_NEWTON_TOL": "0.1"},
+    "nt01": {"BENCH_METHOD": "sdirk", "BENCH_NEWTON_TOL": "0.1"},
+    # the full sdirk stack
+    "all": {"BENCH_METHOD": "sdirk", "BENCH_LINSOLVE": "inv32nr",
+            "BR_EXP32": "1", "BENCH_JAC_WINDOW": "4",
+            "BENCH_NEWTON_TOL": "0.1"},
+    # variable-order BDF (solver/bdf.py): ~2.6x fewer steps and 1 Newton
+    # solve per step vs SDIRK4's five — measured 6x on CPU
+    "bdf": {"BENCH_METHOD": "bdf"},
+    "bdf_exp32nr": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
+                    "BENCH_LINSOLVE": "inv32nr"},
 }
 
 
