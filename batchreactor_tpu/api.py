@@ -37,7 +37,7 @@ from .io.config import input_data, parse_composition_text
 from .io.writers import trim_trajectory, write_profiles
 from .ops.rhs import (make_gas_jac, make_gas_rhs, make_surface_jac,
                       make_surface_rhs, make_udf_rhs)
-from .solver import sdirk
+from .solver import bdf, sdirk
 from .utils.composition import density, mole_to_mass
 
 
@@ -138,9 +138,9 @@ def _segmented_builder(mode, udf, kc_compat, asv_quirk):
 @functools.partial(
     jax.jit,
     static_argnames=("mode", "udf", "kc_compat", "asv_quirk", "n_save",
-                     "max_steps"))
+                     "max_steps", "method"))
 def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
-           n_save, max_steps, kc_compat, asv_quirk):
+           n_save, max_steps, kc_compat, asv_quirk, method="sdirk"):
     """Jitted solve, cache-keyed on the chemistry *mode* rather than a
     per-call rhs closure: mechanism tensor bundles enter as traced pytree
     operands, so repeated calls with any same-shaped mechanism (the
@@ -149,7 +149,10 @@ def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
     # every mechanism-driven mode has a closed-form Jacobian; only UDF
     # falls back to jacfwd inside the solver
     jac = _make_jac(mode, gm, sm, thermo, kc_compat, asv_quirk)
-    return sdirk.solve(
+    if method not in ("sdirk", "bdf"):  # loud, same as the segmented path
+        raise ValueError(f"unknown method {method!r}; use 'sdirk'/'bdf'")
+    solver = bdf.solve if method == "bdf" else sdirk.solve
+    return solver(
         rhs, y0, t0, t1, cfg,
         rtol=rtol, atol=atol, n_save=n_save, max_steps=max_steps, jac=jac,
     )
@@ -188,7 +191,7 @@ def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
 
 def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
                atol, n_save, max_steps, kc_compat, asv_quirk,
-               segmented=None, progress=None):
+               segmented=None, progress=None, method="sdirk"):
     """Dispatch one solve to the requested backend and normalize the result:
     returns (status_str, t_end, y_end, ts, ys, truncated, n_acc, n_rej)
     with ts/ys the saved trajectory *including* the initial row.
@@ -228,14 +231,15 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
             segment_steps=seg_steps,
             max_segments=max(1, -(-int(max_steps) // seg_steps)),
             max_attempts=int(max_steps),
-            rhs_bundle=(gm, sm, thermo), progress=progress)
+            rhs_bundle=(gm, sm, thermo), progress=progress, method=method)
         res = jax.tree.map(
             lambda x: x[0] if hasattr(x, "ndim") and x.ndim >= 1 else x,
             resb)
     else:
         res = _solve(mode, udf, gm, sm, thermo, y0,
                      jnp.asarray(t0), jnp.asarray(t1), cfg,
-                     rtol, atol, n_save, max_steps, kc_compat, asv_quirk)
+                     rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
+                     method=method)
     ts, ys, truncated = trim_trajectory(float(t0), y0, res)
     return (_STATUS.get(int(res.status), "Failure"), float(res.t),
             np.asarray(res.y), ts, ys, truncated, int(res.n_accepted),
@@ -256,7 +260,7 @@ def _mode(chem):
 
 def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
                      max_steps, kc_compat, asv_quirk, verbose, backend,
-                     segmented=None):
+                     segmented=None, method="sdirk"):
     """Core driver: parse XML -> build RHS -> solve -> write profiles
     (reference :152-217)."""
     import sys
@@ -298,7 +302,7 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
         status, t_end, _, ts, ys, truncated, n_acc, n_rej = _run_solve(
             backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
             0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat,
-            asv_quirk, segmented=segmented, progress=prog)
+            asv_quirk, segmented=segmented, progress=prog, method=method)
     if verbose and n_live == 0:
         # ts[0] is the initial row, not an accepted step; a truncated run
         # appends a final-state bridge row that is not an accepted step
@@ -326,7 +330,7 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
 
 def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
                       rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-                      backend, segmented=None):
+                      backend, segmented=None, method="sdirk"):
     """Dict-in/dict-out API (reference :86-147): no files; returns
     ``(accepted_times, {species: final mole fraction})``.
 
@@ -359,7 +363,7 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
     status, t_end, y_end, ts, _, _, _, _ = _run_solve(
         backend, mode, None, gm, sm, thermo_obj, y0, 0.0, float(time), cfg,
         rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-        segmented=segmented)
+        segmented=segmented, method=method)
     if status != "Success":
         # fail loudly: a partial-integration composition is worse than an
         # error for reactor-network callers
@@ -543,7 +547,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   Asv=1.0, chem=None, thermo_obj=None, md=None,
                   rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,
                   kc_compat=False, asv_quirk=True, verbose=True,
-                  backend="jax", segmented=None):
+                  backend="jax", segmented=None, method="sdirk"):
     """Simulate an isothermal constant-volume batch reactor (three forms).
 
     Form 1 — file-driven:   ``batch_reactor(input_file, lib_dir,
@@ -566,6 +570,11 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
     File-driven runs print every accepted step time to the terminal by
     default, exactly like the reference (:401); pass ``verbose=False`` to
     opt out of both the per-step lines and the final summary line.
+
+    ``method`` selects the jax-backend integrator: ``"sdirk"`` (default;
+    L-stable one-step SDIRK4) or ``"bdf"`` (variable-order BDF 1..5, the
+    CVODE family — fewer steps and one Newton solve per step, the fast
+    path for ensemble work; solver/bdf.py).
     """
     if args and isinstance(args[0], dict):
         if len(args) != 4:
@@ -578,7 +587,8 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], args[2], args[3], Asv=Asv, chem=chem,
             thermo_obj=thermo_obj, md=md, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
-            asv_quirk=asv_quirk, backend=backend, segmented=segmented)
+            asv_quirk=asv_quirk, backend=backend, segmented=segmented,
+            method=method)
 
     if len(args) == 3 and callable(args[2]):
         chem = Chemistry(False, False, True, args[2])
@@ -586,7 +596,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
             asv_quirk=asv_quirk, verbose=verbose, backend=backend,
-            segmented=segmented)
+            segmented=segmented, method=method)
 
     if len(args) == 2:
         if chem is None:
@@ -595,6 +605,6 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
             asv_quirk=asv_quirk, verbose=verbose, backend=backend,
-            segmented=segmented)
+            segmented=segmented, method=method)
 
     raise TypeError(f"unrecognized batch_reactor argument pattern: {args!r}")

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .linalg import make_solve_m
 from .sdirk import (DT_UNDERFLOW, MAX_STEPS_REACHED, RUNNING, SUCCESS,
                     SolveResult, _scaled_norm)
 
@@ -131,8 +132,9 @@ def solve(
     else:
         jac = functools.partial(jac, cfg=cfg)
 
-    newton_tol = max(10.0 * 2.220446049250313e-16 / rtol,
-                     min(0.03, rtol ** 0.5))
+    # jnp ops: rtol may be a traced operand (api._solve jits over it)
+    newton_tol = jnp.maximum(10.0 * 2.220446049250313e-16 / rtol,
+                             jnp.minimum(0.03, jnp.sqrt(rtol)))
 
     # ---- initial h (Hairer heuristic, same as sdirk) ----------------------
     f0 = f(t0, y0)
@@ -170,22 +172,6 @@ def solve(
     if (observer is None) != (observer_init is None):
         raise ValueError("observer and observer_init must be given together")
     obs0 = observer_init if observer is not None else jnp.zeros(())
-
-    def make_solve_m(M):
-        if linsolve == "lu":
-            from .linalg import lu_factor, lu_solve
-
-            lu = lu_factor(M)
-            return lambda b: lu_solve(lu, b)
-        Minv = jnp.linalg.inv(M.astype(jnp.float32)).astype(y0.dtype)
-        if linsolve == "inv32nr":
-            return lambda b: Minv @ b
-
-        def solve_m(b):
-            x = Minv @ b
-            return x + Minv @ (b - M @ x)
-
-        return solve_m
 
     def newton(solve_m, t_new, y_pred, psi, c, scale):
         """Solve c f(t_new, y_pred + d) = psi + d; returns (d, converged)."""
@@ -245,7 +231,7 @@ def solve(
 
         J = jac(t_new, y_pred)
         M = eye - c * J
-        solve_m = make_solve_m(M)
+        solve_m = make_solve_m(M, linsolve, y0.dtype)
         d, conv = newton(solve_m, t_new, y_pred, psi, c, scale)
 
         err = _scaled_norm(_ERRC[order] * d, y_pred, rtol, atol)

@@ -76,3 +76,27 @@ def lu_solve(lu_piv, b):
         return x.at[k].set((x[k] - s) / LU[k, k])
 
     return lax.fori_loop(0, n, backward, x)
+
+
+def make_solve_m(M, linsolve, dtype):
+    """Newton linear-solver factory shared by solver/sdirk.py and
+    solver/bdf.py: "lu" (exact f64 pivoted elimination, CPU), "inv32"
+    (native f32 batched inverse + one f64 iterative-refinement pass — the
+    fast TPU path; refinement restores ~f64 accuracy while cond(M) stays
+    below ~1e7), "inv32nr" (no refinement: the inverse only preconditions
+    the quasi-Newton iteration, whose fixed point is solve-accuracy
+    independent)."""
+    import jax.numpy as jnp
+
+    if linsolve == "lu":
+        lu = lu_factor(M)
+        return lambda b: lu_solve(lu, b)
+    Minv = jnp.linalg.inv(M.astype(jnp.float32)).astype(dtype)
+    if linsolve == "inv32nr":
+        return lambda b: Minv @ b
+
+    def solve_m(b):
+        x = Minv @ b
+        return x + Minv @ (b - M @ x)
+
+    return solve_m

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.pytree import pytree_dataclass
-from .linalg import lu_factor, lu_solve
+from .linalg import lu_factor, lu_solve, make_solve_m  # noqa: F401
 
 # --- SDIRK4 tableau (Hairer & Wanner II, Table 6.5; gamma = 1/4) ---
 _GAMMA = 0.25
@@ -208,36 +208,10 @@ def solve(
         z, it, dnorm, converged, diverged = lax.while_loop(cond, body, init)
         return z, converged & jnp.isfinite(dnorm)
 
-    def make_solve_m(M):
-        """Linear solver for M x = b, built once per step attempt."""
-        if linsolve == "lu":
-            lu = lu_factor(M)  # pure-jnp pivoted GE (TPU f64-compatible)
-            return lambda b: lu_solve(lu, b)
-        # inv32: native f32 batched inverse + one f64 refinement pass.  The
-        # f32 inverse carries ~1e-7 relative error; computing the residual
-        # r = b - M x in f64 and correcting once recovers the rest (Newton's
-        # own convergence test owns the failure path past cond(M) ~ 1e7).
-        Minv = jnp.linalg.inv(M.astype(jnp.float32)).astype(y0.dtype)
-        if linsolve == "inv32nr":
-            # no-refinement variant: M only preconditions the quasi-Newton
-            # iteration (the fixed point g(z)=0 is independent of solve
-            # accuracy), so dropping the two refinement matvecs per
-            # iteration trades a ~1e-7 preconditioner error — absorbed by
-            # Newton's own contraction — for a third of the solve kernels.
-            # Ill-conditioned M (cond >~ 1e7) loses the refinement safety
-            # net earlier; the divergence guard + h shrink still owns that.
-            return lambda b: Minv @ b
-
-        def solve_m(b):
-            x = Minv @ b
-            return x + Minv @ (b - M @ x)
-
-        return solve_m
-
     def attempt_step(t, y, h, J):
         """One SDIRK4 step attempt: returns (y_new, err, newton_ok)."""
         M = eye - h * _GAMMA * J
-        solve_m = make_solve_m(M)
+        solve_m = make_solve_m(M, linsolve, y0.dtype)
 
         ks = []
         ok = jnp.array(True)

@@ -174,6 +174,12 @@ def rung_main():
 
     ph = Phases()
     B = int(os.environ.get("BENCH_B", "64"))
+    method = os.environ.get("BENCH_METHOD", "bdf")
+    sdirk_kw = {}
+    if method == "sdirk":
+        sdirk_kw = dict(
+            jac_window=int(os.environ.get("BENCH_JAC_WINDOW", "1")),
+            newton_tol=float(os.environ.get("BENCH_NEWTON_TOL", "0.03")))
     with ph("parse"):
         gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
         th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
@@ -197,9 +203,7 @@ def rung_main():
             rhs, y0s, 0.0, T1, {"T": T_grid}, rtol=RTOL, atol=ATOL,
             segment_steps=seg_steps, jac=jac,
             linsolve=os.environ.get("BENCH_LINSOLVE", "auto"),
-            jac_window=int(os.environ.get("BENCH_JAC_WINDOW", "1")),
-            newton_tol=float(os.environ.get("BENCH_NEWTON_TOL", "0.03")),
-            method=os.environ.get("BENCH_METHOD", "bdf"),
+            method=method, **sdirk_kw,
             observer=obs, observer_init=obs0,
             progress=lambda p: log(f"  segment {p['segment']}: "
                                    f"{p['lanes_done']}/{p['n_lanes']} lanes"))
@@ -225,7 +229,7 @@ def rung_main():
     log(f"[rung B={B}] phases:\n{ph.pretty()}")
     tau = np.asarray(res.observed["tau"])
     print(json.dumps({
-        "B": B, "wall_s": round(wall, 3),
+        "B": B, "method": method, "wall_s": round(wall, 3),
         "cps": round(B / wall, 3),
         "n_ok": n_ok,
         "warm_s": round(t_warm, 1),

@@ -158,3 +158,24 @@ def test_method_validation():
         ensemble_solve(_rob, y0, 0.0, 1.0, {}, method="rk4")
     with pytest.raises(ValueError, match="sdirk-only"):
         ensemble_solve(_rob, y0, 0.0, 1.0, {}, method="bdf", jac_window=4)
+
+
+def test_file_driven_method_bdf(tmp_path, reference_dir, lib_dir, capsys):
+    """batch_reactor(..., method="bdf"): end-to-end file-driven parity with
+    the default solver's final composition."""
+    import csv
+    import shutil
+
+    finals = {}
+    for method in ("sdirk", "bdf"):
+        d = tmp_path / method
+        d.mkdir()
+        shutil.copy(reference_dir / "test" / "batch_h2o2" / "batch.xml",
+                    d / "batch.xml")
+        ret = br.batch_reactor(str(d / "batch.xml"), lib_dir, gaschem=True,
+                               method=method, verbose=False)
+        assert ret == "Success"
+        rows = list(csv.reader(open(d / "gas_profile.csv")))
+        finals[method] = [float(v) for v in rows[-1][4:]]
+    np.testing.assert_allclose(finals["bdf"], finals["sdirk"],
+                               rtol=1e-4, atol=1e-9)
