@@ -179,3 +179,40 @@ def test_file_driven_method_bdf(tmp_path, reference_dir, lib_dir, capsys):
         finals[method] = [float(v) for v in rows[-1][4:]]
     np.testing.assert_allclose(finals["bdf"], finals["sdirk"],
                                rtol=1e-4, atol=1e-9)
+
+
+def test_coupled_gas_surf_golden_parity(gri, reference_dir):
+    """BDF on the coupled GRI + CH4/Ni flagship (10 s horizon): bulk final
+    composition matches the committed golden trajectory like sdirk does —
+    at ~5x fewer accepted steps (measured 823 vs 3848)."""
+    import csv
+
+    from batchreactor_tpu.models.surface import compile_mech
+    from batchreactor_tpu.ops.rhs import make_surface_jac, make_surface_rhs
+
+    gm, th = gri
+    sm = compile_mech(str(reference_dir / "test" / "lib" / "ch4ni.xml"), th,
+                      list(gm.species))
+    sp = list(gm.species)
+    x0 = np.zeros(53)
+    x0[sp.index("CH4")], x0[sp.index("O2")], x0[sp.index("N2")] = .25, .5, .25
+    rho = float(density(jnp.asarray(x0), th.molwt, 1173.0, 1e5))
+    y0 = jnp.concatenate(
+        [mole_to_mass(jnp.asarray(x0), th.molwt) * rho, sm.ini_covg])
+    rhs = make_surface_rhs(sm, th, gm=gm, asv_quirk=True, kc_compat=True)
+    jacf = make_surface_jac(sm, th, gm=gm, asv_quirk=True, kc_compat=True)
+    r = bdf.solve(rhs, y0, 0.0, 10.0, {"T": jnp.asarray(1173.0),
+                                       "Asv": jnp.asarray(1.0)},
+                  rtol=1e-6, atol=1e-10, jac=jacf, max_steps=400_000)
+    assert int(r.status) == SUCCESS
+    assert int(r.n_accepted) < 1500  # sdirk needs ~3850
+    W = np.asarray(th.molwt)
+    xg = np.asarray(r.y)[:53] / W
+    xg /= xg.sum()
+    gold_csv = reference_dir / "test" / "batch_gas_and_surf" / \
+        "gas_profile.csv"
+    rows = list(csv.reader(open(gold_csv)))
+    hdr, last = rows[0], [float(v) for v in rows[-1]]
+    gold = {hdr[i]: last[i] for i in range(len(hdr))}
+    for s in ("H2O", "CO2", "N2"):
+        assert abs(xg[sp.index(s)] - gold[s]) / gold[s] < 2e-3, s
