@@ -14,9 +14,10 @@ The reference exposes one exported name with three Julia methods
 Python has no multiple dispatch, so one ``batch_reactor`` function dispatches
 on the argument pattern (dict first argument -> programmatic; callable third
 argument -> UDF).  Everything device-side is pure JAX: the RHS comes from
-``ops.rhs`` and the integration is the jitted SDIRK4 solve in
-``solver.sdirk`` (the CVODE_BDF replacement), at the reference's tolerances
-reltol=1e-6 / abstol=1e-10 (:210).
+``ops.rhs`` and the integration is a jitted implicit solve — ``method=``
+selects L-stable SDIRK4 (``solver.sdirk``, default) or variable-order
+BDF(1..5) (``solver.bdf``, the CVODE-family fast path) — at the
+reference's tolerances reltol=1e-6 / abstol=1e-10 (:210).
 
 ``sens=True`` reproduces the reference's sensitivity hook (return the
 problem *without* solving, :205-207) — here a :class:`SensitivityProblem`

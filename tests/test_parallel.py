@@ -291,3 +291,23 @@ def test_segmented_n_save_saturates(h2o2):
     np.testing.assert_array_equal(np.asarray(seg.n_saved), [40, 40])
     np.testing.assert_allclose(np.asarray(seg.ts), np.asarray(full.ts),
                                rtol=1e-12)
+
+
+def test_sharded_matches_unsharded_bdf(h2o2):
+    """BDF over the 8-virtual-device mesh == unsharded (method='bdf')."""
+    from batchreactor_tpu.ops.rhs import make_gas_jac
+
+    gm, th, y0 = h2o2
+    rhs = make_gas_rhs(gm, th)
+    jacf = make_gas_jac(gm, th)
+    B = 8
+    y0s = jnp.broadcast_to(y0, (B,) + y0.shape)
+    cfgs = {"T": jnp.linspace(1133.0, 1213.0, B)}
+    kw = dict(rtol=1e-6, atol=1e-10, jac=jacf, method="bdf")
+    r_u = ensemble_solve(rhs, y0s, 0.0, 2e-4, cfgs, **kw)
+    r_s = ensemble_solve(rhs, y0s, 0.0, 2e-4, cfgs, mesh=make_mesh(), **kw)
+    assert np.all(np.asarray(r_u.status) == SUCCESS)
+    np.testing.assert_array_equal(np.asarray(r_s.status),
+                                  np.asarray(r_u.status))
+    np.testing.assert_allclose(np.asarray(r_s.y), np.asarray(r_u.y),
+                               rtol=1e-9, atol=1e-14)
