@@ -9,7 +9,8 @@ Architecture (host -> device):
   host parsers (CHEMKIN / NASA-7 / surface XML / batch XML)
     -> frozen mechanism pytrees of jnp tensors
     -> pure jitted kinetics kernels (thermo, gas rates, surface rates, RHS)
-    -> batched implicit stiff integrator (SDIRK, Newton + LU, vmap-able)
+    -> batched implicit stiff integrators (SDIRK4 and variable-order
+       BDF 1..5, Newton + mixed-precision LU, vmap-able)
     -> mesh-sharded ensemble sweeps (jax.sharding, collective-free)
     -> API layer reproducing the reference's three batch_reactor signatures.
 

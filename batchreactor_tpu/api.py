@@ -436,6 +436,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     flags — e.g. the catalyst-loading Asv sweep on the batch_gas_and_surf
     workload).  Coupled mode is net-new relative to the reference's
     programmatic form, whose params collision forbids it (SURVEY.md §3.3).
+    ``method="bdf"`` selects the variable-order BDF solver (the fast path
+    for sweeps — PERF.md).
     """
     from .parallel import (ensemble_solve, ensemble_solve_segmented,
                            sweep_report)
