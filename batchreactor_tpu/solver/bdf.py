@@ -122,7 +122,13 @@ def solve(
     eye = jnp.eye(n, dtype=y0.dtype)
 
     if linsolve == "auto":
-        linsolve = "lu" if jax.default_backend() == "cpu" else "inv32"
+        # "inv32nr" on accelerators: in a quasi-Newton corrector the f32
+        # inverse only preconditions the iteration — its fixed point is
+        # solve-accuracy independent and the displacement test gates
+        # convergence, so the refinement matvecs buy nothing.  Measured on
+        # TPU (GRI bench, B=256): bit-identical tau and step counts to
+        # "inv32", 18% higher throughput (PERF.md).
+        linsolve = "lu" if jax.default_backend() == "cpu" else "inv32nr"
     if linsolve not in ("lu", "inv32", "inv32nr"):
         raise ValueError(f"unknown linsolve {linsolve!r}")
 

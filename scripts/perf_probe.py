@@ -29,26 +29,38 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 OUT = os.path.join(REPO, "PERF_PROBE.json")
 
-# every variant pins BENCH_METHOD explicitly — bench.py's own default is
-# 'bdf', and an unpinned variant would silently measure the wrong solver
+# every variant pins BENCH_METHOD, BR_EXP32 and BENCH_LINSOLVE explicitly:
+# bench.py's rung mode now DEFAULTS to the winning config (method=bdf,
+# BR_EXP32=1, linsolve auto -> inv32nr on accelerators for BDF), so an
+# unpinned variant would silently measure the lever it claims to isolate
 VARIANTS = {
-    "base": {"BENCH_METHOD": "sdirk"},
-    "nr": {"BENCH_METHOD": "sdirk", "BENCH_LINSOLVE": "inv32nr"},
-    "exp32": {"BENCH_METHOD": "sdirk", "BR_EXP32": "1"},
+    "base": {"BENCH_METHOD": "sdirk", "BR_EXP32": "0",
+             "BENCH_LINSOLVE": "inv32"},
+    "nr": {"BENCH_METHOD": "sdirk", "BR_EXP32": "0",
+           "BENCH_LINSOLVE": "inv32nr"},
+    "exp32": {"BENCH_METHOD": "sdirk", "BR_EXP32": "1",
+              "BENCH_LINSOLVE": "inv32"},
     "exp32nr": {"BENCH_METHOD": "sdirk", "BENCH_LINSOLVE": "inv32nr",
                 "BR_EXP32": "1"},
     # Jacobian held for 4 step attempts (CVODE's quasi-constant iteration
     # matrix economy; M/inverse stay h-correct every attempt)
-    "jw4": {"BENCH_METHOD": "sdirk", "BENCH_JAC_WINDOW": "4"},
+    "jw4": {"BENCH_METHOD": "sdirk", "BR_EXP32": "0",
+            "BENCH_LINSOLVE": "inv32", "BENCH_JAC_WINDOW": "4"},
     # looser Newton displacement tolerance (CVODE uses ~0.1-0.33)
-    "nt01": {"BENCH_METHOD": "sdirk", "BENCH_NEWTON_TOL": "0.1"},
+    "nt01": {"BENCH_METHOD": "sdirk", "BR_EXP32": "0",
+             "BENCH_LINSOLVE": "inv32", "BENCH_NEWTON_TOL": "0.1"},
     # the full sdirk stack
     "all": {"BENCH_METHOD": "sdirk", "BENCH_LINSOLVE": "inv32nr",
             "BR_EXP32": "1", "BENCH_JAC_WINDOW": "4",
             "BENCH_NEWTON_TOL": "0.1"},
     # variable-order BDF (solver/bdf.py): ~2.6x fewer steps and 1 Newton
-    # solve per step vs SDIRK4's five — measured 6x on CPU
-    "bdf": {"BENCH_METHOD": "bdf"},
+    # solve per step vs SDIRK4's five — measured 6x on CPU, and the
+    # measured lever matrix on TPU (PERF.md): inv32nr +18% bit-identical,
+    # exp32 +1.6% at 4.4e-5 tau shift
+    "bdf": {"BENCH_METHOD": "bdf", "BR_EXP32": "0",
+            "BENCH_LINSOLVE": "inv32"},
+    "bdf_nr": {"BENCH_METHOD": "bdf", "BR_EXP32": "0",
+               "BENCH_LINSOLVE": "inv32nr"},
     "bdf_exp32nr": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
                     "BENCH_LINSOLVE": "inv32nr"},
 }
