@@ -122,14 +122,16 @@ def solve(
     eye = jnp.eye(n, dtype=y0.dtype)
 
     if linsolve == "auto":
-        # "inv32nr" on accelerators: in a quasi-Newton corrector the f32
+        # "inv32f" on accelerators: in a quasi-Newton corrector the f32
         # inverse only preconditions the iteration — its fixed point is
         # solve-accuracy independent and the displacement test gates
-        # convergence, so the refinement matvecs buy nothing.  Measured on
-        # TPU (GRI bench, B=256): bit-identical tau and step counts to
-        # "inv32", 18% higher throughput (PERF.md).
-        linsolve = "lu" if jax.default_backend() == "cpu" else "inv32nr"
-    if linsolve not in ("lu", "inv32", "inv32nr"):
+        # convergence — so neither the refinement matvecs nor an f64
+        # application of the preconditioner buy anything.  Measured on TPU
+        # (GRI bench, B=256/384): bit-identical tau and step counts to
+        # "inv32", +18% dropping refinement and +10% more with the f32
+        # matvec (PERF.md).
+        linsolve = "lu" if jax.default_backend() == "cpu" else "inv32f"
+    if linsolve not in ("lu", "inv32", "inv32nr", "inv32f"):
         raise ValueError(f"unknown linsolve {linsolve!r}")
 
     f = functools.partial(rhs, cfg=cfg)

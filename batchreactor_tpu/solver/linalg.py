@@ -85,13 +85,18 @@ def make_solve_m(M, linsolve, dtype):
     fast TPU path; refinement restores ~f64 accuracy while cond(M) stays
     below ~1e7), "inv32nr" (no refinement: the inverse only preconditions
     the quasi-Newton iteration, whose fixed point is solve-accuracy
-    independent)."""
+    independent), "inv32f" (inv32nr with the matvec itself in f32 — the
+    residual and correction are state-scale so f32 range suffices;
+    components under f32-tiny flush to zero 28 orders below atol)."""
     import jax.numpy as jnp
 
     if linsolve == "lu":
         lu = lu_factor(M)
         return lambda b: lu_solve(lu, b)
-    Minv = jnp.linalg.inv(M.astype(jnp.float32)).astype(dtype)
+    Minv32 = jnp.linalg.inv(M.astype(jnp.float32))
+    if linsolve == "inv32f":
+        return lambda b: (Minv32 @ b.astype(jnp.float32)).astype(dtype)
+    Minv = Minv32.astype(dtype)
     if linsolve == "inv32nr":
         return lambda b: Minv @ b
 

@@ -152,9 +152,9 @@ def solve(
 
     if linsolve == "auto":
         linsolve = "lu" if jax.default_backend() == "cpu" else "inv32"
-    if linsolve not in ("lu", "inv32", "inv32nr"):
+    if linsolve not in ("lu", "inv32", "inv32nr", "inv32f"):
         raise ValueError(f"unknown linsolve {linsolve!r}; use "
-                         f"'lu'/'inv32'/'inv32nr'/'auto'")
+                         f"'lu'/'inv32'/'inv32nr'/'inv32f'/'auto'")
 
     f = functools.partial(rhs, cfg=cfg)
     if jac is None:

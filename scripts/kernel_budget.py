@@ -1,0 +1,115 @@
+"""Per-attempt kernel budget: time the BDF step's components on the device.
+
+PERF.md's trace question — where does the ms per batched step attempt go
+under f64 emulation? — answered by timing each component as its own jitted
+program at the bench shape (GRI-3.0, B lanes):
+
+  rhs        one RHS evaluation (B, 53) -> (B, 53)
+  jac        analytic Jacobian (B, 53, 53)
+  inv32      f32 batched inverse of the iteration matrix
+  matvec64   (B, 53, 53) @ (B, 53) in emulated f64  (inv32nr's solve)
+  matvec32   same in native f32                     (inv32f's solve)
+  attempt    one full vmapped BDF step attempt (J + inverse + Newton + err)
+
+Each timing is min-of-5 after a warm-up call (steady-state dispatch, the
+regime the segmented sweep runs in).  Component sum vs the measured
+attempt time shows how much XLA fusion claws back.  Writes
+KERNEL_BUDGET.json and prints it.
+
+Usage: python scripts/kernel_budget.py        # B=384 on the default device
+       KB_B=128 python scripts/kernel_budget.py
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("BR_EXP32", "1")  # the bench configuration
+
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+if not os.path.isdir(LIB):
+    LIB = os.path.join(REPO, "tests", "fixtures")
+
+
+def timed(fn, *args, n=5):
+    """Min-of-n steady-state wall time of a jitted callable (seconds)."""
+    import jax
+
+    jax.block_until_ready(fn(*args))  # warm-up / compile
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+    from batchreactor_tpu.solver import bdf
+    from batchreactor_tpu.utils.composition import density, mole_to_mass
+
+    B = int(os.environ.get("KB_B", "384"))
+    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sp = list(gm.species)
+    S = len(sp)
+    x0 = np.zeros(S)
+    x0[sp.index("CH4")], x0[sp.index("O2")], x0[sp.index("N2")] = .25, .5, .25
+    T = jnp.linspace(1500.0, 2000.0, B)
+    rho = jax.vmap(lambda t: density(jnp.asarray(x0), th.molwt, t, 1e5))(T)
+    ys = rho[:, None] * mole_to_mass(jnp.asarray(x0), th.molwt)[None, :]
+    rhs = make_gas_rhs(gm, th)
+    jacf = make_gas_jac(gm, th)
+
+    rhs_b = jax.jit(jax.vmap(lambda y, t: rhs(0.0, y, {"T": t})))
+    jac_b = jax.jit(jax.vmap(lambda y, t: jacf(0.0, y, {"T": t})))
+    J = jac_b(ys, T)
+    c = jnp.asarray(1e-7)
+    M = jnp.eye(S)[None] - c * J
+    inv_b = jax.jit(lambda m: jnp.linalg.inv(m.astype(jnp.float32)))
+    Minv32 = inv_b(M)
+    Minv64 = Minv32.astype(jnp.float64)
+    mv64 = jax.jit(lambda a, b: jnp.einsum("bij,bj->bi", a, b))
+    mv32 = jax.jit(lambda a, b: jnp.einsum(
+        "bij,bj->bi", a, b.astype(jnp.float32)).astype(jnp.float64))
+
+    def one_attempt(y, t):
+        # the body of one BDF step attempt at order 1, matching the real
+        # per-attempt kernel chain (J + M + inv + Newton loop + error norm)
+        res = bdf.solve(rhs, y, 0.0, 1e-7, {"T": t}, rtol=1e-6, atol=1e-10,
+                        jac=jacf, max_steps=1, n_save=0)
+        return res.y
+
+    att_b = jax.jit(jax.vmap(one_attempt))
+
+    out = {
+        "B": B, "device": jax.default_backend(),
+        "exp32": os.environ.get("BR_EXP32") == "1",
+        "ms": {
+            "rhs": timed(rhs_b, ys, T) * 1e3,
+            "jac": timed(jac_b, ys, T) * 1e3,
+            "inv32": timed(inv_b, M) * 1e3,
+            "matvec64": timed(mv64, Minv64, ys) * 1e3,
+            "matvec32": timed(mv32, Minv32, ys) * 1e3,
+            "attempt": timed(att_b, ys, T) * 1e3,
+        },
+    }
+    out["ms"] = {k: round(v, 3) for k, v in out["ms"].items()}
+    with open(os.path.join(REPO, "KERNEL_BUDGET.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

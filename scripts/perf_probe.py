@@ -63,6 +63,9 @@ VARIANTS = {
                "BENCH_LINSOLVE": "inv32nr"},
     "bdf_exp32nr": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
                     "BENCH_LINSOLVE": "inv32nr"},
+    # the adopted accelerator default (PERF.md): f32 preconditioner matvec
+    "bdf_exp32f": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
+                   "BENCH_LINSOLVE": "inv32f"},
 }
 
 

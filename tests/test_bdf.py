@@ -227,7 +227,7 @@ def test_gri_inv32_linsolve_matches_lu(gri):
     rhs, jacf = make_gas_rhs(gm, th), make_gas_jac(gm, th)
     obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
     taus = {}
-    for ls in ("lu", "inv32", "inv32nr"):
+    for ls in ("lu", "inv32", "inv32nr", "inv32f"):
         r = ensemble_solve(rhs, y0s, 0.0, 8e-4, {"T": T_grid}, method="bdf",
                            rtol=1e-6, atol=1e-10, jac=jacf, linsolve=ls,
                            observer=obs, observer_init=obs0)
@@ -235,6 +235,7 @@ def test_gri_inv32_linsolve_matches_lu(gri):
         taus[ls] = np.asarray(r.observed["tau"])
     np.testing.assert_allclose(taus["inv32"], taus["lu"], rtol=1e-4)
     np.testing.assert_allclose(taus["inv32nr"], taus["lu"], rtol=1e-4)
+    np.testing.assert_allclose(taus["inv32f"], taus["lu"], rtol=1e-4)
 
 
 def test_forward_sensitivity_through_bdf():
