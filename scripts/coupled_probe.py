@@ -1,0 +1,127 @@
+"""Coupled gas+surface TPU throughput probe: the batch_gas_and_surf workload.
+
+The flagship coupled configuration (/root/reference/test/batch_gas_and_surf/
+batch.xml: GRI-Mech 3.0 gas + CH4-on-Ni surface, CH4/O2/N2 = 0.25/0.5/0.25,
+1173 K, 1 bar, 10 s) widened to a B-lane temperature sweep through the
+high-level ``batch_reactor_sweep`` coupled mode (gmd= + smd=) with the
+variable-order BDF solver — the mode the reference's programmatic form
+cannot express at all, and its file form runs one condition per process.
+
+Reports conditions/sec and cross-checks final gas states on a few lanes
+against the independent native C++ BDF (``native.solve_surf_bdf`` with
+gm=), writing COUPLED_TPU.json.
+
+Usage:  python scripts/coupled_probe.py          # B=64 on the default device
+        CP_B=16 CP_T1=1.0 python scripts/coupled_probe.py
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+os.environ.setdefault("BR_EXP32", "1")  # the bench protocol
+
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+if not os.path.isdir(LIB):
+    LIB = os.path.join(REPO, "tests", "fixtures")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.models.surface import compile_mech
+    from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+    from batchreactor_tpu.solver.sdirk import SUCCESS
+    from batchreactor_tpu.utils.profiling import Phases
+
+    B = int(os.environ.get("CP_B", "64"))
+    t1 = float(os.environ.get("CP_T1", "10.0"))
+    Asv = 1.0  # reference batch.xml has no <Asv>; the parser defaults to 1
+    ph = Phases()
+    with ph("parse"):
+        # this workload needs the reference mechanism library (grimech.dat +
+        # ch4ni.xml); the vendored fixtures carry neither, so fail loudly
+        gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+        th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+        sm = compile_mech(f"{LIB}/ch4ni.xml", th, list(gm.species))
+    surf_xml = "ch4ni.xml"
+    T_grid = jnp.linspace(1073.0, 1273.0, B)
+
+    t0 = time.perf_counter()
+    with ph("solve_incl_compile"):
+        out = br.batch_reactor_sweep(
+            {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, T_grid, 1e5, t1,
+            chem=br.Chemistry(surfchem=True, gaschem=True),
+            thermo_obj=th, gmd=gm, smd=sm, Asv=Asv,
+            method="bdf", segment_steps=512)
+    warm = time.perf_counter() - t0
+    # second run = steady-state timing (compile cached)
+    t0 = time.perf_counter()
+    with ph("solve"):
+        out = br.batch_reactor_sweep(
+            {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, T_grid, 1e5, t1,
+            chem=br.Chemistry(surfchem=True, gaschem=True),
+            thermo_obj=th, gmd=gm, smd=sm, Asv=Asv,
+            method="bdf", segment_steps=512)
+    wall = time.perf_counter() - t0
+    n_ok = int((out["status"] == SUCCESS).sum())
+
+    # ---- final-state parity vs the independent native C++ BDF ------------
+    spot = []
+    with ph("spot_check"):
+        from batchreactor_tpu import native
+
+        X = np.zeros(len(th.species))
+        sp = list(th.species)
+        X[sp.index("CH4")], X[sp.index("O2")], X[sp.index("N2")] = .25, .5, .25
+        for b in np.linspace(0, B - 1, 4).astype(int):
+            if int(out["status"][b]) != SUCCESS:
+                # a failed lane's final state is partial — that is a solve
+                # failure to report, not a parity error to measure
+                spot.append({"lane": int(b), "T": float(T_grid[b]),
+                             "skipped": "lane status != SUCCESS"})
+                continue
+            y0 = np.asarray(sweep_solution_vectors(
+                jnp.asarray(X)[None, :], th.molwt,
+                T_grid[b][None], 1e5, ini_covg=sm.ini_covg)[0])
+            rn = native.solve_surf_bdf(sm, th, float(T_grid[b]), Asv, y0,
+                                       0.0, t1, gm=gm, rtol=1e-6, atol=1e-10)
+            ng = len(sp)
+            moles = rn.y[:ng] / np.asarray(th.molwt)
+            x_nat = moles / moles.sum()
+            # compare bulk species (mole fraction > 1e-8) relative
+            x_tpu = np.array([out["x"][s][b] for s in sp])
+            mask = x_nat > 1e-8
+            rel = float(np.max(np.abs(x_tpu[mask] - x_nat[mask])
+                               / x_nat[mask]))
+            spot.append({"lane": int(b), "T": float(T_grid[b]),
+                         "max_rel_err_bulk_x": rel})
+
+    rec = {
+        "workload": f"GRI30 + {surf_xml} coupled, CH4/O2/N2 0.25/0.5/0.25, "
+                    f"1 bar, Asv={Asv}, t1={t1}, B={B} T-sweep "
+                    f"1073-1273 K, rtol 1e-6 atol 1e-10",
+        "method": "bdf", "B": B,
+        "wall_s": round(wall, 2), "cond_per_s": round(B / wall, 3),
+        "warm_s": round(warm, 1),
+        "device": jax.default_backend(),
+        "n_ok": n_ok,
+        "x_parity_native": spot,
+        "phases_s": {k: round(v, 2) for k, v in ph.summary().items()},
+    }
+    with open(os.path.join(REPO, "COUPLED_TPU.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,9 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(REPO, ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+# match the bench protocol (bench.py rung_main): f32 rate exponentials on
+# by default, BR_EXP32=0 reverts; must be set before the package import
+os.environ.setdefault("BR_EXP32", "1")
 
 LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
 if not os.path.isdir(LIB):
@@ -153,6 +156,7 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
         "workload": f"GRI30 {n_T}x{n_phi} TxPhi ignition map, 1 bar, "
                     f"t1={t1}, rtol={rtol} atol={atol}",
         "method": method,
+        "exp32": os.environ.get("BR_EXP32") == "1",
         "B": int(B),
         "wall_s": round(wall, 2),
         "cond_per_s": round(B / wall, 3),
