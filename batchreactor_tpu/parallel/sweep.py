@@ -106,11 +106,12 @@ def _check_method(method, jac_window, newton_tol):
     if method not in _SOLVERS:
         raise ValueError(f"unknown method {method!r}; use "
                          f"{sorted(_SOLVERS)}")
-    if method != "sdirk" and (jac_window != 1 or newton_tol != 0.03):
-        # fail loudly instead of silently dropping the sdirk-only knobs
+    if method != "sdirk" and newton_tol != 0.03:
+        # fail loudly instead of silently dropping the sdirk-only knob
+        # (bdf derives its Newton tolerance from rtol, CVODE-style)
         raise ValueError(
-            f"jac_window/newton_tol are sdirk-only knobs; method={method!r} "
-            f"got jac_window={jac_window}, newton_tol={newton_tol}")
+            f"newton_tol is an sdirk-only knob; method={method!r} "
+            f"got newton_tol={newton_tol}")
 
 
 @functools.lru_cache(maxsize=64)
@@ -128,7 +129,7 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
 
     def one(y0, t0, t1, cfg, obs0):
         kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
-              if method == "sdirk" else {})
+              if method == "sdirk" else {"jac_window": jac_window})
         return _SOLVERS[method](
             rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
             n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
@@ -359,7 +360,8 @@ def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
         else:
             rhs_fn, jac_fn = rhs, jac
         kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
-              if method == "sdirk" else {"solver_state": sstate})
+              if method == "sdirk"
+              else {"solver_state": sstate, "jac_window": jac_window})
         return _SOLVERS[method](
             rhs_fn, y0, t0, t1, cfg, rtol=rtol, atol=atol,
             max_steps=segment_steps, n_save=n_save, dt0=h0, err0=e0,

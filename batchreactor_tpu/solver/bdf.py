@@ -103,6 +103,7 @@ def solve(
     observer_init=None,
     err0=None,
     solver_state=None,
+    jac_window=1,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` with BDF(1..5).
 
@@ -113,6 +114,13 @@ def solve(
     resume the multistep history across bounded device launches.  ``err0``
     is accepted for sdirk interface compatibility and ignored (the BDF
     history carries its own memory).
+
+    ``jac_window=K`` (K > 1) evaluates the Jacobian once per K step
+    attempts (CVODE's quasi-constant iteration matrix; M and its inverse
+    stay c-correct every attempt).  Stale-J Newton converges to the same
+    corrector solution — only its rate degrades, gated by the displacement
+    test — but accept/reject patterns can shift at newton_tol scale, and
+    segmented == monolithic bit-exactness holds only for ``jac_window=1``.
     """
     y0 = jnp.asarray(y0)
     n = y0.shape[0]
@@ -133,6 +141,10 @@ def solve(
         linsolve = "lu" if jax.default_backend() == "cpu" else "inv32f"
     if linsolve not in ("lu", "inv32", "inv32nr", "inv32f"):
         raise ValueError(f"unknown linsolve {linsolve!r}")
+    if jac_window < 1:
+        # fori_loop(0, 0, ...) would return the carry unchanged and spin
+        # the outer while_loop forever inside jit
+        raise ValueError(f"jac_window must be >= 1, got {jac_window}")
 
     f = functools.partial(rhs, cfg=cfg)
     if jac is None:
@@ -211,7 +223,13 @@ def solve(
         d, _, _, _, conv, _ = lax.while_loop(cond, body, init)
         return d, conv
 
-    def body(carry):
+    def step_once(carry, J_stale):
+        """One step attempt; ``J_stale=None`` evaluates a fresh Jacobian at
+        this attempt's predictor (jac_window=1), otherwise the passed J is
+        used as-is — CVODE's quasi-constant iteration matrix economy.  M and
+        its inverse stay c-correct every attempt either way; J quality only
+        affects the quasi-Newton convergence RATE, which the displacement
+        test gates (same argument as the inv32* preconditioners)."""
         (t, D, order, h, n_equal, status, n_acc, n_rej, ts, ys, n_saved,
          obs) = carry
         running = status == RUNNING
@@ -237,7 +255,7 @@ def solve(
         c = h / gam
         scale = atol + rtol * jnp.abs(y_pred)
 
-        J = jac(t_new, y_pred)
+        J = jac(t_new, y_pred) if J_stale is None else J_stale
         M = eye - c * J
         solve_m = make_solve_m(M, linsolve, y0.dtype)
         d, conv = newton(solve_m, t_new, y_pred, psi, c, scale)
@@ -342,6 +360,24 @@ def solve(
 
     def cond(carry):
         return carry[5] == RUNNING
+
+    if jac_window == 1:
+        def body(carry):
+            return step_once(carry, None)
+    else:
+        def body(carry):
+            # one Jacobian (evaluated at the window-opening predictor)
+            # serves jac_window attempts; a lane that terminates mid-window
+            # idles for the remainder (step_once's running/hold gates keep
+            # its carry frozen).  Window phase resets at segment
+            # boundaries, so segmented == monolithic bit-exactness holds
+            # only for jac_window=1; step budgets may overshoot by up to
+            # jac_window-1 attempts.
+            t, D, order, h = carry[0], carry[1], carry[2], carry[3]
+            y_pred = _masked_row_sum(D, jnp.ones((_ROWS,), y0.dtype), order)
+            J = jac(t + h, y_pred)
+            return lax.fori_loop(0, jac_window,
+                                 lambda _, c: step_once(c, J), carry)
 
     zero = jnp.asarray(0, dtype=jnp.int32)
     init = (t0, D_init, order_init, h_init, nequal_init,

@@ -44,7 +44,7 @@ if not os.path.isdir(LIB):
 def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
               phi_hi=1.6, t1=8e-4, p=1e5, ckpt_dir=None, chunk_size=512,
               segment_steps=256, mesh=None, rtol=1e-6, atol=1e-10,
-              n_spot=8, method="bdf", log=print):
+              n_spot=8, method="bdf", jac_window=8, log=print):
     """Run the T x phi GRI ignition map; return the result record dict."""
     import jax
     import jax.numpy as jnp
@@ -84,7 +84,7 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
 
     solve_kw = dict(rtol=rtol, atol=atol, jac=jac, observer=obs,
                     observer_init=obs0, mesh=mesh, method=method,
-                    segment_steps=segment_steps)
+                    segment_steps=segment_steps, jac_window=jac_window)
     t_start = time.perf_counter()
     with ph("solve"):
         if ckpt_dir:
@@ -157,6 +157,7 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
                     f"t1={t1}, rtol={rtol} atol={atol}",
         "method": method,
         "exp32": os.environ.get("BR_EXP32") == "1",
+        "jac_window": jac_window,
         "B": int(B),
         "wall_s": round(wall, 2),
         "cond_per_s": round(B / wall, 3),
@@ -182,6 +183,7 @@ def main():
     ckpt = os.environ.get("NORTHSTAR_CKPT", "")
     rec = run_sweep(n_T=n_T, n_phi=n_phi, ckpt_dir=ckpt or None,
                     method=os.environ.get("NORTHSTAR_METHOD", "bdf"),
+                    jac_window=int(os.environ.get("NORTHSTAR_JW", "8")),
                     segment_steps=int(os.environ.get("NORTHSTAR_SEG", "256")),
                     chunk_size=int(os.environ.get("NORTHSTAR_CHUNK", "512")),
                     log=lambda m: print(m, file=sys.stderr, flush=True))
