@@ -83,7 +83,7 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     closure per call (e.g. ``ignition_observer(...)`` inside a loop) forces
     a full recompile every call, minutes at GRI scale on TPU.
     """
-    _check_method(method, jac_window, newton_tol)
+    _check_method(method, newton_tol)
     jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
                             dt_min_factor, linsolve, jac, observer,
                             jac_window, newton_tol, method)
@@ -102,7 +102,7 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     return jitted(y0s, t0, t1, cfgs, obs0)
 
 
-def _check_method(method, jac_window, newton_tol):
+def _check_method(method, newton_tol):
     if method not in _SOLVERS:
         raise ValueError(f"unknown method {method!r}; use "
                          f"{sorted(_SOLVERS)}")
@@ -206,7 +206,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     # a segment can accept at most segment_steps rows, so this buffer never
     # drops a row the host still has capacity for
     seg_save = min(int(n_save), int(segment_steps)) if n_save else 0
-    _check_method(method, jac_window, newton_tol)
+    _check_method(method, newton_tol)
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
                                       dt_min_factor, linsolve,
                                       None if rhs_bundle is not None else jac,

@@ -183,7 +183,11 @@ def main():
     ckpt = os.environ.get("NORTHSTAR_CKPT", "")
     rec = run_sweep(n_T=n_T, n_phi=n_phi, ckpt_dir=ckpt or None,
                     method=os.environ.get("NORTHSTAR_METHOD", "bdf"),
-                    jac_window=int(os.environ.get("NORTHSTAR_JW", "8")),
+                    # jw=8 validated for BDF only (PERF.md); sdirk keeps 1
+                    jac_window=int(os.environ.get(
+                        "NORTHSTAR_JW",
+                        "8" if os.environ.get("NORTHSTAR_METHOD",
+                                              "bdf") == "bdf" else "1")),
                     segment_steps=int(os.environ.get("NORTHSTAR_SEG", "256")),
                     chunk_size=int(os.environ.get("NORTHSTAR_CHUNK", "512")),
                     log=lambda m: print(m, file=sys.stderr, flush=True))

@@ -214,6 +214,21 @@ class TestSweepAPI:
             segment_steps=64)
         assert out["report"]["counts"]["success"] == 2
 
+    def test_bdf_jac_window_through_sweep_api(self, h2o2):
+        """jac_window reaches the solver through batch_reactor_sweep: the
+        windowed run tracks the per-attempt-J run at tolerance scale."""
+        gm, th = h2o2
+        taus = {}
+        for jw in (1, 4):
+            out = br.batch_reactor_sweep(
+                {"H2": 0.25, "O2": 0.25, "N2": 0.5},
+                jnp.linspace(1200.0, 1400.0, 3), 1e5, 2e-3,
+                chem=br.Chemistry(gaschem=True), thermo_obj=th, md=gm,
+                method="bdf", jac_window=jw, ignition_marker="H2")
+            assert out["report"]["counts"]["success"] == 3
+            taus[jw] = out["tau"]
+        np.testing.assert_allclose(taus[4], taus[1], rtol=1e-3)
+
     def test_per_lane_composition(self, h2o2):
         gm, th = h2o2
         out = br.batch_reactor_sweep(
