@@ -414,7 +414,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         rtol=1e-6, atol=1e-10,
                         max_steps=200_000, segment_steps=0, kc_compat=False,
                         asv_quirk=True, ignition_marker=None,
-                        ignition_mode="half", method="sdirk", jac_window=1):
+                        ignition_mode="half", method="sdirk", jac_window=1,
+                        analytic_jac=True):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -441,6 +442,10 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     K step attempts (CVODE's quasi-constant iteration matrix; measured
     +70% sweep throughput on TPU at K=8 with tau shifts ~2.5e-5 —
     PERF.md; K=1 keeps per-attempt J and bit-exact segmented resume).
+    ``analytic_jac=False`` drops the closed-form Jacobian and lets the
+    solver fall back to ``jax.jacfwd`` — a measurement/escape knob (the
+    coupled analytic-J program currently hits a TPU-backend compile-time
+    wall, PERF.md).
     """
     from .parallel import (ensemble_solve, ensemble_solve_segmented,
                            sweep_report)
@@ -516,6 +521,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     rhs, jac, observer, obs0 = _sweep_fns(mode, gm, sm, thermo_obj,
                                           kc_compat, asv_quirk, marker_idx,
                                           ignition_mode)
+    if not analytic_jac:
+        jac = None  # solver falls back to jax.jacfwd
 
     if mesh is not None:
         # pad the batch to the mesh device count with copies of the last

@@ -9,7 +9,8 @@ cannot express at all, and its file form runs one condition per process.
 
 Reports conditions/sec and cross-checks final gas states on a few lanes
 against the independent native C++ BDF (``native.solve_surf_bdf`` with
-gm=), writing COUPLED_TPU.json.
+gm=), writing COUPLED_{DEVICE}.json (COUPLED_TPU.json on the chip,
+COUPLED_CPU.json on a CPU-pinned run).
 
 Usage:  python scripts/coupled_probe.py          # B=64 on the default device
         CP_B=16 CP_T1=1.0 python scripts/coupled_probe.py
@@ -45,11 +46,17 @@ def main():
 
     B = int(os.environ.get("CP_B", "64"))
     t1 = float(os.environ.get("CP_T1", "10.0"))
+    # CP_JAC=fwd drops the analytic Jacobian (jax.jacfwd fallback): the
+    # escape hatch for the coupled analytic-J TPU compile wall (PERF.md)
+    cp_jac = os.environ.get("CP_JAC", "analytic")
+    if cp_jac not in ("analytic", "fwd"):
+        raise SystemExit(f"CP_JAC must be 'analytic' or 'fwd', got {cp_jac!r}")
+    analytic = cp_jac != "fwd"
     Asv = 1.0  # reference batch.xml has no <Asv>; the parser defaults to 1
     ph = Phases()
     with ph("parse"):
-        # this workload needs the reference mechanism library (grimech.dat +
-        # ch4ni.xml); the vendored fixtures carry neither, so fail loudly
+        # grimech.dat + ch4ni.xml ship in tests/fixtures too (vendored), so
+        # this runs on bare clones via the LIB fallback
         gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
         th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
         sm = compile_mech(f"{LIB}/ch4ni.xml", th, list(gm.species))
@@ -62,7 +69,7 @@ def main():
             {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, T_grid, 1e5, t1,
             chem=br.Chemistry(surfchem=True, gaschem=True),
             thermo_obj=th, gmd=gm, smd=sm, Asv=Asv,
-            method="bdf", segment_steps=512)
+            method="bdf", segment_steps=512, analytic_jac=analytic)
     warm = time.perf_counter() - t0
     # second run = steady-state timing (compile cached)
     t0 = time.perf_counter()
@@ -71,7 +78,7 @@ def main():
             {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, T_grid, 1e5, t1,
             chem=br.Chemistry(surfchem=True, gaschem=True),
             thermo_obj=th, gmd=gm, smd=sm, Asv=Asv,
-            method="bdf", segment_steps=512)
+            method="bdf", segment_steps=512, analytic_jac=analytic)
     wall = time.perf_counter() - t0
     n_ok = int((out["status"] == SUCCESS).sum())
 
@@ -110,7 +117,7 @@ def main():
         "workload": f"GRI30 + {surf_xml} coupled, CH4/O2/N2 0.25/0.5/0.25, "
                     f"1 bar, Asv={Asv}, t1={t1}, B={B} T-sweep "
                     f"1073-1273 K, rtol 1e-6 atol 1e-10",
-        "method": "bdf", "B": B,
+        "method": "bdf", "B": B, "analytic_jac": analytic,
         "wall_s": round(wall, 2), "cond_per_s": round(B / wall, 3),
         "warm_s": round(warm, 1),
         "device": jax.default_backend(),
@@ -118,7 +125,8 @@ def main():
         "x_parity_native": spot,
         "phases_s": {k: round(v, 2) for k, v in ph.summary().items()},
     }
-    with open(os.path.join(REPO, "COUPLED_TPU.json"), "w") as f:
+    out_path = os.path.join(REPO, f"COUPLED_{rec['device'].upper()}.json")
+    with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec))
 

@@ -39,8 +39,9 @@ def lib_dir():
 
 @pytest.fixture(scope="session")
 def gri_lib_dir(lib_dir):
-    # tests needing the big GRI-3.0 / CH4-Ni fixtures (not vendored: 450+60
-    # lines of third-party mechanism data) skip on a bare clone
+    # GRI-3.0 / CH4-Ni mechanisms are vendored in tests/fixtures since
+    # round 3, so lib_dir (reference checkout or fixtures fallback) always
+    # carries them; the skip remains as a guard for partial checkouts
     if not (pathlib.Path(lib_dir) / "grimech.dat").is_file():
         pytest.skip(f"grimech.dat/ch4ni.xml unavailable in {lib_dir}")
     return lib_dir
