@@ -15,9 +15,10 @@ Python has no multiple dispatch, so one ``batch_reactor`` function dispatches
 on the argument pattern (dict first argument -> programmatic; callable third
 argument -> UDF).  Everything device-side is pure JAX: the RHS comes from
 ``ops.rhs`` and the integration is a jitted implicit solve — ``method=``
-selects L-stable SDIRK4 (``solver.sdirk``, default) or variable-order
-BDF(1..5) (``solver.bdf``, the CVODE-family fast path) — at the
-reference's tolerances reltol=1e-6 / abstol=1e-10 (:210).
+selects variable-order BDF(1..5) (``solver.bdf``, the CVODE-family fast
+path and the default, matching the reference's CVODE_BDF) or L-stable
+SDIRK4 (``solver.sdirk``) — at the reference's tolerances reltol=1e-6 /
+abstol=1e-10 (:210).
 
 ``sens=True`` reproduces the reference's sensitivity hook (return the
 problem *without* solving, :205-207) — here a :class:`SensitivityProblem`
@@ -141,7 +142,7 @@ def _segmented_builder(mode, udf, kc_compat, asv_quirk):
     static_argnames=("mode", "udf", "kc_compat", "asv_quirk", "n_save",
                      "max_steps", "method"))
 def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
-           n_save, max_steps, kc_compat, asv_quirk, method="sdirk"):
+           n_save, max_steps, kc_compat, asv_quirk, method="bdf"):
     """Jitted solve, cache-keyed on the chemistry *mode* rather than a
     per-call rhs closure: mechanism tensor bundles enter as traced pytree
     operands, so repeated calls with any same-shaped mechanism (the
@@ -192,7 +193,7 @@ def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
 
 def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
                atol, n_save, max_steps, kc_compat, asv_quirk,
-               segmented=None, progress=None, method="sdirk"):
+               segmented=None, progress=None, method="bdf"):
     """Dispatch one solve to the requested backend and normalize the result:
     returns (status_str, t_end, y_end, ts, ys, truncated, n_acc, n_rej)
     with ts/ys the saved trajectory *including* the initial row.
@@ -261,7 +262,7 @@ def _mode(chem):
 
 def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
                      max_steps, kc_compat, asv_quirk, verbose, backend,
-                     segmented=None, method="sdirk"):
+                     segmented=None, method="bdf"):
     """Core driver: parse XML -> build RHS -> solve -> write profiles
     (reference :152-217)."""
     import sys
@@ -331,7 +332,7 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
 
 def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
                       rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-                      backend, segmented=None, method="sdirk"):
+                      backend, segmented=None, method="bdf"):
     """Dict-in/dict-out API (reference :86-147): no files; returns
     ``(accepted_times, {species: final mole fraction})``.
 
@@ -414,7 +415,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         rtol=1e-6, atol=1e-10,
                         max_steps=200_000, segment_steps=0, kc_compat=False,
                         asv_quirk=True, ignition_marker=None,
-                        ignition_mode="half", method="sdirk", jac_window=1,
+                        ignition_mode="half", method="bdf", jac_window=1,
                         analytic_jac=True):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
@@ -561,7 +562,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   Asv=1.0, chem=None, thermo_obj=None, md=None,
                   rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,
                   kc_compat=False, asv_quirk=True, verbose=True,
-                  backend="jax", segmented=None, method="sdirk"):
+                  backend="jax", segmented=None, method="bdf"):
     """Simulate an isothermal constant-volume batch reactor (three forms).
 
     Form 1 — file-driven:   ``batch_reactor(input_file, lib_dir,
@@ -585,10 +586,10 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
     default, exactly like the reference (:401); pass ``verbose=False`` to
     opt out of both the per-step lines and the final summary line.
 
-    ``method`` selects the jax-backend integrator: ``"sdirk"`` (default;
-    L-stable one-step SDIRK4) or ``"bdf"`` (variable-order BDF 1..5, the
-    CVODE family — fewer steps and one Newton solve per step, the fast
-    path for ensemble work; solver/bdf.py).
+    ``method`` selects the jax-backend integrator: ``"bdf"`` (default;
+    variable-order BDF 1..5, the CVODE family the reference's solver
+    belongs to — fewer steps and one Newton solve per step; solver/bdf.py)
+    or ``"sdirk"`` (L-stable one-step SDIRK4).
     """
     if args and isinstance(args[0], dict):
         if len(args) != 4:

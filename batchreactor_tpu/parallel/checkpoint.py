@@ -122,7 +122,12 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
     the invalidation is explicit and greppable rather than a silent
     by-product of the recipe change."""
     h = hashlib.sha256()
-    h.update(b"br-sweep-fingerprint-v2")
+    # v3: the RESOLVED solver method enters the hash (round 3 flipped the
+    # default from sdirk to bdf — a pre-flip checkpoint dir written without
+    # an explicit method= must not resume under the new default and
+    # silently concatenate sdirk and bdf chunks)
+    h.update(b"br-sweep-fingerprint-v3")
+    h.update(b"method=" + str(solve_kw.get("method", "bdf")).encode())
     _hash_callable(h, rhs)
     h.update(np.ascontiguousarray(np.asarray(y0s)).tobytes())
     for k in sorted(cfgs):

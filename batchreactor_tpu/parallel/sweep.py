@@ -69,7 +69,7 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
                    dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
                    observer=None, observer_init=None, jac_window=1,
-                   newton_tol=0.03, method="sdirk"):
+                   newton_tol=0.03, method="bdf"):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
@@ -117,7 +117,7 @@ def _check_method(method, newton_tol):
 @functools.lru_cache(maxsize=64)
 def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
                    linsolve, jac=None, observer=None, jac_window=1,
-                   newton_tol=0.03, method="sdirk"):
+                   newton_tol=0.03, method="bdf"):
     """One compiled batched solve per (rhs, solver-settings) combination.
 
     Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
@@ -158,7 +158,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              linsolve="auto", jac=None, observer=None,
                              observer_init=None, dt_min_factor=1e-22,
                              n_save=0, rhs_bundle=None, jac_window=1,
-                             newton_tol=0.03, method="sdirk"):
+                             newton_tol=0.03, method="bdf"):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -348,7 +348,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
 def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
                              linsolve, jac, observer, n_save=0,
                              bundle_mode=False, jac_window=1,
-                             newton_tol=0.03, method="sdirk"):
+                             newton_tol=0.03, method="bdf"):
     """Compiled per-segment batched solve: per-lane t0 and carried-in step
     size are traced operands (vmap axis 0), so every segment reuses one
     executable.  In ``bundle_mode`` the first operand is a mechanism-bundle

@@ -212,7 +212,10 @@ def test_segmented_matches_unsegmented(h2o2):
     y0s = jnp.broadcast_to(y0, (B, 9))
     cfgs = {"T": jnp.linspace(1200.0, 1400.0, B)}
     obs, obs0 = ignition_observer(sp.index("H2"), mode="half")
-    full = ensemble_solve(rhs, y0s, 0.0, 2e-3, cfgs, dt0=1e-12,
+    # no dt0 pin: both paths must start from the same Hairer heuristic h0 —
+    # the segmented driver computes its own first-segment h0, and under BDF
+    # (the default) identical starts make segmented == monolithic bit-exact
+    full = ensemble_solve(rhs, y0s, 0.0, 2e-3, cfgs,
                           observer=obs, observer_init=obs0)
     segs = []
     seg = ensemble_solve_segmented(
