@@ -52,6 +52,8 @@ def main():
     if cp_jac not in ("analytic", "fwd"):
         raise SystemExit(f"CP_JAC must be 'analytic' or 'fwd', got {cp_jac!r}")
     analytic = cp_jac != "fwd"
+    # the bench protocol's Jacobian window (PERF.md); CP_JW=1 reverts
+    jw = int(os.environ.get("CP_JW", "8"))
     Asv = 1.0  # reference batch.xml has no <Asv>; the parser defaults to 1
     ph = Phases()
     with ph("parse"):
@@ -69,7 +71,8 @@ def main():
             {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, T_grid, 1e5, t1,
             chem=br.Chemistry(surfchem=True, gaschem=True),
             thermo_obj=th, gmd=gm, smd=sm, Asv=Asv,
-            method="bdf", segment_steps=512, analytic_jac=analytic)
+            method="bdf", segment_steps=512, analytic_jac=analytic,
+            jac_window=jw)
     warm = time.perf_counter() - t0
     # second run = steady-state timing (compile cached)
     t0 = time.perf_counter()
@@ -78,7 +81,8 @@ def main():
             {"CH4": 0.25, "O2": 0.5, "N2": 0.25}, T_grid, 1e5, t1,
             chem=br.Chemistry(surfchem=True, gaschem=True),
             thermo_obj=th, gmd=gm, smd=sm, Asv=Asv,
-            method="bdf", segment_steps=512, analytic_jac=analytic)
+            method="bdf", segment_steps=512, analytic_jac=analytic,
+            jac_window=jw)
     wall = time.perf_counter() - t0
     n_ok = int((out["status"] == SUCCESS).sum())
 
@@ -118,6 +122,7 @@ def main():
                     f"1 bar, Asv={Asv}, t1={t1}, B={B} T-sweep "
                     f"1073-1273 K, rtol 1e-6 atol 1e-10",
         "method": "bdf", "B": B, "analytic_jac": analytic,
+        "jac_window": jw,
         "wall_s": round(wall, 2), "cond_per_s": round(B / wall, 3),
         "warm_s": round(warm, 1),
         "device": jax.default_backend(),
