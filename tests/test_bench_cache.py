@@ -1,0 +1,85 @@
+"""Unit tests for bench.py's healthy-rung banking and cache fallback.
+
+The banked-cache mechanism is the round's measurement-survival path (a
+wedged tunneled chip at bench time must still report the best healthy-chip
+rung — PERF.md operational constraints), so its host-side logic gets real
+tests: banking criteria, best-keeps-wins, and the workload fingerprint
+gate that stops a cache entry from a different workload being reported as
+the headline metric.
+
+bench.py's parent process never imports jax (by design), so importing it
+here is cheap and side-effect-free beyond a couple of env defaults.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    """A fresh bench module whose cache/partial paths live in tmp_path."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_under_test"] = mod
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "TPU_CACHE", str(tmp_path / "cache.json"))
+    monkeypatch.setattr(mod, "PARTIAL", str(tmp_path / "partial.json"))
+    yield mod
+    del sys.modules["bench_under_test"]
+
+
+def _rung(platform="tpu", cps=50.0, B=256, n_ok=256):
+    return {"platform": platform, "cps": cps, "B": B, "n_ok": n_ok,
+            "wall_s": B / cps, "tau_min": 1e-5, "tau_max": 5e-4}
+
+
+def test_bank_and_load_roundtrip(bench):
+    bench.bank_tpu_rung(_rung(cps=50.0))
+    got = bench.load_tpu_cache()
+    assert got is not None and got["cps"] == 50.0
+    # the banked record carries the workload fingerprint and a timestamp
+    assert got["workload"] == bench._workload_fingerprint()
+    assert "banked_at" in got
+
+
+def test_cpu_rungs_are_never_banked(bench):
+    bench.bank_tpu_rung(_rung(platform="cpu"))
+    assert bench.load_tpu_cache() is None
+
+
+def test_partial_rungs_are_never_banked(bench):
+    bench.bank_tpu_rung(_rung(n_ok=17))  # 17 of 256 lanes succeeded
+    assert bench.load_tpu_cache() is None
+
+
+def test_best_rung_wins_and_slower_does_not_regress(bench):
+    bench.bank_tpu_rung(_rung(cps=50.0))
+    bench.bank_tpu_rung(_rung(cps=40.0))  # slower: keep the 50
+    assert bench.load_tpu_cache()["cps"] == 50.0
+    bench.bank_tpu_rung(_rung(cps=60.0))  # faster: replace
+    assert bench.load_tpu_cache()["cps"] == 60.0
+
+
+def test_workload_fingerprint_gates_the_cache(bench):
+    """A cache entry measured under a different workload (other horizon,
+    other T window) must never be reported as this invocation's metric."""
+    bench.bank_tpu_rung(_rung(cps=50.0))
+    with open(bench.TPU_CACHE) as f:
+        cached = json.load(f)
+    cached["workload"]["t1"] = 1e-9  # someone benched a different horizon
+    with open(bench.TPU_CACHE, "w") as f:
+        json.dump(cached, f)
+    assert bench.load_tpu_cache() is None
+
+
+def test_corrupt_cache_is_ignored(bench):
+    with open(bench.TPU_CACHE, "w") as f:
+        f.write("{not json")
+    assert bench.load_tpu_cache() is None
