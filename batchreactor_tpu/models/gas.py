@@ -73,6 +73,8 @@ class GasMechanism:
     Ea0: jnp.ndarray         # (R,) J/mol
     has_troe: jnp.ndarray    # (R,) 1.0 where TROE blending applies
     troe: jnp.ndarray        # (R, 4) a, T3, T1, T2 (T2=+inf for 3-parameter)
+    has_sri: jnp.ndarray     # (R,) 1.0 where SRI blending applies
+    sri: jnp.ndarray         # (R, 5) a, b, c, d, e (d=1, e=0 for 3-param)
     rev_mask: jnp.ndarray    # (R,) 1.0 where reversible
     sign_A: jnp.ndarray      # (R,) +-1; negative-A DUPLICATE rows carry the
                              #      sign here, ln|A| in log_A
@@ -127,14 +129,15 @@ def _tofloat(tok):
 class _Rxn:
     __slots__ = (
         "equation", "reactants", "products", "A", "beta", "Ea", "reversible",
-        "third_body", "falloff", "collider", "eff", "low", "troe", "duplicate",
-        "rev", "plog", "cheb", "tcheb", "pcheb",
+        "third_body", "falloff", "collider", "eff", "low", "troe", "sri",
+        "duplicate", "rev", "plog", "cheb", "tcheb", "pcheb",
     )
 
     def __init__(self):
         self.eff = {}
         self.low = None
         self.troe = None
+        self.sri = None
         self.third_body = False
         self.falloff = False
         self.collider = None
@@ -224,8 +227,15 @@ def parse_gas_mechanism(path):
     return elements, species, rxns
 
 
+_AUX_KEYWORDS = ("DUPLICATE", "DUP", "LOW", "TROE", "SRI", "REV", "PLOG",
+                 "TCHEB", "PCHEB", "CHEB")
+
+
 def _parse_reaction_line(line, rxns, e_factor):
     up = line.upper()
+    if not rxns and any(up.startswith(k) for k in _AUX_KEYWORDS):
+        raise ValueError(
+            f"auxiliary line without a preceding reaction: {line!r}")
     if up.startswith("DUPLICATE") or up.startswith("DUP"):
         rxns[-1].duplicate = True
         return
@@ -236,6 +246,18 @@ def _parse_reaction_line(line, rxns, e_factor):
     if up.startswith("TROE"):
         nums = [_tofloat(t) for t in re.findall(r"[-+0-9.EeDd]+", line[4:]) if _is_number(t)]
         rxns[-1].troe = tuple(nums)
+        return
+    if up.startswith("SRI"):
+        # SRI /a b c [d e]/ — Stanford Research Institute falloff blending
+        # F = d T^e [a exp(-b/T) + exp(-T/c)]^X, X = 1/(1 + log10(Pr)^2);
+        # 3-parameter form implies d=1, e=0 (CHEMKIN-II)
+        nums = [_tofloat(t) for t in re.findall(r"[-+0-9.EeDd]+", line[3:])
+                if _is_number(t)]
+        if len(nums) not in (3, 5):
+            raise ValueError(f"SRI needs 3 or 5 numbers: {line!r}")
+        if not rxns:
+            raise ValueError(f"SRI without a preceding reaction: {line!r}")
+        rxns[-1].sri = tuple(nums) if len(nums) == 5 else (*nums, 1.0, 0.0)
         return
     if up.startswith("REV"):
         # REV /A beta Ea/ — explicit reverse Arrhenius (CHEMKIN-II); the
@@ -346,6 +368,10 @@ def compile_gaschemistry(mech_file):
     has_troe = np.zeros(Rn)
     # safe inert defaults keep F finite (and jacfwd NaN-free) on non-TROE rows
     troe = np.tile(np.array([0.6, 100.0, 1000.0, np.inf]), (Rn, 1))
+    has_sri = np.zeros(Rn)
+    # inert defaults: base = a*exp(-b/T) + exp(-T/c) = 1 + 1 = 2, finite
+    # for any T and under jacfwd; non-SRI rows are masked to F = 1 anyway
+    sri = np.tile(np.array([1.0, 0.0, np.inf, 1.0, 0.0]), (Rn, 1))
     rev_mask = np.zeros(Rn)
     sign_A = np.ones(Rn)
     has_rev = np.zeros(Rn)
@@ -470,9 +496,10 @@ def compile_gaschemistry(mech_file):
         if rxn.cheb is not None:
             # Chebyshev reactions: the (+M) is pure notation — k(T,p)
             # carries the whole pressure dependence, no collider efficiencies
-            if rxn.third_body or rxn.low is not None or rxn.troe is not None:
-                raise ValueError(
-                    f"CHEB cannot combine with +M/LOW/TROE: {rxn.equation!r}")
+            if (rxn.third_body or rxn.low is not None
+                    or rxn.troe is not None or rxn.sri is not None):
+                raise ValueError(f"CHEB cannot combine with +M/LOW/TROE/SRI: "
+                                 f"{rxn.equation!r}")
             if rxn.collider is not None or rxn.eff:
                 # a (+SP) collider or efficiency lines would silently change
                 # the meaning: CHEB k(T,p) is defined on TOTAL pressure
@@ -521,6 +548,9 @@ def compile_gaschemistry(mech_file):
             log_A0[i] = np.log(rxn.low[0]) + order * np.log(1e-6)
             beta0[i] = rxn.low[1]
             Ea0[i] = rxn.low[2]  # already J/mol (converted at parse)
+            if rxn.troe is not None and rxn.sri is not None:
+                raise ValueError(
+                    f"TROE and SRI are mutually exclusive: {rxn.equation!r}")
             if rxn.troe is not None:
                 has_troe[i] = 1.0
                 t = rxn.troe
@@ -528,6 +558,15 @@ def compile_gaschemistry(mech_file):
                 troe[i, 1] = t[1]
                 troe[i, 2] = t[2]
                 troe[i, 3] = t[3] if len(t) > 3 else np.inf
+            if rxn.sri is not None:
+                if rxn.sri[2] <= 0 or rxn.sri[3] <= 0:
+                    raise ValueError(
+                        f"SRI needs c > 0 and d > 0: {rxn.equation!r}")
+                has_sri[i] = 1.0
+                sri[i, :] = rxn.sri
+        elif rxn.sri is not None:
+            raise ValueError(
+                f"SRI on a non-falloff reaction: {rxn.equation!r}")
 
     int_stoich = bool(
         np.all(nu_f == np.round(nu_f)) and np.all(nu_r == np.round(nu_r))
@@ -547,6 +586,8 @@ def compile_gaschemistry(mech_file):
         Ea0=jnp.asarray(Ea0),
         has_troe=jnp.asarray(has_troe),
         troe=jnp.asarray(troe),
+        has_sri=jnp.asarray(has_sri),
+        sri=jnp.asarray(sri),
         rev_mask=jnp.asarray(rev_mask),
         sign_A=jnp.asarray(sign_A),
         has_rev=jnp.asarray(has_rev),

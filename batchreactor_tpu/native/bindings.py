@@ -47,6 +47,8 @@ class _BrGasMech(ctypes.Structure):
         ("Ea0", ctypes.POINTER(ctypes.c_double)),
         ("has_troe", ctypes.POINTER(ctypes.c_double)),
         ("troe", ctypes.POINTER(ctypes.c_double)),
+        ("has_sri", ctypes.POINTER(ctypes.c_double)),
+        ("sri", ctypes.POINTER(ctypes.c_double)),
         ("rev_mask", ctypes.POINTER(ctypes.c_double)),
         ("sign_A", ctypes.POINTER(ctypes.c_double)),
         ("has_rev", ctypes.POINTER(ctypes.c_double)),
@@ -201,6 +203,7 @@ def _pack_mech(gm, thermo, kc_compat):
         ("has_tb", gm.has_tb), ("has_falloff", gm.has_falloff),
         ("log_A0", gm.log_A0), ("beta0", gm.beta0), ("Ea0", gm.Ea0),
         ("has_troe", gm.has_troe), ("troe", gm.troe),
+        ("has_sri", gm.has_sri), ("sri", gm.sri),
         ("rev_mask", gm.rev_mask), ("sign_A", gm.sign_A),
         ("has_rev", gm.has_rev), ("log_A_rev", gm.log_A_rev),
         ("beta_rev", gm.beta_rev), ("Ea_rev", gm.Ea_rev),

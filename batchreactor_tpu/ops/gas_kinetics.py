@@ -12,7 +12,7 @@ Rate law (CHEMKIN-II semantics):
   kf_i = A_i T^beta_i exp(-Ea_i / RT)
   third body: rate *= cM_i = sum_k eff_ik c_k
   falloff:   kf = k_inf * Pr/(1+Pr) * F,  Pr = k0 cM / k_inf,
-             F = 1 (Lindemann) or TROE blending
+             F = 1 (Lindemann), TROE, or SRI blending
   reverse:   kr = kf / Kc, Kc = exp(-sum_k dnu_ik g_k/RT) * (p_atm/RT)^dnu_i
   wdot_k = sum_i dnu_ik (ratef_i - rater_i),  dnu = nu_r - nu_f
 """
@@ -113,6 +113,43 @@ def _troe_F(T, Pr, troe, has_troe, with_grad=False):
     dlogF_dlp = -log_fc * 2.0 * f1 * df1_dlp / (one_f1 * one_f1)
     dF_dPr = jnp.where(has_troe > 0, F_troe * dlogF_dlp / Pr_safe, 0.0)
     return F, dF_dPr
+
+
+def _sri_F(T, Pr, sri, has_sri, with_grad=False):
+    """SRI falloff blending factor; returns 1 where not SRI, finite always.
+
+    F = d T^e [a exp(-b/T) + exp(-T/c)]^X with X = 1/(1 + log10(Pr)^2)
+    (CHEMKIN-II; 3-parameter form has d=1, e=0).  Shares the forward /
+    gradient single-implementation rule with :func:`_troe_F`.
+    """
+    a, b, c = sri[:, 0], sri[:, 1], sri[:, 2]
+    d, e = sri[:, 3], sri[:, 4]
+    Pr_safe = jnp.maximum(Pr, _TINY)
+    lp = jnp.log(Pr_safe) / _LOG10
+    X = 1.0 / (1.0 + lp * lp)
+    base = jnp.maximum(a * _exp(-b / T) + _exp(-T / c), _TINY)
+    ln_base = jnp.log(base)
+    F_sri = d * _exp(e * jnp.log(T)) * _exp(X * ln_base)
+    F = jnp.where(has_sri > 0, F_sri, 1.0)
+    if not with_grad:
+        return F
+    # dF/dPr = F ln(base) dX/dlp dlp/dPr;  dX/dlp = -2 lp X^2
+    dF_dPr = jnp.where(
+        has_sri > 0,
+        F_sri * ln_base * (-2.0 * lp * X * X) / (_LOG10 * Pr_safe), 0.0)
+    return F, dF_dPr
+
+
+def _blend_F(T, Pr, gm, with_grad=False):
+    """Falloff blending F (TROE, SRI, or Lindemann F=1) with optional
+    dF/dPr.  TROE and SRI are mutually exclusive per reaction (parse-time
+    check), so the product form composes the masked factors exactly."""
+    if not with_grad:
+        return (_troe_F(T, Pr, gm.troe, gm.has_troe)
+                * _sri_F(T, Pr, gm.sri, gm.has_sri))
+    Ft, dFt = _troe_F(T, Pr, gm.troe, gm.has_troe, with_grad=True)
+    Fs, dFs = _sri_F(T, Pr, gm.sri, gm.has_sri, with_grad=True)
+    return Ft * Fs, dFt * Fs + Ft * dFs
 
 
 def _plog_interp(T, conc, gm):
@@ -224,7 +261,7 @@ def forward_rate_constants(T, conc, gm, with_grad=False,
     tb_factor = jnp.where(gm.has_tb > 0, cM, 1.0)
     fc = cM_pos * 1e-6 if falloff_compat else 1.0
     if not with_grad:
-        F = _troe_F(T, Pr, gm.troe, gm.has_troe)
+        F = _blend_F(T, Pr, gm)
         # sign_A: negative-A DUPLICATE rows (ln-domain stores |A|, the sign
         # is a linear side channel; falloff rows are parse-time positive)
         kf = gm.sign_A * jnp.where(gm.has_falloff > 0, k_inf * L * F * fc,
@@ -238,7 +275,7 @@ def forward_rate_constants(T, conc, gm, with_grad=False,
             kf = jnp.where(gm.has_cheb > 0,
                            _exp(jnp.clip(lnk_c, -_EXP_MAX, _EXP_MAX)), kf)
         return kf, tb_factor
-    F, dF_dPr = _troe_F(T, Pr, gm.troe, gm.has_troe, with_grad=True)
+    F, dF_dPr = _blend_F(T, Pr, gm, with_grad=True)
     kf = gm.sign_A * jnp.where(gm.has_falloff > 0, k_inf * L * F * fc, k_inf)
     dkf_dPr = k_inf * (F / ((1.0 + Pr) * (1.0 + Pr)) + L * dF_dPr)
     # the forward path clamps Pr (and fc) at cM=0, so the true derivative is

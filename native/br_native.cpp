@@ -66,6 +66,8 @@ struct BrGasMech {
   const double* Ea0;         // (R,)
   const double* has_troe;    // (R,)
   const double* troe;        // (R,4) a, T3, T1, T2
+  const double* has_sri;     // (R,)
+  const double* sri;         // (R,5) a, b, c, d, e
   const double* rev_mask;    // (R,)
   const double* sign_A;      // (R,) +-1; negative-A DUPLICATE rows
   const double* has_rev;     // (R,) 1.0 where explicit REV parameters
@@ -153,6 +155,18 @@ void br_gas_rhs(const BrGasMech* m, double T, const double* y, double* dy) {
         const double log_pr = std::log(Pr > kTiny ? Pr : kTiny) / kLog10;
         const double f1 = (log_pr + c) / (n - 0.14 * (log_pr + c));
         F = std::exp(kLog10 * log_fc / (1.0 + f1 * f1));
+      }
+      if (m->has_sri[i] > 0) {
+        // SRI blending: F = d T^e [a exp(-b/T) + exp(-T/c)]^X,
+        // X = 1/(1 + log10(Pr)^2)  (mirrors ops/gas_kinetics._sri_F)
+        const double* s = m->sri + i * 5;
+        const double lp = std::log(Pr > kTiny ? Pr : kTiny) / kLog10;
+        const double X = 1.0 / (1.0 + lp * lp);
+        double base = s[0] * std::exp(-s[1] / T);
+        if (std::isfinite(s[2])) base += std::exp(-T / s[2]);
+        else base += 1.0;
+        if (base < kTiny) base = kTiny;
+        F = s[3] * std::pow(T, s[4]) * std::exp(X * std::log(base));
       }
       kf = kf * (Pr / (1.0 + Pr)) * F;
       // reference-parity falloff (PARITY.md, resolved round 2): the blended

@@ -402,3 +402,104 @@ def test_cheb_collider_and_bad_dims_loud(tmp_path):
     with pytest.raises(ValueError, match="1..16"):
         br.compile_gaschemistry(_mini_mech(
             tmp_path, "H2+O2=>2OH 1.0 0. 0.\nCHEB / 9999999 1 8.0 /\n"))
+
+
+# --- SRI falloff blending (CHEMKIN-II breadth) ---
+
+def test_sri_hand_computed(tmp_path, fixtures_dir):
+    """SRI falloff: kf = k_inf L F with F = d T^e [a e^{-b/T} + e^{-T/c}]^X,
+    X = 1/(1 + log10(Pr)^2) — hand-computed against the kernel, 3- and
+    5-parameter forms."""
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import reaction_rates
+    from batchreactor_tpu.utils.constants import CAL_TO_J, R
+
+    # irreversible => isolates the forward falloff rate (no Kc reverse)
+    mech = _mini_mech(tmp_path,
+                      "H2+O2(+M)=>2OH(+M)   4.0E13  0.5  1000.\n"
+                      "LOW /2.0E16  0.0  800./\n"
+                      "SRI /0.45  797.  979./\n"
+                      "2OH(+M)=>H2+O2(+M)  3.0E13  0.0  1200.\n"
+                      "LOW /1.0E16  0.0  700./\n"
+                      "SRI /0.54  201.  1024.  0.7  0.1/\n")
+    gm = br.compile_gaschemistry(mech)
+    assert np.asarray(gm.has_sri).tolist() == [1.0, 1.0]
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    T = 1150.0
+    conc = np.array([2.0, 1.5, 0.7, 0.4, 3.0])  # H2 O2 OH H2O N2, mol/m^3
+    q = np.asarray(reaction_rates(T, jnp.asarray(conc), gm, th))
+
+    cM = conc.sum()  # default efficiencies 1
+    fwd_conc = [conc[0] * conc[1], conc[2] ** 2]
+    for i, (A, bexp, Ea, low, sri) in enumerate([
+            (4.0e13, 0.5, 1000.0, (2.0e16, 0.0, 800.0),
+             (0.45, 797.0, 979.0, 1.0, 0.0)),
+            (3.0e13, 0.0, 1200.0, (1.0e16, 0.0, 700.0),
+             (0.54, 201.0, 1024.0, 0.7, 0.1))]):
+        kinf = A * 1e-6 * T**bexp * np.exp(-Ea * CAL_TO_J / (R * T))
+        k0 = low[0] * 1e-12 * T**low[1] * np.exp(-low[2] * CAL_TO_J / (R * T))
+        Pr = k0 * cM / kinf
+        X = 1.0 / (1.0 + np.log10(Pr) ** 2)
+        base = sri[0] * np.exp(-sri[1] / T) + np.exp(-T / sri[2])
+        F = sri[3] * T ** sri[4] * base ** X
+        k_hand = kinf * (Pr / (1.0 + Pr)) * F
+        np.testing.assert_allclose(float(q[i]), k_hand * fwd_conc[i],
+                                   rtol=1e-10)
+
+
+def test_sri_jacobian_matches_jacfwd(tmp_path, fixtures_dir):
+    """The closed-form Jacobian carries the SRI dF/dPr chain exactly."""
+    import jax
+    import jax.numpy as jnp
+    from batchreactor_tpu.ops.gas_kinetics import (production_rates,
+                                                   production_rates_and_jac)
+
+    mech = _mini_mech(tmp_path,
+                      "H2+O2(+M)=2OH(+M)   4.0E13  0.5  1000.\n"
+                      "LOW /2.0E16  0.0  800./\n"
+                      "SRI /0.45  797.  979./\n"
+                      "2OH=H2O+O2  1.0E12  0.0  300.\n")
+    gm = br.compile_gaschemistry(mech)
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    T = 1200.0
+    conc = jnp.asarray([2.0, 1.5, 0.7, 0.4, 3.0])
+    _, J = production_rates_and_jac(T, conc, gm, th)
+    J_fd = jax.jacfwd(lambda c: production_rates(T, c, gm, th))(conc)
+    np.testing.assert_allclose(np.asarray(J), np.asarray(J_fd), rtol=1e-10,
+                               atol=1e-10 * float(jnp.abs(J_fd).max()))
+
+
+def test_sri_native_parity(tmp_path, fixtures_dir):
+    """The native C++ runtime mirrors the SRI blending to roundoff."""
+    import jax.numpy as jnp
+    from batchreactor_tpu import native
+    from batchreactor_tpu.ops.rhs import make_gas_rhs
+
+    mech = _mini_mech(tmp_path,
+                      "H2+O2(+M)=2OH(+M)   4.0E13  0.5  1000.\n"
+                      "LOW /2.0E16  0.0  800./\n"
+                      "SRI /0.54  201.  1024.  0.7  0.1/\n")
+    gm = br.compile_gaschemistry(mech)
+    th = br.create_thermo(list(gm.species), f"{fixtures_dir}/therm.dat")
+    T = 1150.0
+    y = np.array([0.1, 0.5, 0.01, 0.02, 0.37])  # rho_k, kg/m^3
+    dy_jax = np.asarray(make_gas_rhs(gm, th)(0.0, jnp.asarray(y), {"T": T}))
+    dy_nat = native.gas_rhs(gm, th, T, y)
+    np.testing.assert_allclose(dy_nat, dy_jax, rtol=1e-10,
+                               atol=1e-12 * np.abs(dy_jax).max())
+
+
+def test_sri_validation(tmp_path):
+    with pytest.raises(ValueError, match="non-falloff"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2=2OH  1.0E13 0. 0.\nSRI /0.5 100. 200./\n"))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2(+M)=2OH(+M)  1.0E13 0. 0.\n"
+                      "LOW /1.0E16 0. 0./\n"
+                      "TROE /0.6 100. 1000./\n"
+                      "SRI /0.5 100. 200./\n"))
+    with pytest.raises(ValueError, match="3 or 5"):
+        br.compile_gaschemistry(_mini_mech(
+            tmp_path, "H2+O2(+M)=2OH(+M)  1.0E13 0. 0.\n"
+                      "LOW /1.0E16 0. 0./\nSRI /0.5 100./\n"))
