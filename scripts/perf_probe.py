@@ -57,15 +57,20 @@ VARIANTS = {
     # solve per step vs SDIRK4's five — measured 6x on CPU, and the
     # measured lever matrix on TPU (PERF.md): inv32nr +18% bit-identical,
     # exp32 +1.6% at 4.4e-5 tau shift
+    # bdf variants pin BENCH_JAC_WINDOW too: the bench's own bdf default
+    # is now jac_window=8, which would silently leak into these baselines
     "bdf": {"BENCH_METHOD": "bdf", "BR_EXP32": "0",
-            "BENCH_LINSOLVE": "inv32"},
+            "BENCH_LINSOLVE": "inv32", "BENCH_JAC_WINDOW": "1"},
     "bdf_nr": {"BENCH_METHOD": "bdf", "BR_EXP32": "0",
-               "BENCH_LINSOLVE": "inv32nr"},
+               "BENCH_LINSOLVE": "inv32nr", "BENCH_JAC_WINDOW": "1"},
     "bdf_exp32nr": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
-                    "BENCH_LINSOLVE": "inv32nr"},
-    # the adopted accelerator default (PERF.md): f32 preconditioner matvec
+                    "BENCH_LINSOLVE": "inv32nr", "BENCH_JAC_WINDOW": "1"},
     "bdf_exp32f": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
-                   "BENCH_LINSOLVE": "inv32f"},
+                   "BENCH_LINSOLVE": "inv32f", "BENCH_JAC_WINDOW": "1"},
+    # the adopted accelerator default stack (PERF.md)
+    "bdf_exp32f_jw8": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
+                       "BENCH_LINSOLVE": "inv32f",
+                       "BENCH_JAC_WINDOW": "8"},
 }
 
 
