@@ -1,20 +1,18 @@
 """Measure solver-variant throughput on the live accelerator.
 
-Round-3 analysis (PERF.md): at B=128 a batched SDIRK step attempt costs
-~22 ms on (128, 53) tensors — far below compute limits — so the candidate
-levers are kernel-count and f64-emulation reductions.  This probe measures
-them head-to-head on the bench workload (GRI ignition sweep, B=128,
-t1=8e-4 s, rtol 1e-6 / atol 1e-10), each variant in its own subprocess via
-bench.py's rung mode:
-
-  base     inv32 Newton solve (f32 inverse + f64 refinement), f64 exp
-  nr       inv32nr — drop the two refinement matvecs per Newton iteration
-  exp32    BR_EXP32=1 — rate-expression exponentials evaluated in f32
-  exp32nr  both
+Round-3 analysis (PERF.md): a batched step attempt runs far below compute
+limits — the candidate levers are kernel-count and f64-emulation
+reductions.  This probe measures them head-to-head on the bench workload
+(GRI ignition sweep, B=128 by default, t1=8e-4 s, rtol 1e-6 / atol
+1e-10), each variant in its own subprocess via bench.py's rung mode.
+The VARIANTS table below is the authoritative list: SDIRK levers (Newton
+refinement, f32 exponentials, Jacobian window, Newton tolerance), the
+BDF solver against the same lever matrix, and the adopted accelerator
+default stack (bdf + exp32 + inv32f + jac_window=8).
 
 Correctness gate: every variant's per-lane ignition delays must match the
-base variant (max rel diff reported; < 1e-3 expected — the variants perturb
-rate constants by ~1e-7 at most).  Results land in PERF_PROBE.json.
+base variant (max rel diff reported; < 1e-3 expected — the measured lever
+shifts are ~2.5e-5 at worst, PERF.md).  Results land in PERF_PROBE.json.
 
 Run only on a healthy chip (the probe pre-flights like bench.py).
 """
