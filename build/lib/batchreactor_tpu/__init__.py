@@ -1,0 +1,52 @@
+"""batchreactor_tpu — TPU-native batch-reactor chemical-kinetics framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``vinodjanardhanan/BatchReactor.jl`` (isothermal constant-volume batch reactor
+with CHEMKIN gas-phase chemistry, mean-field surface chemistry, both coupled,
+or a user-defined rate function; see /root/reference/src/BatchReactor.jl).
+
+Architecture (host -> device):
+  host parsers (CHEMKIN / NASA-7 / surface XML / batch XML)
+    -> frozen mechanism pytrees of jnp tensors
+    -> pure jitted kinetics kernels (thermo, gas rates, surface rates, RHS)
+    -> batched implicit stiff integrators (SDIRK4 and variable-order
+       BDF 1..5, Newton + mixed-precision LU, vmap-able)
+    -> mesh-sharded ensemble sweeps (jax.sharding, collective-free)
+    -> API layer reproducing the reference's three batch_reactor signatures.
+
+Chemistry spans ~40 orders of magnitude and the reference integrates at
+abstol=1e-10 (/root/reference/src/BatchReactor.jl:210), so float64 is enabled
+at import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .models.thermo import ThermoTable, create_thermo  # noqa: E402
+from .models.gas import GasMechanism, compile_gaschemistry  # noqa: E402
+from .models.surface import SurfaceMechanism, compile_mech  # noqa: E402
+from .api import (  # noqa: E402
+    Chemistry,
+    SensitivityProblem,
+    batch_reactor,
+    batch_reactor_sweep,
+)
+from .io.config import InputData, input_data  # noqa: E402
+
+__all__ = [
+    "ThermoTable",
+    "create_thermo",
+    "GasMechanism",
+    "compile_gaschemistry",
+    "SurfaceMechanism",
+    "compile_mech",
+    "Chemistry",
+    "SensitivityProblem",
+    "batch_reactor",
+    "batch_reactor_sweep",
+    "InputData",
+    "input_data",
+]
+
+__version__ = "0.1.0"

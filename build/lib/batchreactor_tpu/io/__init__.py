@@ -1,0 +1,4 @@
+from .config import InputData, input_data, parse_composition_text
+from .writers import write_profiles
+
+__all__ = ["InputData", "input_data", "parse_composition_text", "write_profiles"]

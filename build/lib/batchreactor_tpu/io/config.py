@@ -1,0 +1,137 @@
+"""Batch-reactor XML configuration parsing (host side, stdlib xml.etree).
+
+Accepts the reference's input format verbatim (``<batch>`` root with tags
+``gasphase, molefractions|massfractions, T, p, Asv, time, gas_mech,
+surface_mech`` — /root/reference/src/BatchReactor.jl:238-306, tag docs at
+/root/reference/docs/src/index.md:80-123).  The reference goes through
+libxml2 via LightXML (:153-154); host-side parsing needs no TPU analog, so
+this is plain ``xml.etree``.
+"""
+
+import dataclasses
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from ..models.gas import GasMechanism, compile_gaschemistry
+from ..models.surface import SurfaceMechanism, compile_mech
+from ..models.thermo import ThermoTable, create_thermo
+
+
+@dataclasses.dataclass(frozen=True)
+class InputData:
+    """Parsed run configuration (mirrors the reference's ``InputData`` struct,
+    /root/reference/src/BatchReactor.jl:28-39), with mechanisms already
+    compiled to device tensor bundles."""
+
+    T: float                  # K (isothermal — constant through the run)
+    p: float                  # Pa (initial; recomputed algebraically after)
+    Asv: float                # surface-area-to-volume ratio, 1/m
+    tf: float                 # integration horizon, s
+    species: tuple            # gas-phase species names (state layout order)
+    mole_fracs: np.ndarray    # (S,) initial gas mole fractions
+    thermo: ThermoTable
+    gmd: GasMechanism | None
+    smd: SurfaceMechanism | None
+
+
+def parse_composition_text(text, species):
+    """``"CH4=0.25,O2=0.5,N2=0.25"`` -> zero-filled (S,) fraction vector.
+
+    Missing species get 0 (the reference's ``get_mole_fracs`` closure
+    zero-fills too, /root/reference/src/BatchReactor.jl:92-100); unknown
+    species are an error.
+    """
+    index = {s.upper(): k for k, s in enumerate(species)}
+    fracs = np.zeros(len(species))
+    for item in text.replace("\n", ",").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, val = item.partition("=")
+        key = name.strip().upper()
+        if key not in index:
+            raise KeyError(
+                f"composition species {name.strip()!r} not in the gas-phase "
+                f"species list"
+            )
+        fracs[index[key]] = float(val)
+    return fracs
+
+
+def input_data(xml_file, lib_dir, chem):
+    """Parse a ``batch.xml`` + mechanism library into an InputData.
+
+    Role-equivalent to ``input_data`` in the reference
+    (/root/reference/src/BatchReactor.jl:238-306): species order comes from
+    the gas mechanism when ``chem.gaschem`` (:255) else from the
+    ``<gasphase>`` tag (:258-259); thermo always loads from
+    ``lib_dir/therm.dat`` (:242-243); a ``<massfractions>`` tag is accepted
+    in place of ``<molefractions>`` (docs/src/index.md:116).
+    """
+    from ..utils.composition import mass_to_mole  # local: avoid jnp at import
+
+    root = ET.parse(xml_file).getroot()
+    if root.tag != "batch":
+        raise ValueError(f"expected <batch> root in {xml_file}, got <{root.tag}>")
+
+    def text(tag):
+        el = root.find(tag)
+        return None if el is None or el.text is None else el.text.strip()
+
+    def value(tag, default=None):
+        t = text(tag)
+        if t is None:
+            if default is None:
+                raise KeyError(f"missing required tag <{tag}> in {xml_file}")
+            return default
+        return float(t)
+
+    gmd = None
+    if chem.gaschem:
+        mech = text("gas_mech")
+        if mech is None:
+            raise KeyError(f"gaschem run needs <gas_mech> in {xml_file}")
+        gmd = compile_gaschemistry(os.path.join(lib_dir, mech))
+        species = gmd.species
+    else:
+        gp = text("gasphase")
+        if gp is None:
+            raise KeyError(f"non-gaschem run needs <gasphase> in {xml_file}")
+        species = tuple(s.upper() for s in gp.split())
+
+    thermo = create_thermo(species, os.path.join(lib_dir, "therm.dat"))
+
+    comp_text = text("molefractions")
+    if comp_text is not None:
+        mole_fracs = parse_composition_text(comp_text, species)
+    else:
+        comp_text = text("massfractions")
+        if comp_text is None:
+            raise KeyError(
+                f"need <molefractions> or <massfractions> in {xml_file}"
+            )
+        mass = parse_composition_text(comp_text, species)
+        mole_fracs = np.asarray(mass_to_mole(mass, thermo.molwt))
+
+    smd = None
+    if chem.surfchem:
+        mech = text("surface_mech")
+        if mech is None:
+            raise KeyError(f"surfchem run needs <surface_mech> in {xml_file}")
+        smd = compile_mech(os.path.join(lib_dir, mech), thermo, species)
+
+    return InputData(
+        T=value("T"),
+        p=value("p"),
+        # missing <Asv> defaults to 1 (confirmed against the golden
+        # batch_gas_and_surf trajectory, PARITY.md)
+        Asv=value("Asv", default=1.0),
+        tf=value("time"),
+        species=species,
+        mole_fracs=mole_fracs,
+        thermo=thermo,
+        gmd=gmd,
+        smd=smd,
+    )

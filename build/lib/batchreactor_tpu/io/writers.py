@@ -1,0 +1,101 @@
+"""Post-hoc trajectory writers reproducing the reference's output files.
+
+The reference streams one row per CVODE-accepted step from an in-loop
+callback (/root/reference/src/BatchReactor.jl:208,383-402) into four streams:
+``gas_profile.dat/.csv`` (t, T, p, rho, x_k) and ``surface_covg.dat/.csv``
+(t, T, theta_k), placed next to the input XML (:170-173).  Host callbacks
+per step would serialize the TPU solve, so we save accepted steps to a
+device buffer during the solve and write identical files afterwards.
+
+Formats (golden artifacts at /root/reference/test/batch_gas_and_surf/):
+``.dat`` — 10-wide right-aligned tab-separated header, ``%.4e`` rows;
+``.csv`` — comma-separated full-precision floats (``repr`` round-trip).
+"""
+
+import os
+
+import numpy as np
+
+
+def _write_dat(path, names, rows):
+    with open(path, "w") as f:
+        f.write("".join(f"{n:>10s}\t" for n in names) + "\n")
+        for row in rows:
+            f.write("".join(f"{v:.4e}\t" for v in row) + "\n")
+
+
+def _write_csv(path, names, rows):
+    with open(path, "w") as f:
+        f.write(",".join(names) + "\n")
+        for row in rows:
+            f.write(",".join(repr(float(v)) for v in row) + "\n")
+
+
+def trim_trajectory(t0, y0, res):
+    """(ts, ys, truncated) including the initial row, from a SolveResult.
+
+    The buffer pads unused rows with t=+inf (solver/sdirk.py); ``n_saved``
+    counts the valid rows.  The reference's files likewise start with the
+    initial state followed by every accepted step.  If the solve accepted
+    more steps than the buffer holds, the dropped tail is bridged by
+    appending the true final state ``(res.t, res.y)`` and ``truncated`` is
+    True — the last row is always the end of the integration.
+    """
+    n = int(res.n_saved)
+    ts = np.concatenate([[float(t0)], np.asarray(res.ts[:n])])
+    ys = np.concatenate([np.asarray(y0)[None, :], np.asarray(res.ys[:n])])
+    truncated = int(res.n_accepted) > n
+    if truncated:
+        ts = np.concatenate([ts, [float(res.t)]])
+        ys = np.concatenate([ys, np.asarray(res.y)[None, :]])
+    return ts, ys, truncated
+
+
+def gas_profile_rows(ts, ys, T, molwt, ng):
+    """Rows (t, T, p, rho, x_1..x_S) from saved states y = rho_k [+theta].
+
+    Column layout per /root/reference/docs/src/index.md:158-170 and the
+    golden ``gas_profile.csv`` header.
+    """
+    from ..utils.constants import R
+
+    rho_k = ys[:, :ng]
+    rho = rho_k.sum(axis=1)
+    moles = rho_k / molwt[None, :]   # molar concentration c_k [mol/m^3]
+    x = moles / moles.sum(axis=1, keepdims=True)
+    p = moles.sum(axis=1) * R * T    # = rho R T / Wbar, ideal gas
+    return np.column_stack([ts, np.full_like(ts, T), p, rho, x])
+
+
+def coverage_rows(ts, ys, T, ng):
+    """Rows (t, T, theta_1..theta_Ss) — golden ``surface_covg.csv`` layout."""
+    return np.column_stack([ts, np.full_like(ts, T), ys[:, ng:]])
+
+
+def write_profiles(out_dir, species, ts, ys, T, molwt, surface_species=None):
+    """Write gas_profile.{dat,csv} (+ surface_covg.{dat,csv} if surface
+    species present) into ``out_dir``; returns the list of paths written.
+
+    Note the docs call the coverage file ``surf_covg.dat`` but the code
+    writes ``surface_covg.dat`` (/root/reference/src/BatchReactor.jl:171 vs
+    docs/src/index.md:132) — we match the code.
+    """
+    ng = len(species)
+    gas_names = ["t", "T", "p", "rho"] + list(species)
+    gas = gas_profile_rows(ts, ys, T, np.asarray(molwt), ng)
+    paths = [
+        os.path.join(out_dir, "gas_profile.dat"),
+        os.path.join(out_dir, "gas_profile.csv"),
+    ]
+    _write_dat(paths[0], gas_names, gas)
+    _write_csv(paths[1], gas_names, gas)
+
+    if surface_species:
+        cov_names = ["t", "T"] + list(surface_species)
+        cov = coverage_rows(ts, ys, T, ng)
+        p_dat = os.path.join(out_dir, "surface_covg.dat")
+        p_csv = os.path.join(out_dir, "surface_covg.csv")
+        _write_dat(p_dat, cov_names, cov)
+        _write_csv(p_csv, cov_names, cov)
+        paths += [p_dat, p_csv]
+    return paths

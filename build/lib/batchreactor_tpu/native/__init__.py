@@ -1,0 +1,37 @@
+"""Python bindings for the native (C++) runtime — ctypes, no pybind11.
+
+The reference delegates its heavy numerics to wrapped C libraries (SUNDIALS
+CVODE at /root/reference/src/BatchReactor.jl:138,210; libxml2 via LightXML).
+This package wraps the framework's own native runtime ``native/br_native.cpp``
+— CHEMKIN-semantics gas and surface RHS kernels plus a CVODE-class
+variable-order BDF — built on demand with g++ into ``native/libbr_native.so``
+and loaded with ctypes.
+
+Uses: ``backend="cpu"`` single-condition runs (all chemistry modes), the
+self-measured single-CPU bench baseline (BASELINE.md protocol), and
+solver-vs-solver / RHS-vs-RHS test oracles.
+"""
+
+from .bindings import (  # noqa: F401
+    NativeUnavailable,
+    available,
+    gas_rhs,
+    load_library,
+    solve_bdf,
+    solve_gas_bdf,
+    solve_surf_bdf,
+    surf_rhs,
+    surface_rates,
+)
+
+__all__ = [
+    "NativeUnavailable",
+    "available",
+    "gas_rhs",
+    "load_library",
+    "solve_bdf",
+    "solve_gas_bdf",
+    "solve_surf_bdf",
+    "surf_rhs",
+    "surface_rates",
+]

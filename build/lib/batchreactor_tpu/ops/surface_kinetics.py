@@ -1,0 +1,127 @@
+"""Surface molar production rates as a pure jnp kernel.
+
+Device-side rebuild of ``SurfaceReactions.calculate_molar_production_rates!``
+(/root/reference/src/BatchReactor.jl:344).  Pure function of
+(T, p, gas mole fractions, coverages); returns SI production rates
+(mol/m^2/s) for gas species and surface species separately.  Rate-law
+conventions are pinned against the committed golden trajectory — see the
+models/surface.py module docstring.
+
+Internally works in cgs (mol/cm^3 gas, mol/cm^2 surface) because the
+mechanism's A values are cgs; the single x1e4 conversion happens at the end.
+"""
+
+import jax.numpy as jnp
+
+from ..utils.constants import R
+# the forward rates and the analytic Jacobian share ONE stoichiometric-
+# product implementation (clamps included) so the 'Jacobian == derivative
+# of the RHS' invariant cannot drift between two copies of the math
+from .gas_kinetics import _stoich_prod, _stoich_prod_and_grad
+
+_EXP_MAX = 690.0
+# cgs gas constant for the sticking flux sqrt(R T / 2 pi M): erg/(mol K)
+_R_CGS = R * 1e7
+_PI = 3.141592653589793
+
+
+def rate_constants(T, theta, sm, with_grad=False):
+    """Effective rate constants (R,), cgs units.
+
+    ``with_grad=True`` additionally returns dk/dtheta (R, Ss) — the single
+    implementation both the forward rates and the analytic Jacobian use
+    (same discipline as gas_kinetics._troe_F), so the 'Jacobian matches
+    jacfwd to roundoff' invariant cannot drift.
+    """
+    # coverage-dependent activation energy: Ea_eff = Ea + eps @ theta
+    # (applies to Arrhenius AND sticking rows — a <coverage> tag targeting a
+    # stick id modifies the sticking probability's activation energy)
+    Ea_eff = sm.Ea + sm.cov_eps @ theta
+    log_arg = sm.beta * jnp.log(T) - Ea_eff / (R * T)
+    k_arr = jnp.exp(jnp.clip(sm.log_A + log_arg, -_EXP_MAX, _EXP_MAX))
+    # sticking: (s0/(1-s0/2) if MWC) sqrt(RT/2piM) [cm/s], theta enters the
+    # rate directly (no Gamma^m) — golden-trajectory convention
+    s_raw = sm.stick_s0 * jnp.exp(jnp.clip(log_arg, -_EXP_MAX, _EXP_MAX))
+    denom = 1.0 - s_raw / 2.0
+    s_eff = jnp.where(sm.mwc > 0, s_raw / denom, s_raw)
+    flux = jnp.sqrt(_R_CGS * T / (2.0 * _PI * sm.stick_molwt))
+    k = jnp.where(sm.stick > 0, s_eff * flux, k_arr)
+    if not with_grad:
+        return k
+    # d/dEa_eff: Arrhenius -k/(RT); stick s_raw' = -s_raw/(RT) through the
+    # Motz-Wise chain d(s/(1-s/2))/ds = 1/denom^2
+    dmwc_ds = jnp.where(sm.mwc > 0, 1.0 / (denom * denom), 1.0)
+    dk_dEa = jnp.where(sm.stick > 0,
+                       flux * dmwc_ds * (-s_raw / (R * T)),
+                       -k_arr / (R * T))
+    return k, dk_dEa[:, None] * sm.cov_eps
+
+
+def reaction_rates(T, p, mole_fracs, theta, sm):
+    """Rate of progress per reaction (R,), mol/cm^2/s."""
+    c_gas = mole_fracs * p / (R * T) * 1e-6           # mol/cm^3
+    c_surf = theta * sm.site_density / sm.site_coordination  # mol/cm^2
+    k = rate_constants(T, theta, sm)
+    gas_part = _stoich_prod(c_gas, sm.expo_gas, sm.int_expo)
+    # stick rows use raw coverages; Arrhenius rows use surface concentrations
+    surf_conc_part = _stoich_prod(c_surf, sm.expo_surf, sm.int_expo)
+    surf_theta_part = _stoich_prod(theta, sm.expo_surf, sm.int_expo)
+    surf_part = jnp.where(sm.stick > 0, surf_theta_part, surf_conc_part)
+    return k * gas_part * surf_part
+
+
+def production_rates(T, p, mole_fracs, theta, sm):
+    """(sdot_gas (Sg,), sdot_surf (Ss,)) in SI mol/m^2/s."""
+    q = reaction_rates(T, p, mole_fracs, theta, sm)  # mol/cm^2/s
+    sdot_gas = (sm.nu_r_gas - sm.nu_f_gas).T @ q * 1e4
+    sdot_surf = (sm.nu_r_surf - sm.nu_f_surf).T @ q * 1e4
+    return sdot_gas, sdot_surf
+
+
+def production_rates_and_jac(T, p, mole_fracs, theta, sm):
+    """Production rates plus their closed-form Jacobian blocks.
+
+    Returns ``(sdot_gas, sdot_surf, (dgas_dcg, dgas_dth, dsurf_dcg,
+    dsurf_dth))`` where the derivative blocks are of the *SI* production
+    rates with respect to the *cgs* gas concentrations c_gas = x p/(RT) 1e-6
+    [mol/cm^3] and the raw coverages theta.  The reactor-state chain rule
+    (c_gas_k = rho_k / M_k * 1e-6 in the batch-reactor state) lives in
+    ops/rhs.make_surface_jac.
+
+    Rationale mirrors gas_kinetics.production_rates_and_jac: the implicit
+    solver rebuilds this matrix every Newton step attempt, and
+    ``jax.jacfwd`` through :func:`production_rates` costs one forward pass
+    per state entry (66 for the gas+surf GRI+CH4/Ni flagship —
+    /root/reference/src/BatchReactor.jl:344 is the reference's surface
+    hot-loop call).  Derivative structure per reaction row j:
+
+      q_j = k_j(theta) * G_j(c_gas) * S_j(theta)
+      dk_j/dtheta_k = (dk_j/dEa_eff) cov_eps_jk — coverage-dependent
+        activation energy, through the Arrhenius exp or the sticking
+        probability (incl. the Motz-Wise chain d(s/(1-s/2))/ds = 1/(1-s/2)^2)
+      dS_j/dtheta_k: stick rows use raw coverages; Arrhenius rows go through
+        surface concentrations c_surf = theta Gamma/sigma.
+    """
+    c_gas = mole_fracs * p / (R * T) * 1e-6                  # mol/cm^3
+    gamma_sig = sm.site_density / sm.site_coordination        # (Ss,)
+    c_surf = theta * gamma_sig                                # mol/cm^2
+
+    k, dk_dth = rate_constants(T, theta, sm, with_grad=True)  # (R,), (R, Ss)
+
+    # --- stoichiometric products and gradients -----------------------------
+    G, dG = _stoich_prod_and_grad(c_gas, sm.expo_gas, sm.int_expo)
+    Sc, dSc = _stoich_prod_and_grad(c_surf, sm.expo_surf, sm.int_expo)
+    St, dSt = _stoich_prod_and_grad(theta, sm.expo_surf, sm.int_expo)
+    S_sel = jnp.where(sm.stick > 0, St, Sc)
+    dS_dth = jnp.where(sm.stick[:, None] > 0, dSt,
+                       dSc * gamma_sig[None, :])
+
+    q = k * G * S_sel                                         # mol/cm^2/s
+    dq_dcg = (k * S_sel)[:, None] * dG                        # (R, Sg)
+    dq_dth = (G * S_sel)[:, None] * dk_dth + (k * G)[:, None] * dS_dth
+
+    dnu_g = sm.nu_r_gas - sm.nu_f_gas                         # (R, Sg)
+    dnu_s = sm.nu_r_surf - sm.nu_f_surf                       # (R, Ss)
+    return (dnu_g.T @ q * 1e4, dnu_s.T @ q * 1e4,
+            (dnu_g.T @ dq_dcg * 1e4, dnu_g.T @ dq_dth * 1e4,
+             dnu_s.T @ dq_dcg * 1e4, dnu_s.T @ dq_dth * 1e4))

@@ -1,0 +1,51 @@
+"""NASA-7 polynomial evaluation as pure jnp ops.
+
+Device-side counterpart of the thermodynamic evaluations the reference
+delegates to ``IdealGas`` (Gibbs/Kp buffers ``g_all``/``Kp`` at
+/root/reference/src/BatchReactor.jl:192-194).  Everything here is a pure
+function of (T, ThermoTable) so it traces into the jitted RHS and vmaps over
+ensemble lanes.
+
+NASA-7 (per species, per range, coefficients a1..a7):
+  cp/R  = a1 + a2 T + a3 T^2 + a4 T^3 + a5 T^4
+  h/RT  = a1 + a2/2 T + a3/3 T^2 + a4/4 T^3 + a5/5 T^4 + a6/T
+  s/R   = a1 ln T + a2 T + a3/2 T^2 + a4/3 T^3 + a5/4 T^4 + a7
+"""
+
+import jax.numpy as jnp
+
+
+def _select_coeffs(T, table):
+    """(S, 7) coefficients for scalar T, switching ranges at T_mid."""
+    use_high = (T > table.T_mid)[:, None]
+    return jnp.where(use_high, table.coeffs[:, 1, :], table.coeffs[:, 0, :])
+
+
+def cp_h_s_over_R(T, table):
+    """Returns (cp/R, h/(RT), s/R), each (S,), at scalar temperature T."""
+    a = _select_coeffs(T, table)
+    T2, T3, T4 = T * T, T * T * T, T * T * T * T
+    cp = a[:, 0] + a[:, 1] * T + a[:, 2] * T2 + a[:, 3] * T3 + a[:, 4] * T4
+    h = (
+        a[:, 0]
+        + a[:, 1] / 2 * T
+        + a[:, 2] / 3 * T2
+        + a[:, 3] / 4 * T3
+        + a[:, 4] / 5 * T4
+        + a[:, 5] / T
+    )
+    s = (
+        a[:, 0] * jnp.log(T)
+        + a[:, 1] * T
+        + a[:, 2] / 2 * T2
+        + a[:, 3] / 3 * T3
+        + a[:, 4] / 4 * T4
+        + a[:, 6]
+    )
+    return cp, h, s
+
+
+def gibbs_over_RT(T, table):
+    """g_k/(RT) = h/(RT) - s/R for each species, (S,)."""
+    _, h, s = cp_h_s_over_R(T, table)
+    return h - s

@@ -1,0 +1,29 @@
+from .checkpoint import checkpointed_sweep, load_result, save_result
+from .grid import condition_grid, premixed_mole_fracs, sweep_solution_vectors
+from .sweep import (
+    ensemble_solve,
+    ensemble_solve_segmented,
+    ignition_delay,
+    ignition_observer,
+    make_mesh,
+    pad_batch,
+    sweep_report,
+    temperature_sweep,
+)
+
+__all__ = [
+    "checkpointed_sweep",
+    "condition_grid",
+    "ensemble_solve",
+    "ensemble_solve_segmented",
+    "ignition_delay",
+    "ignition_observer",
+    "load_result",
+    "make_mesh",
+    "pad_batch",
+    "premixed_mole_fracs",
+    "save_result",
+    "sweep_report",
+    "sweep_solution_vectors",
+    "temperature_sweep",
+]

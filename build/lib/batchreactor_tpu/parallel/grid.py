@@ -1,0 +1,82 @@
+"""Condition grids for ensemble sweeps.
+
+The reference handles exactly one reactor condition per call
+(/root/reference/src/BatchReactor.jl:210); sweeping a grid there means a
+serial Julia loop re-entering CVODE.  Here a sweep is data: a dict of
+per-lane parameter arrays handed to ``ensemble_solve`` (one lane per grid
+point, sharded over the device mesh).  These helpers build the standard
+grids of the BASELINE.json workloads — (T0, phi) ignition maps, catalyst
+loading (Asv) scans — as flat (B,) condition vectors plus the matching
+(B, S) initial-state block.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.composition import density, mole_to_mass
+
+
+def condition_grid(**axes):
+    """Cartesian product of named 1-D axes -> dict of flat (B,) arrays.
+
+    >>> g = condition_grid(T=jnp.linspace(1200, 2000, 64), phi=jnp.linspace(0.5, 2.0, 64))
+    >>> g["T"].shape   # (4096,) — lane-major over the product
+    """
+    names = list(axes)
+    arrays = [jnp.atleast_1d(jnp.asarray(axes[n])) for n in names]
+    mesh = jnp.meshgrid(*arrays, indexing="ij")
+    return {n: m.reshape(-1) for n, m in zip(names, mesh)}
+
+
+def premixed_mole_fracs(species, fuel, phi, oxidizer="O2", diluent=None,
+                        stoich_o2=None, o2_to_diluent=None):
+    """Per-lane premixed fuel/oxidizer mole fractions over a phi grid.
+
+    ``phi`` is the equivalence ratio: phi = (fuel/O2) / (fuel/O2)_stoich.
+    ``stoich_o2`` is the stoichiometric O2 per mole of fuel (2.0 for CH4,
+    0.5 for H2 — derived from the global oxidation reaction).  With
+    ``diluent`` (e.g. "N2") and ``o2_to_diluent`` (e.g. 3.76 for air), the
+    diluent rides with the oxidizer stream.  Returns (B, S) mole fractions.
+    """
+    if stoich_o2 is None:
+        raise ValueError("stoich_o2 (moles O2 per mole fuel at phi=1) is required")
+    if o2_to_diluent and diluent is None:
+        raise ValueError("o2_to_diluent given without a diluent species")
+    phi = jnp.atleast_1d(jnp.asarray(phi))
+    sp = {s: k for k, s in enumerate(species)}
+    for name in (fuel, oxidizer) + ((diluent,) if diluent else ()):
+        if name not in sp:
+            raise KeyError(f"species {name!r} not in mechanism species list")
+    n_fuel = phi                          # moles fuel per stoich_o2 moles O2
+    n_o2 = jnp.full_like(phi, stoich_o2)
+    n_dil = n_o2 * (o2_to_diluent or 0.0)
+    total = n_fuel + n_o2 + n_dil
+    x = jnp.zeros((phi.shape[0], len(species)), dtype=phi.dtype)
+    x = x.at[:, sp[fuel]].set(n_fuel / total)
+    x = x.at[:, sp[oxidizer]].set(n_o2 / total)
+    if diluent:
+        x = x.at[:, sp[diluent]].set(n_dil / total)
+    return x
+
+
+def sweep_solution_vectors(mole_fracs, molwt, T, p, ini_covg=None):
+    """Batched y0 builder: (B, S) mole fractions + per-lane T, p -> (B, S[+Ss]).
+
+    The vmapped analog of ``api.get_solution_vector`` (y0 = rho * Y_k, the
+    reference's get_solution_vector, /root/reference/src/BatchReactor.jl:224-232).
+    ``T``/``p`` broadcast from scalars; ``ini_covg`` (Ss,) appends identical
+    initial coverages to every lane (the reference's surface path).
+    """
+    mole_fracs = jnp.atleast_2d(jnp.asarray(mole_fracs))
+    B = mole_fracs.shape[0]
+    T = jnp.broadcast_to(jnp.asarray(T, dtype=mole_fracs.dtype), (B,))
+    p = jnp.broadcast_to(jnp.asarray(p, dtype=mole_fracs.dtype), (B,))
+
+    def one(x, T1, p1):
+        rho = density(x, molwt, T1, p1)
+        y = rho * mole_to_mass(x, molwt)
+        if ini_covg is not None:
+            y = jnp.concatenate([y, jnp.asarray(ini_covg, dtype=y.dtype)])
+        return y
+
+    return jax.vmap(one)(mole_fracs, T, p)

@@ -1,0 +1,479 @@
+"""Mesh-sharded ensemble sweeps — the framework's parallelism layer.
+
+The reference runs exactly one reactor condition per call, single-threaded
+(no Threads/Distributed/MPI anywhere in /root/reference — SURVEY.md §2c).
+The TPU-native scaling axis is the *ensemble batch*: one reactor condition
+per lane, RHS + Newton + LU vectorized with ``vmap`` into ``(B, S)``
+batched tensor ops that tile onto the MXU, and the batch axis sharded over
+the ICI device mesh with ``NamedSharding(P('batch'))``.  Lanes are
+independent, so the program is collective-free by construction; XLA moves
+nothing between chips until the host gathers results at the end.
+
+Each lane keeps its *own* adaptive step size (sdirk.solve's while_loop is
+vmapped, so XLA runs lanes until the slowest finishes — fast-igniting lanes
+mask out).  Per-lane ``status`` arrays are the failure-detection surface
+(SURVEY.md §5): a diverged lane reports DT_UNDERFLOW/MAX_STEPS without
+poisoning its neighbours.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..solver import bdf, sdirk
+
+_SOLVERS = {"sdirk": sdirk.solve, "bdf": bdf.solve}
+
+
+def make_mesh(devices=None, axis="batch"):
+    """1-D device mesh over all (or the given) devices, for sweep sharding."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def pad_batch(batch_size, mesh):
+    """Smallest multiple of the mesh size >= batch_size (lanes pad with
+    copies so the shard is even; padded lanes are sliced off by the caller)."""
+    n = mesh.devices.size
+    return ((batch_size + n - 1) // n) * n
+
+
+def pad_to_mesh(y0s, cfgs, mesh):
+    """Pad the batch axis to the mesh device count with copies of the last
+    lane.  Returns (y0s, cfgs, original_B); slice results back with
+    :func:`unpad_result`."""
+    B = y0s.shape[0]
+    pad = pad_batch(B, mesh) - B
+    if pad:
+        y0s = jnp.concatenate([y0s, jnp.repeat(y0s[-1:], pad, axis=0)])
+        cfgs = jax.tree.map(
+            lambda v: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)]),
+            cfgs)
+    return y0s, cfgs, B
+
+
+def unpad_result(res, B):
+    """Slice a batched SolveResult back to the original B lanes (inverse of
+    :func:`pad_to_mesh`; no-op when nothing was padded)."""
+    if int(res.y.shape[0]) == B:
+        return res
+    return jax.tree.map(
+        lambda x: x[:B] if hasattr(x, "ndim") and x.ndim >= 1 else x, res)
+
+
+def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
+                   rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
+                   dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
+                   observer=None, observer_init=None, jac_window=1,
+                   newton_tol=0.03, method="bdf"):
+    """Solve a batch of reactor conditions in one XLA program.
+
+    ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
+    leaves (per-lane T, Asv, ...); scalars t0/t1 are shared.  With ``mesh``,
+    the batch axis is sharded ``P('batch')`` across devices (B must divide
+    evenly — see :func:`pad_batch`).  Returns a batched SolveResult.
+
+    Compilation caching keys on the *identity* of the ``rhs``/``jac``/
+    ``observer`` callables (jit semantics): reuse the same callable objects
+    across calls — build them once, sweep many times.  A freshly constructed
+    closure per call (e.g. ``ignition_observer(...)`` inside a loop) forces
+    a full recompile every call, minutes at GRI scale on TPU.
+    """
+    _check_method(method, newton_tol)
+    jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
+                            dt_min_factor, linsolve, jac, observer,
+                            jac_window, newton_tol, method)
+    t0 = jnp.asarray(t0, dtype=y0s.dtype)
+    t1 = jnp.asarray(t1, dtype=y0s.dtype)
+    obs0 = observer_init if observer is not None else 0.0
+
+    if mesh is None:
+        return jitted(y0s, t0, t1, cfgs, obs0)
+
+    spec = NamedSharding(mesh, P(axis))
+    y0s = jax.device_put(y0s, spec)
+    cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
+    # outputs inherit the batch sharding; XLA inserts no collectives because
+    # lanes never exchange data
+    return jitted(y0s, t0, t1, cfgs, obs0)
+
+
+def _check_method(method, newton_tol):
+    if method not in _SOLVERS:
+        raise ValueError(f"unknown method {method!r}; use "
+                         f"{sorted(_SOLVERS)}")
+    if method != "sdirk" and newton_tol != 0.03:
+        # fail loudly instead of silently dropping the sdirk-only knob
+        # (bdf derives its Newton tolerance from rtol, CVODE-style)
+        raise ValueError(
+            f"newton_tol is an sdirk-only knob; method={method!r} "
+            f"got newton_tol={newton_tol}")
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
+                   linsolve, jac=None, observer=None, jac_window=1,
+                   newton_tol=0.03, method="bdf"):
+    """One compiled batched solve per (rhs, solver-settings) combination.
+
+    Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
+    the whole while_loop program each time (~2 min at GRI scale on TPU);
+    memoizing on the rhs callable + static solver knobs makes repeat sweeps
+    — the ensemble use case — pay tracing once.  t0/t1 stay traced operands
+    so sweeping the horizon does not recompile.
+    """
+
+    def one(y0, t0, t1, cfg, obs0):
+        kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
+              if method == "sdirk" else {"jac_window": jac_window})
+        return _SOLVERS[method](
+            rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
+            n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
+            linsolve=linsolve, jac=jac, observer=observer,
+            observer_init=obs0 if observer is not None else None, **kw)
+
+    return jax.jit(jax.vmap(one, in_axes=(0, None, None, 0, None)))
+
+
+def temperature_sweep(rhs, y0, T_grid, t1, base_cfg=None, **kw):
+    """Convenience: one initial state swept over a temperature grid (the
+    ignition-delay workload in BASELINE.json's batch_ch4 config)."""
+    T_grid = jnp.asarray(T_grid)
+    B = T_grid.shape[0]
+    y0s = jnp.broadcast_to(y0, (B,) + y0.shape)
+    cfg = dict(base_cfg or {})
+    cfg = {k: jnp.broadcast_to(jnp.asarray(v), (B,)) for k, v in cfg.items()}
+    cfg["T"] = T_grid
+    return ensemble_solve(rhs, y0s, 0.0, t1, cfg, **kw)
+
+
+def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
+                             max_segments=10_000, max_attempts=None,
+                             mesh=None, axis="batch",
+                             progress=None, rtol=1e-6, atol=1e-10,
+                             linsolve="auto", jac=None, observer=None,
+                             observer_init=None, dt_min_factor=1e-22,
+                             n_save=0, rhs_bundle=None, jac_window=1,
+                             newton_tol=0.03, method="bdf"):
+    """ensemble_solve with the device program bounded to ``segment_steps``
+    step attempts per launch; the host loops segments until every lane
+    terminates.
+
+    Why: one monolithic while_loop over a full ignition sweep can run for
+    many minutes on a single XLA launch — long enough to trip RPC/watchdog
+    limits on tunneled TPU runtimes, and invisible to the host until it
+    finishes.  Segmenting bounds the blast radius of a launch, lets
+    ``progress`` observe per-segment completion (lanes done / steps taken),
+    and costs one dispatch per segment.  State carried between segments:
+    per-lane (t, y, next step size h, observer fold); a lane that fails
+    terminally (DT_UNDERFLOW) is parked so it does not burn segment budget
+    re-failing.
+
+    ``n_save`` > 0 records up to that many accepted rows per lane, exactly
+    like the unsegmented path (first-n_save semantics), but the *device*
+    buffer is only ``min(n_save, segment_steps)`` rows — segments drain to a
+    host-side (B, n_save) array between launches.  This is how file-driven
+    XML runs get their profile trajectories on accelerators without the
+    monolithic launch (reference streaming callback analog,
+    /root/reference/src/BatchReactor.jl:208,383-402).
+
+    With ``rhs_bundle``, ``rhs`` is instead a *builder*:
+    ``rhs(bundle) -> (rhs_fn, jac_fn)``, and the bundle pytree (mechanism
+    tensors) enters the compiled program as a traced operand.  The compile
+    cache then keys on the builder's identity, so repeated calls with
+    fresh same-shaped bundles (e.g. re-parsed mechanisms in file-driven
+    runs) reuse one executable instead of recompiling.  ``jac`` is ignored
+    in this form.
+
+    ``max_attempts`` bounds the total step attempts per lane across
+    segments, tracked host-side: a lane still running once its accepted +
+    rejected attempts reach the budget is parked with MAX_STEPS_REACHED —
+    the same exact budget semantics as the monolithic path's ``max_steps``.
+    (One asymmetry remains: a lane that *finishes* inside its final segment
+    keeps its success even if the finish came within the up-to-
+    ``segment_steps - 1`` attempts past the budget; the monolithic path
+    would have reported MaxIters.  The failing direction — the resource
+    bound — is exact.)
+    """
+    if max_segments < 1:
+        raise ValueError(f"max_segments must be >= 1, got {max_segments}")
+    y0s = jnp.asarray(y0s)
+    B = y0s.shape[0]
+    # a segment can accept at most segment_steps rows, so this buffer never
+    # drops a row the host still has capacity for
+    seg_save = min(int(n_save), int(segment_steps)) if n_save else 0
+    _check_method(method, newton_tol)
+    jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
+                                      dt_min_factor, linsolve,
+                                      None if rhs_bundle is not None else jac,
+                                      observer, seg_save,
+                                      rhs_bundle is not None, jac_window,
+                                      newton_tol, method)
+    bundle_arg = rhs_bundle if rhs_bundle is not None else 0.0
+    t1 = jnp.asarray(t1, dtype=y0s.dtype)
+    t = jnp.full((B,), t0, dtype=y0s.dtype)
+    h = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: heuristic first step
+    e = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: fresh PI controller
+    y = y0s
+    if observer is not None:
+        obs = jax.tree.map(
+            lambda x: jnp.broadcast_to(jnp.asarray(x),
+                                       (B,) + jnp.shape(jnp.asarray(x))),
+            observer_init)
+    else:
+        obs = jnp.zeros((B,))
+    if method == "bdf":
+        # all-zero difference history = per-lane cold start (bdf.solve)
+        sstate = (jnp.zeros((B, bdf.MAXORD + 3) + y0s.shape[1:],
+                            dtype=y0s.dtype),
+                  jnp.ones((B,), dtype=jnp.int32),
+                  jnp.full((B,), -1.0, dtype=y0s.dtype),
+                  jnp.zeros((B,), dtype=jnp.int32))
+    else:
+        sstate = jnp.zeros((B,), dtype=y0s.dtype)  # unused dummy
+    if mesh is not None:
+        spec = NamedSharding(mesh, P(axis))
+        y = jax.device_put(y, spec)
+        t = jax.device_put(t, spec)
+        h = jax.device_put(h, spec)
+        e = jax.device_put(e, spec)
+        cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
+        obs = jax.tree.map(lambda x: jax.device_put(x, spec), obs)
+        sstate = jax.tree.map(lambda x: jax.device_put(x, spec), sstate)
+
+    final_status = np.full((B,), int(sdirk.RUNNING), dtype=np.int32)
+    final_t = np.full((B,), np.nan)
+    n_acc = np.zeros((B,), dtype=np.int64)
+    n_rej = np.zeros((B,), dtype=np.int64)
+    if n_save:
+        all_ts = np.full((B, int(n_save)), np.inf)
+        all_ys = np.zeros((B, int(n_save)) + y0s.shape[1:])
+        saved = np.zeros((B,), dtype=np.int64)
+    for seg in range(max_segments):
+        res = jitted(bundle_arg, y, t, t1, cfgs, h, e, obs, sstate)
+        status = np.asarray(res.status)
+        # only lanes still live this segment contribute step counts: parked
+        # lanes re-enter as zero-span solves that burn one rejected attempt
+        running = final_status == int(sdirk.RUNNING)
+        n_acc += np.where(running, np.asarray(res.n_accepted), 0)
+        n_rej += np.where(running, np.asarray(res.n_rejected), 0)
+        if n_save:
+            # drain this segment's device buffer into the host trajectory —
+            # vectorized masked scatter, no per-lane Python loop, and the
+            # (B, seg_save, S) transfer is skipped entirely for segments
+            # that saved nothing (only the small n_saved vector moves)
+            seg_n = np.asarray(res.n_saved)
+            take = np.where(running, np.minimum(seg_n, int(n_save) - saved),
+                            0)
+            drained_ts = None
+            if take.max() > 0:
+                seg_ts = np.asarray(res.ts)
+                seg_ys = np.asarray(res.ys)
+                col = np.arange(seg_ts.shape[1])
+                src = col[None, :] < take[:, None]           # (B, seg_save)
+                b_idx, c_idx = np.nonzero(src)
+                dst = saved[b_idx] + c_idx
+                all_ts[b_idx, dst] = seg_ts[b_idx, c_idx]
+                all_ys[b_idx, dst] = seg_ys[b_idx, c_idx]
+                saved += take
+                drained_ts = seg_ts[b_idx, c_idx]  # lane-major, in-lane order
+        terminal = status != int(sdirk.MAX_STEPS_REACHED)
+        newly_terminal = running & terminal
+        final_status = np.where(newly_terminal, status, final_status)
+        # the reported t for a terminal lane is the t at the segment where it
+        # first terminated (for DT_UNDERFLOW that is the failure time, same
+        # as the unsegmented path reports) — not the t1 it gets parked at
+        final_t = np.where(newly_terminal, np.asarray(res.t), final_t)
+        if max_attempts is not None:
+            # exact per-lane attempt budget (monolithic max_steps parity):
+            # park still-running lanes whose budget is spent as MaxSteps
+            exhausted = (final_status == int(sdirk.RUNNING)) & (
+                n_acc + n_rej >= int(max_attempts))
+            final_status = np.where(exhausted,
+                                    int(sdirk.MAX_STEPS_REACHED),
+                                    final_status)
+            final_t = np.where(exhausted, np.asarray(res.t), final_t)
+        parked = jnp.asarray(final_status != int(sdirk.RUNNING))
+        t = jnp.where(parked, t1, res.t)
+        y = res.y
+        # lanes parked *before* this segment ran a zero-span solve whose
+        # res.h is NaN — keep their last live h (and PI memory); lanes that
+        # terminated this segment take res.h (their final adapted step size)
+        h = jnp.where(jnp.asarray(~running), h, res.h)
+        e = jnp.where(jnp.asarray(~running), e, res.err_prev)
+        if method == "bdf":
+            # the multistep history resumes across segments (the zero-span
+            # `already` guard holds parked lanes' carry unchanged)
+            sstate = res.solver_state
+        if observer is not None:
+            obs = res.observed
+        done = not bool(np.any(final_status == int(sdirk.RUNNING)))
+        if progress is not None:
+            payload = {"segment": seg, "lanes_done": int(
+                (final_status != int(sdirk.RUNNING)).sum()), "n_lanes": B,
+                "accepted_total": int(n_acc.sum())}
+            if n_save and drained_ts is not None:
+                # accepted times drained this segment (lane-major) — the
+                # live per-step terminal progress the file-driven API
+                # prints (reference /root/reference/src/BatchReactor.jl:401)
+                payload["drained_ts"] = drained_ts
+            progress(payload)
+        if done:
+            break
+    else:
+        final_status[final_status == int(sdirk.RUNNING)] = int(
+            sdirk.MAX_STEPS_REACHED)
+    # lanes that never terminated (budget exhausted) report their current t
+    final_t = np.where(np.isnan(final_t), np.asarray(res.t), final_t)
+
+    if n_save:
+        ts_out = jnp.asarray(all_ts, dtype=y0s.dtype)
+        ys_out = jnp.asarray(all_ys, dtype=y0s.dtype)
+        n_saved_out = jnp.asarray(saved)
+    else:
+        ts_out, ys_out, n_saved_out = res.ts, res.ys, res.n_saved
+    return sdirk.SolveResult(
+        t=jnp.asarray(final_t, dtype=y0s.dtype), y=y,
+        status=jnp.asarray(final_status),
+        n_accepted=jnp.asarray(n_acc), n_rejected=jnp.asarray(n_rej),
+        ts=ts_out, ys=ys_out, n_saved=n_saved_out, h=h,
+        observed=obs if observer is not None else None)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
+                             linsolve, jac, observer, n_save=0,
+                             bundle_mode=False, jac_window=1,
+                             newton_tol=0.03, method="bdf"):
+    """Compiled per-segment batched solve: per-lane t0 and carried-in step
+    size are traced operands (vmap axis 0), so every segment reuses one
+    executable.  In ``bundle_mode`` the first operand is a mechanism-bundle
+    pytree (broadcast, not vmapped) and ``rhs`` is a builder."""
+
+    def one(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate):
+        if bundle_mode:
+            rhs_fn, jac_fn = rhs(bundle)
+        else:
+            rhs_fn, jac_fn = rhs, jac
+        kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
+              if method == "sdirk"
+              else {"solver_state": sstate, "jac_window": jac_window})
+        return _SOLVERS[method](
+            rhs_fn, y0, t0, t1, cfg, rtol=rtol, atol=atol,
+            max_steps=segment_steps, n_save=n_save, dt0=h0, err0=e0,
+            dt_min_factor=dt_min_factor, linsolve=linsolve, jac=jac_fn,
+            observer=observer,
+            observer_init=obs0 if observer is not None else None, **kw)
+
+    return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0, 0)))
+
+
+def sweep_report(res, cfgs=None):
+    """Failure-detection summary for an ensemble SolveResult (SURVEY.md §5:
+    the reference's only failure signal is one retcode,
+    /root/reference/src/BatchReactor.jl:216; a sweep needs per-lane triage).
+
+    Returns a dict: per-status lane counts, indices of failed lanes, and —
+    when ``cfgs`` is given — the offending parameter values per failed lane,
+    so a diverged corner of the condition grid is identifiable at a glance.
+    """
+    status = np.asarray(res.status)
+    names = {int(sdirk.SUCCESS): "success",
+             int(sdirk.MAX_STEPS_REACHED): "max_steps",
+             int(sdirk.DT_UNDERFLOW): "dt_underflow",
+             int(sdirk.RUNNING): "running"}
+    counts = {names.get(int(s), str(int(s))): int((status == s).sum())
+              for s in np.unique(status)}
+    failed = np.nonzero(status != int(sdirk.SUCCESS))[0]
+    report = {
+        "n_lanes": int(status.shape[0]),
+        "counts": counts,
+        "failed_lanes": failed.tolist(),
+        "n_accepted": {"min": int(np.min(np.asarray(res.n_accepted))),
+                       "max": int(np.max(np.asarray(res.n_accepted))),
+                       "mean": float(np.mean(np.asarray(res.n_accepted)))},
+    }
+    if cfgs is not None and failed.size:
+        report["failed_conditions"] = {
+            k: np.asarray(v)[failed].tolist() for k, v in cfgs.items()
+        }
+    return report
+
+
+def ignition_observer(marker, mode="half", frac=0.5):
+    """(observer, init) pair extracting ignition delay *during* the solve.
+
+    The O(1)-memory alternative to :func:`ignition_delay` over an ``n_save``
+    trajectory buffer: at 4096 lanes a (B, n_save, S) buffer scatter
+    dominates the sweep (it rewrites the whole buffer every accepted step
+    under vmap), while this fold costs O(B) per step.  ``mode="half"``
+    records the first accepted time the marker species drops below
+    ``frac`` x its first-seen value (fuel-consumption marker; the first
+    accepted step sits ~1e-16 s after t0, so first-seen == initial to
+    rounding).  ``mode="peak"`` records the time of the running maximum
+    (OH-peak marker).  Read the result from ``SolveResult.observed["tau"]``
+    (NaN where never crossed — e.g. lanes that did not ignite).
+    """
+    if mode == "half":
+        init = {"m0": jnp.nan, "tau": jnp.nan, "t_prev": jnp.nan,
+                "m_prev": jnp.nan}
+
+        def observer(t, y, acc):
+            m = y[marker]
+            m0 = jnp.where(jnp.isnan(acc["m0"]), m, acc["m0"])
+            thr = frac * m0
+            crossed = jnp.isnan(acc["tau"]) & (m < thr)
+            # linear interpolation between the bracketing accepted steps:
+            # the accepted-step spacing near a fast ignition front is wide
+            # enough that first-step-past-threshold alone costs ~1% tau
+            denom = acc["m_prev"] - m
+            w = jnp.where(denom != 0, (acc["m_prev"] - thr) / denom, 1.0)
+            w = jnp.clip(w, 0.0, 1.0)
+            t_x = jnp.where(jnp.isnan(acc["t_prev"]), t,
+                            acc["t_prev"] + w * (t - acc["t_prev"]))
+            return {"m0": m0, "tau": jnp.where(crossed, t_x, acc["tau"]),
+                    "t_prev": t, "m_prev": m}
+
+    elif mode == "peak":
+        init = {"m_max": -jnp.inf, "tau": jnp.nan}
+
+        def observer(t, y, acc):
+            m = y[marker]
+            higher = m > acc["m_max"]
+            return {"m_max": jnp.maximum(m, acc["m_max"]),
+                    "tau": jnp.where(higher, t, acc["tau"])}
+
+    else:
+        raise ValueError(f"unknown ignition observer mode {mode!r}")
+    return observer, init
+
+
+def ignition_delay(ts, ys, marker, mode="peak"):
+    """Per-lane ignition delay from saved trajectories.
+
+    The classic max-dT/dt marker is unavailable (isothermal reactor —
+    SURVEY.md §7.8), so use species markers: ``mode="peak"`` returns the
+    time of the marker species' maximum (e.g. OH mass density), ``"half"``
+    the first time it drops below half its initial value (fuel-consumption
+    marker).  ``ts``: (B, n_save) +inf-padded; ``ys``: (B, n_save, S);
+    ``marker``: species index.
+    """
+    c = ys[..., marker]                      # (B, n_save)
+    valid = jnp.isfinite(ts)
+    if mode == "peak":
+        c = jnp.where(valid, c, -jnp.inf)
+        idx = jnp.argmax(c, axis=-1)
+    elif mode == "half":
+        below = valid & (c < 0.5 * c[..., :1])
+        # first True; if never, fall back to the last valid index
+        idx = jnp.argmax(below, axis=-1)
+        never = ~jnp.any(below, axis=-1)
+        last = jnp.sum(valid, axis=-1) - 1
+        idx = jnp.where(never, last, idx)
+    else:
+        raise ValueError(f"unknown ignition-delay mode {mode!r}")
+    return jnp.take_along_axis(ts, idx[:, None], axis=-1)[:, 0]

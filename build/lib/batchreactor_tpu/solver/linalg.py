@@ -1,0 +1,107 @@
+"""Dense LU factorization in pure jnp ops — TPU-compatible at any dtype.
+
+XLA's built-in LuDecomposition custom-call supports only F32/C64 on TPU
+(verified on v5e: "Only F32 and C64 types are implemented in LuDecomposition;
+got shape f64[9,9]"), so ``jax.scipy.linalg.lu_factor`` cannot carry the
+float64 Newton systems this framework needs (abstol 1e-10 chemistry,
+/root/reference/src/BatchReactor.jl:210).  This module implements partially
+pivoted Gaussian elimination from elementwise arithmetic only, which compiles
+on CPU and on the TPU's emulated f64 alike, and vmaps cleanly over ensemble
+lanes (every lane shares the same O(n) sequential factor loop; the inner work
+is (B, n) / (B, n, n) vectorized).
+
+Jacobians here are small (n = n_species <= ~53 for GRI-Mech 3.0), so an
+unblocked right-looking elimination is appropriate; a Pallas-blocked batched
+kernel is the planned upgrade path for large batches.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def lu_factor(A):
+    """Partially pivoted LU: returns (LU, piv) with L unit-lower in-place.
+
+    piv[k] is the row swapped into position k at step k (LAPACK-style ipiv).
+    """
+    n = A.shape[0]
+    idx = jnp.arange(n)
+
+    def body(k, state):
+        LU, piv = state
+        col = jnp.abs(LU[:, k])
+        cand = jnp.where(idx >= k, col, -jnp.inf)
+        p = jnp.argmax(cand)
+        piv = piv.at[k].set(p.astype(jnp.int32))
+        # swap rows k <-> p
+        row_k, row_p = LU[k], LU[p]
+        LU = LU.at[k].set(row_p).at[p].set(row_k)
+        pivot = LU[k, k]
+        # guard exactly-singular pivots; downstream Newton failure handling
+        # (divergence -> step rejection) owns the recovery
+        safe = jnp.where(jnp.abs(pivot) > 0, pivot, 1.0)
+        factor = jnp.where(idx > k, LU[:, k] / safe, 0.0)
+        # update only the trailing submatrix (cols >= k); cols < k hold L
+        row_k_masked = jnp.where(idx >= k, LU[k], 0.0)
+        LU = LU - factor[:, None] * row_k_masked[None, :]
+        LU = LU.at[:, k].set(jnp.where(idx > k, factor, LU[:, k]))
+        return LU, piv
+
+    return lax.fori_loop(0, n, body, (A, jnp.zeros(n, dtype=jnp.int32)))
+
+
+def lu_solve(lu_piv, b):
+    """Solve A x = b given lu_factor(A) output."""
+    LU, piv = lu_piv
+    n = LU.shape[0]
+    idx = jnp.arange(n)
+
+    def permute(k, x):
+        p = piv[k]
+        xk, xp = x[k], x[p]
+        return x.at[k].set(xp).at[p].set(xk)
+
+    x = lax.fori_loop(0, n, permute, b)
+
+    def forward(k, x):
+        # x[k] -= sum_{j<k} L[k,j] x[j]   (unit diagonal)
+        s = jnp.sum(jnp.where(idx < k, LU[k] * x, 0.0))
+        return x.at[k].set(x[k] - s)
+
+    x = lax.fori_loop(0, n, forward, x)
+
+    def backward(i, x):
+        k = n - 1 - i
+        s = jnp.sum(jnp.where(idx > k, LU[k] * x, 0.0))
+        return x.at[k].set((x[k] - s) / LU[k, k])
+
+    return lax.fori_loop(0, n, backward, x)
+
+
+def make_solve_m(M, linsolve, dtype):
+    """Newton linear-solver factory shared by solver/sdirk.py and
+    solver/bdf.py: "lu" (exact f64 pivoted elimination, CPU), "inv32"
+    (native f32 batched inverse + one f64 iterative-refinement pass — the
+    fast TPU path; refinement restores ~f64 accuracy while cond(M) stays
+    below ~1e7), "inv32nr" (no refinement: the inverse only preconditions
+    the quasi-Newton iteration, whose fixed point is solve-accuracy
+    independent), "inv32f" (inv32nr with the matvec itself in f32 — the
+    residual and correction are state-scale so f32 range suffices;
+    components under f32-tiny flush to zero 28 orders below atol)."""
+    import jax.numpy as jnp
+
+    if linsolve == "lu":
+        lu = lu_factor(M)
+        return lambda b: lu_solve(lu, b)
+    Minv32 = jnp.linalg.inv(M.astype(jnp.float32))
+    if linsolve == "inv32f":
+        return lambda b: (Minv32 @ b.astype(jnp.float32)).astype(dtype)
+    Minv = Minv32.astype(dtype)
+    if linsolve == "inv32nr":
+        return lambda b: Minv @ b
+
+    def solve_m(b):
+        x = Minv @ b
+        return x + Minv @ (b - M @ x)
+
+    return solve_m

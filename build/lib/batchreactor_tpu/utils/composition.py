@@ -1,0 +1,43 @@
+"""Composition/unit conversions, jit-safe.
+
+TPU-native re-design of the ``RxnHelperUtils`` helpers the reference calls from
+its hot loop (``massfrac_to_molefrac!``/``average_molwt``/``density`` at
+/root/reference/src/BatchReactor.jl:334-338,349-353 and the solution-vector
+builder at :224-232).  The reference mutates preallocated buffers; here every
+conversion is a pure ``jnp`` function of its inputs so it can live inside a
+jitted, vmapped RHS.
+
+Conventions: ``molwt`` is kg/mol; compositions are 1-D arrays over species.
+"""
+
+import jax.numpy as jnp
+
+from .constants import R
+
+
+def mole_to_mass(mole_frac, molwt):
+    """Y_k = x_k W_k / sum(x W)."""
+    m = mole_frac * molwt
+    return m / jnp.sum(m)
+
+
+def mass_to_mole(mass_frac, molwt):
+    """x_k = (Y_k / W_k) / sum(Y/W)."""
+    n = mass_frac / molwt
+    return n / jnp.sum(n)
+
+
+def average_molwt(mole_frac, molwt):
+    """Mean molecular weight [kg/mol] from mole fractions."""
+    return jnp.sum(mole_frac * molwt)
+
+
+def density(mole_frac, molwt, T, p):
+    """Ideal-gas mixture mass density rho = p * Wbar / (R T) [kg/m^3]."""
+    return p * average_molwt(mole_frac, molwt) / (R * T)
+
+
+def pressure(rho, mole_frac, molwt, T):
+    """Algebraic pressure update p = rho R T / Wbar (constant-volume reactor;
+    cf. /root/reference/src/BatchReactor.jl:338,353)."""
+    return rho * R * T / average_molwt(mole_frac, molwt)

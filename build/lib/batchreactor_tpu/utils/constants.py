@@ -1,0 +1,59 @@
+"""Physical constants and atomic masses.
+
+The reference stack takes its gas constant from ``RxnHelperUtils.R`` (used at
+/root/reference/src/BatchReactor.jl:338,353) and its atomic masses from the
+``IdealGas`` thermo builder (create_thermo at /root/reference/src/BatchReactor.jl:265).
+Neither package is vendored, so the values below were *calibrated* against the
+committed golden output /root/reference/test/batch_gas_and_surf/gas_profile.csv:
+the initial density 0.27697974868307573 kg/m^3 at T=1173 K, p=1e5 Pa,
+x=(CH4 0.25, O2 0.5, N2 0.25) pins p*M/(R*T) to ~6e-7 relative accuracy with
+R = 8.314472 J/mol/K (CODATA 2002) and the classic CHEMKIN atomic-mass table.
+"""
+
+# Universal gas constant [J / (mol K)].
+R = 8.314472
+
+# cal -> J (thermochemical calorie); CHEMKIN-II activation energies are cal/mol.
+CAL_TO_J = 4.184
+
+# Standard-state pressure for NASA-7 thermodynamics [Pa] (1 atm).
+P_ATM = 101325.0
+
+# Avogadro number [1/mol], Boltzmann [J/K] — for completeness
+# (cf. the reference's dead-code /root/reference/src/Constants.jl:1-16).
+NA = 6.02214076e23
+KB = 1.380649e-23
+
+# Atomic masses [g/mol], classic CHEMKIN table (see module docstring).
+ATOMIC_MASS = {
+    "H": 1.00797,
+    "D": 2.014102,
+    "HE": 4.0026,
+    "C": 12.01115,
+    "N": 14.0067,
+    "O": 15.9994,
+    "F": 18.998403,
+    "NE": 20.179,
+    "NA": 22.98977,
+    "MG": 24.305,
+    "AL": 26.98154,
+    "SI": 28.0855,
+    "P": 30.97376,
+    "S": 32.064,
+    "CL": 35.453,
+    "AR": 39.948,
+    "K": 39.0983,
+    "CA": 40.08,
+    "FE": 55.847,
+    "NI": 58.71,
+    "CU": 63.546,
+    "ZN": 65.38,
+    "BR": 79.904,
+    "KR": 83.8,
+    "RH": 102.9055,
+    "PD": 106.4,
+    "AG": 107.868,
+    "PT": 195.09,
+    "AU": 196.9665,
+    "E": 5.48579903e-4,
+}

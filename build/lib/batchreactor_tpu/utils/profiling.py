@@ -1,0 +1,70 @@
+"""Per-phase timers and device tracing (SURVEY.md §5: the reference's only
+observability is a per-step ``@printf`` of the time,
+/root/reference/src/BatchReactor.jl:401; the TPU-native plan is phase timers
+— parse / compile / transfer / solve — plus ``jax.profiler`` traces).
+
+``Phases`` collects named wall-clock spans; ``phase(...)`` is the context
+manager; ``device_trace(...)`` wraps ``jax.profiler.trace`` so a sweep can
+drop a TensorBoard-loadable trace directory without importing jax at every
+call site.  Timings are host wall-clock: callers that time device work
+should block (``jax.block_until_ready``) inside the span — ``phase`` does
+it for you when given a value to block on.
+"""
+
+import contextlib
+import time
+
+
+class Phases:
+    """Accumulates named wall-clock spans; repeated names accumulate.
+
+    >>> ph = Phases()
+    >>> with ph("parse"): mech = compile_gaschemistry(path)
+    >>> with ph("solve", block=result): ...
+    >>> ph.summary()   # {'parse': 0.12, 'solve': 3.4}
+    """
+
+    def __init__(self):
+        self.spans = {}
+        self.counts = {}
+
+    @contextlib.contextmanager
+    def __call__(self, name, block=None):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            if block is not None:
+                import jax
+
+                jax.block_until_ready(block)
+            dt = time.perf_counter() - t0
+            self.spans[name] = self.spans.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self):
+        return dict(self.spans)
+
+    def pretty(self):
+        total = sum(self.spans.values()) or 1.0
+        lines = [
+            f"{name:>12s}: {dt:8.3f}s  ({100.0 * dt / total:5.1f}%)"
+            for name, dt in sorted(self.spans.items(), key=lambda kv: -kv[1])
+        ]
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir):
+    """``jax.profiler`` trace spanning the with-block (TensorBoard format).
+
+    Wraps device execution so kernel-level timing (f64-emulation cost,
+    while_loop iteration breakdown, transfer gaps) is inspectable offline.
+    """
+    import jax
+
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

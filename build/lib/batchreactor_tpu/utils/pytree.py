@@ -1,0 +1,32 @@
+"""Tiny helper to declare frozen dataclasses as JAX pytrees.
+
+The reference keeps mechanism/thermo data in mutable Julia structs
+(``SpeciesThermoObj``, ``MechanismDefinition`` — /root/reference/src/BatchReactor.jl:36-38).
+TPU-first, these become immutable pytrees of device arrays: array leaves are
+traced/sharded by jit, while static metadata (species name tuples, flags)
+rides along as aux data so it can steer tracing without becoming a tracer.
+"""
+
+import dataclasses
+
+import jax
+
+
+def pytree_dataclass(*, meta_fields=()):
+    """Decorator: frozen dataclass registered as a pytree.
+
+    ``meta_fields`` are hashable static metadata (names, python scalars that
+    must stay static); every other field is a pytree data leaf.
+    """
+
+    def wrap(cls):
+        cls = dataclasses.dataclass(frozen=True)(cls)
+        data = tuple(
+            f.name for f in dataclasses.fields(cls) if f.name not in meta_fields
+        )
+        jax.tree_util.register_dataclass(
+            cls, data_fields=data, meta_fields=tuple(meta_fields)
+        )
+        return cls
+
+    return wrap

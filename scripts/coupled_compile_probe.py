@@ -1,0 +1,190 @@
+"""Coupled gas+surf TPU compile-wall localization ladder (round-4 task).
+
+Round 3 found the coupled (GRI-3.0 + CH4/Ni) BDF program never finishes
+compiling on the TPU backend (two attempts, 30 and 58 min) while the
+same program compiles in ~10 s on CPU and the gas-only program compiles in
+~150 s on the chip (PERF.md).  The one localization probe that existed ran
+right after a killed TPU client, so a wedged tunnel could not be excluded.
+
+This script is the clean re-localization: a LADDER of jits of increasing
+completeness, each in its OWN subprocess with a SIGTERM-first timeout (a
+SIGKILLed TPU client wedges the tunnel — round-2/3 postmortems), recording
+per-stage compile+run seconds to COMPILE_PROBE.json.  Stages:
+
+  s0_probe        tiny matmul — chip alive?
+  s1_surf_rates   surface production_rates_and_jac, single lane
+  s2_surf_jac     full coupled analytic Jacobian fn (make_surface_jac), B=64
+  s3_rhs          coupled RHS vmapped, B=64
+  s4_bdf_fwd      coupled BDF solve, jacfwd Jacobian, jw=1, tiny horizon
+  s5_bdf_ana      coupled BDF solve, analytic Jacobian, jw=1
+  s6_bdf_ana_jw8  coupled BDF solve, analytic Jacobian, jac_window=8
+  s7_bdf_remat    like s5 but the Jacobian wrapped in jax.checkpoint
+
+Any stage timing out marks where the compile pathology begins; later
+stages still run (each is independent).  Usage:
+
+  python scripts/coupled_compile_probe.py               # all stages, 600 s each
+  CCP_STAGES=s2,s5 CCP_TIMEOUT=1200 python scripts/coupled_compile_probe.py
+  CCP_B=16 ...                                          # smaller batch
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+if not os.path.isdir(LIB):
+    LIB = os.path.join(REPO, "tests", "fixtures")
+
+STAGES = ["s0_probe", "s1_surf_rates", "s2_surf_jac", "s3_rhs",
+          "s4_bdf_fwd", "s5_bdf_ana", "s6_bdf_ana_jw8", "s7_bdf_remat"]
+
+
+def _stage_main(stage):
+    """Child body: build + jit + run ONE stage, print a json line."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(REPO, ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    os.environ.setdefault("BR_EXP32", "1")
+    import jax
+
+    if os.environ.get("CCP_CPU") == "1":
+        # control runs: the axon plugin ignores JAX_PLATFORMS, so the CPU
+        # pin must go through jax.config before first backend use
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.models.surface import compile_mech
+    from batchreactor_tpu.ops import surface_kinetics
+    from batchreactor_tpu.ops.rhs import make_surface_jac, make_surface_rhs
+    from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+    from batchreactor_tpu.parallel.sweep import ensemble_solve
+
+    B = int(os.environ.get("CCP_B", "64"))
+    t_init = time.perf_counter()
+    if stage == "s0_probe":
+        x = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+        jax.block_until_ready(x)
+        print(json.dumps({"stage": stage, "ok": True,
+                          "backend": jax.default_backend(),
+                          "wall_s": round(time.perf_counter() - t_init, 1)}))
+        return
+
+    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sm = compile_mech(f"{LIB}/ch4ni.xml", th, list(gm.species))
+    sp = list(gm.species)
+    ng, ns = len(sp), len(sm.species)
+
+    X = np.zeros(ng)
+    X[sp.index("CH4")], X[sp.index("O2")], X[sp.index("N2")] = .25, .5, .25
+    T_grid = jnp.linspace(1073.0, 1273.0, B)
+    y0s = sweep_solution_vectors(jnp.broadcast_to(jnp.asarray(X), (B, ng)),
+                                 th.molwt, T_grid, 1e5,
+                                 ini_covg=sm.ini_covg)
+    cfg = {"T": T_grid, "Asv": jnp.full((B,), 1.0)}
+    build_s = time.perf_counter() - t_init
+
+    rhs = make_surface_rhs(sm, th, gm=gm)
+    jacf = make_surface_jac(sm, th, gm=gm)
+
+    t0 = time.perf_counter()
+    if stage == "s1_surf_rates":
+        f = jax.jit(lambda T, p, x, th_: surface_kinetics.
+                    production_rates_and_jac(T, p, x, th_, sm))
+        out = f(1173.0, 1e5, jnp.asarray(X), sm.ini_covg)
+        jax.block_until_ready(out)
+    elif stage == "s2_surf_jac":
+        f = jax.jit(jax.vmap(jacf, in_axes=(None, 0, {"T": 0, "Asv": 0})))
+        out = f(0.0, y0s, cfg)
+        jax.block_until_ready(out)
+    elif stage == "s3_rhs":
+        f = jax.jit(jax.vmap(rhs, in_axes=(None, 0, {"T": 0, "Asv": 0})))
+        out = f(0.0, y0s, cfg)
+        jax.block_until_ready(out)
+    elif stage in ("s4_bdf_fwd", "s5_bdf_ana", "s6_bdf_ana_jw8",
+                   "s7_bdf_remat"):
+        import functools
+
+        kw = dict(rtol=1e-6, atol=1e-10, method="bdf", max_steps=64)
+        if stage == "s4_bdf_fwd":
+            kw["jac"] = None
+        elif stage == "s7_bdf_remat":
+            kw["jac"] = jax.checkpoint(jacf)
+        else:
+            kw["jac"] = jacf
+        kw["jac_window"] = 8 if stage == "s6_bdf_ana_jw8" else 1
+        # tiny horizon + tiny step budget: the COMPILE is the measurement;
+        # the program structure (while_loop body) is the full solver's
+        res = ensemble_solve(rhs, y0s, 0.0, 1e-8, cfg, **kw)
+        jax.block_until_ready(res.y)
+    else:
+        raise SystemExit(f"unknown stage {stage}")
+    print(json.dumps({"stage": stage, "ok": True,
+                      "backend": jax.default_backend(),
+                      "build_s": round(build_s, 1),
+                      "compile_and_run_s": round(time.perf_counter() - t0,
+                                                 1)}))
+
+
+def main():
+    if os.environ.get("CCP_STAGE"):  # child mode
+        _stage_main(os.environ["CCP_STAGE"])
+        return
+
+    timeout = int(os.environ.get("CCP_TIMEOUT", "600"))
+    stages = (os.environ.get("CCP_STAGES", "").split(",")
+              if os.environ.get("CCP_STAGES") else STAGES)
+    out_path = os.environ.get("CCP_OUT",
+                              os.path.join(REPO, "COMPILE_PROBE.json"))
+    results = []
+    for stage in stages:
+        print(f"--- {stage} (timeout {timeout}s)", file=sys.stderr,
+              flush=True)
+        env = {**os.environ, "CCP_STAGE": stage}
+        t0 = time.time()
+        proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                                env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                stdout, stderr = proc.communicate(timeout=45)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                stdout, stderr = proc.communicate()
+            timed_out = True
+        rec = {"stage": stage, "rc": proc.returncode,
+               "timed_out": timed_out,
+               "wall_s": round(time.time() - t0, 1)}
+        for line in (stdout or "").splitlines():
+            try:
+                rec.update(json.loads(line))
+                break
+            except json.JSONDecodeError:
+                continue
+        if not rec.get("ok"):
+            rec["stderr_tail"] = (stderr or "")[-800:]
+        results.append(rec)
+        print(json.dumps(rec), file=sys.stderr, flush=True)
+        with open(out_path, "w") as fh:
+            json.dump({"stages": results, "lib": LIB}, fh, indent=1)
+        if stage == "s0_probe" and (timed_out or proc.returncode != 0):
+            print("chip unreachable; aborting ladder", file=sys.stderr)
+            break
+    print(json.dumps({"stages": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+"""Matched single-core CPU baseline for the north-star 4096-lane map.
+
+Round-3 verdict: the "≥50× on the 4096-condition sweep" claim divided the
+*bench-workload* rung by a *bench-workload* scipy baseline (0.931 s/lane);
+the map's own single-core s/lane was never measured.  This script closes
+that gap: it samples the 64×64 T×phi map on a stratified n×n sub-lattice
+(unbiased for the uniform grid), solves each sampled condition one-at-a-time
+on the CPU exactly the way the reference runs (one serial CVODE-class BDF
+call per condition, /root/reference/src/BatchReactor.jl:210), and
+extrapolates mean s/lane × 4096 to the full-map single-core wall-clock.
+
+Two baseline solvers, reported separately:
+- ``scipy``  — solve_ivp(method="BDF") driving the jitted-on-CPU JAX RHS
+  with the ANALYTIC Jacobian supplied (stronger than the round-2 bench
+  baseline, which let scipy finite-difference J — supplying J is the fair
+  single-core analog of CVODE's user-Jacobian mode);
+- ``native`` — the repo's independent C++ variable-order BDF runtime
+  (batchreactor_tpu/native/br_native.cpp), analytic Jacobian in C++, genuinely
+  single-threaded — the strongest CVODE-class single-core baseline we have.
+
+Writes NORTHSTAR_BASELINE.json with per-solver s/lane stats and the implied
+full-map speedup for the TPU number in NORTHSTAR_TPU.json (if present).
+
+Usage:
+  python scripts/northstar_baseline.py            # 8x8 = 64 sample lanes
+  NB_N=4 python scripts/northstar_baseline.py     # 4x4 quick pass
+  NB_SOLVERS=native python scripts/northstar_baseline.py
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+if not os.path.isdir(LIB):
+    LIB = os.path.join(REPO, "tests", "fixtures")
+
+# the north-star map definition (scripts/northstar_sweep.py run_sweep
+# defaults) — keep in sync
+N_FULL = 64
+T_LO, T_HI = 1500.0, 2000.0
+PHI_LO, PHI_HI = 0.6, 1.6
+T1, P = 8e-4, 1e5
+RTOL, ATOL = 1e-6, 1e-10
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+    from batchreactor_tpu.parallel.grid import premixed_mole_fracs
+    from batchreactor_tpu.utils.composition import density, mole_to_mass
+
+    n = int(os.environ.get("NB_N", "8"))
+    solvers = os.environ.get("NB_SOLVERS", "scipy,native").split(",")
+    log = lambda m: print(m, file=sys.stderr, flush=True)
+
+    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sp = list(gm.species)
+
+    # stratified sub-lattice: centers of n×n equal blocks of the 64×64 grid
+    full_T = np.linspace(T_LO, T_HI, N_FULL)
+    full_phi = np.linspace(PHI_LO, PHI_HI, N_FULL)
+    pick = (N_FULL // (2 * n) + (N_FULL // n) * np.arange(n))
+    Ts, phis = full_T[pick], full_phi[pick]
+    lanes = [(T, phi) for T in Ts for phi in phis]
+    log(f"[baseline] {len(lanes)} sample lanes from the {N_FULL}x{N_FULL} "
+        f"map (T {Ts[0]:.0f}..{Ts[-1]:.0f}, phi {phis[0]:.2f}.."
+        f"{phis[-1]:.2f}), t1={T1}, rtol={RTOL}/atol={ATOL}")
+
+    rhs = jax.jit(make_gas_rhs(gm, th))
+    jacf = jax.jit(make_gas_jac(gm, th))
+
+    def y0_of(T, phi):
+        X = premixed_mole_fracs(sp, "CH4", jnp.asarray([phi]), stoich_o2=2.0,
+                                diluent="N2", o2_to_diluent=0.5)[0]
+        rho = float(density(X, th.molwt, float(T), P))
+        return np.asarray(mole_to_mass(X, th.molwt)) * rho
+
+    results = {}
+    per_lane = [{"T": float(T), "phi": float(phi)} for T, phi in lanes]
+
+    if "scipy" in solvers:
+        from scipy.integrate import solve_ivp
+
+        walls, fails = [], 0
+        for i, (T, phi) in enumerate(lanes):
+            y0 = y0_of(T, phi)
+            cfg = {"T": jnp.asarray(float(T))}
+            f = lambda t, y: np.asarray(rhs(t, jnp.asarray(y), cfg))
+            J = lambda t, y: np.asarray(jacf(t, jnp.asarray(y), cfg))
+            f(0.0, y0), J(0.0, y0)  # compile outside the timer
+            t0 = time.perf_counter()
+            sol = solve_ivp(f, (0.0, T1), y0, method="BDF",
+                            rtol=RTOL, atol=ATOL, jac=J)
+            walls.append(time.perf_counter() - t0)
+            per_lane[i]["scipy_s"] = round(walls[-1], 4)
+            fails += not sol.success
+            if i % n == 0:
+                log(f"[scipy] lane {i}/{len(lanes)} T={T:.0f} "
+                    f"phi={phi:.2f}: {walls[-1]:.2f}s")
+        results["scipy"] = {"s_per_lane_mean": float(np.mean(walls)),
+                            "s_per_lane_min": float(np.min(walls)),
+                            "s_per_lane_max": float(np.max(walls)),
+                            "s_per_lane_std": float(np.std(walls)),
+                            "n_failed": fails}
+
+    if "native" in solvers:
+        from batchreactor_tpu import native
+
+        walls, fails = [], 0
+        for i, (T, phi) in enumerate(lanes):
+            y0 = y0_of(T, phi)
+            t0 = time.perf_counter()
+            r = native.solve_gas_bdf(gm, th, float(T), y0, 0.0, T1,
+                                     rtol=RTOL, atol=ATOL, n_save=0)
+            walls.append(time.perf_counter() - t0)
+            per_lane[i]["native_s"] = round(walls[-1], 5)
+            fails += r.status != "Success"
+            if i % n == 0:
+                log(f"[native] lane {i}/{len(lanes)} T={T:.0f} "
+                    f"phi={phi:.2f}: {walls[-1]:.3f}s")
+        results["native"] = {"s_per_lane_mean": float(np.mean(walls)),
+                             "s_per_lane_min": float(np.min(walls)),
+                             "s_per_lane_max": float(np.max(walls)),
+                             "s_per_lane_std": float(np.std(walls)),
+                             "n_failed": fails}
+
+    B_full = N_FULL * N_FULL
+    rec = {
+        "workload": f"GRI30 {N_FULL}x{N_FULL} TxPhi ignition map "
+                    f"(northstar_sweep.py definition), single-core CPU, "
+                    f"one serial BDF call per condition",
+        "sample": f"stratified {n}x{n} block-center sub-lattice "
+                  f"({len(lanes)} lanes)",
+        "t1": T1, "rtol": RTOL, "atol": ATOL,
+        "solvers": results,
+        # per-lane (T, phi, s) records feed the lane-cost model that sorts
+        # the TPU map into cost-homogeneous chunks (northstar_sweep.py)
+        "per_lane": per_lane,
+    }
+    for name, r in results.items():
+        rec[f"extrapolated_full_map_wall_s_{name}"] = round(
+            r["s_per_lane_mean"] * B_full, 1)
+
+    ns_path = os.path.join(REPO, "NORTHSTAR_TPU.json")
+    if os.path.exists(ns_path):
+        with open(ns_path) as fh:
+            ns = json.load(fh)
+        tpu_wall = ns.get("wall_s")
+        if tpu_wall:
+            rec["tpu_wall_s"] = tpu_wall
+            for name, r in results.items():
+                rec[f"map_speedup_vs_{name}"] = round(
+                    r["s_per_lane_mean"] * B_full / tpu_wall, 1)
+
+    out = os.environ.get("NB_OUT", os.path.join(REPO,
+                                                "NORTHSTAR_BASELINE.json"))
+    with open(out, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

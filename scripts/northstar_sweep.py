@@ -41,10 +41,58 @@ if not os.path.isdir(LIB):
     LIB = os.path.join(REPO, "tests", "fixtures")
 
 
+def _lane_cost_model(T, phi, log=print):
+    """Predicted per-lane cost from the stratified single-core sample in
+    NORTHSTAR_BASELINE.json (scripts/northstar_baseline.py): bilinear
+    interpolation of the native-BDF s/lane over the (T, phi) plane.  Used
+    to cost-sort lanes before chunking (checkpointed_sweep lane_cost=):
+    a chunk's wall is its slowest lane, and the map's corner lanes cost
+    ~3x its cheap lanes, so cost-homogeneous chunks cut the straggler
+    tax.  Ordering is all that matters; absolute calibration does not.
+    Returns None (no sort) if the baseline artifact is unavailable."""
+    import numpy as np
+
+    path = os.path.join(REPO, "NORTHSTAR_BASELINE.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        rec = json.load(fh)
+    per_lane = rec.get("per_lane")
+    if not per_lane:
+        return None
+    pts = np.asarray([[r["T"], r["phi"]] for r in per_lane])
+    w = np.asarray([r.get("native_s", r.get("scipy_s", np.nan))
+                    for r in per_lane])
+    if np.isnan(w).any():
+        return None
+    Tg = np.unique(pts[:, 0])
+    Pg = np.unique(pts[:, 1])
+    if Tg.size * Pg.size != w.size:
+        return None
+    W = w.reshape(Tg.size, Pg.size)  # lanes were written T-major
+
+    def interp1(grid, x):
+        i = np.clip(np.searchsorted(grid, x) - 1, 0, grid.size - 2)
+        f = np.clip((x - grid[i]) / (grid[i + 1] - grid[i]), 0.0, 1.0)
+        return i, f
+
+    iT, fT = interp1(Tg, np.asarray(T))
+    iP, fP = interp1(Pg, np.asarray(phi))
+    cost = ((1 - fT) * (1 - fP) * W[iT, iP]
+            + (1 - fT) * fP * W[iT, iP + 1]
+            + fT * (1 - fP) * W[iT + 1, iP]
+            + fT * fP * W[iT + 1, iP + 1])
+    log(f"[northstar] lane-cost model from {os.path.basename(path)}: "
+        f"predicted s/lane {cost.min():.3f}..{cost.max():.3f} "
+        f"(max/mean {cost.max() / cost.mean():.2f})")
+    return cost
+
+
 def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
               phi_hi=1.6, t1=8e-4, p=1e5, ckpt_dir=None, chunk_size=512,
               segment_steps=256, mesh=None, rtol=1e-6, atol=1e-10,
-              n_spot=8, method="bdf", jac_window=8, log=print):
+              n_spot=8, method="bdf", jac_window=8, sort_lanes=True,
+              log=print):
     """Run the T x phi GRI ignition map; return the result record dict."""
     import jax
     import jax.numpy as jnp
@@ -85,11 +133,17 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
     solve_kw = dict(rtol=rtol, atol=atol, jac=jac, observer=obs,
                     observer_init=obs0, mesh=mesh, method=method,
                     segment_steps=segment_steps, jac_window=jac_window)
+    lane_cost = None
+    if sort_lanes and ckpt_dir:
+        # cost-sorted chunking only changes anything when the sweep is
+        # chunked; the single-program path has no chunk boundaries
+        lane_cost = _lane_cost_model(grid["T"], grid["phi"], log=log)
     t_start = time.perf_counter()
     with ph("solve"):
         if ckpt_dir:
             res = checkpointed_sweep(rhs, y0s, 0.0, t1, cfgs, ckpt_dir,
-                                     chunk_size=chunk_size, **solve_kw)
+                                     chunk_size=chunk_size,
+                                     lane_cost=lane_cost, **solve_kw)
         else:
             kw = {k: v for k, v in solve_kw.items() if k != "segment_steps"}
             res = ensemble_solve_segmented(rhs, y0s, 0.0, t1, cfgs,
@@ -158,6 +212,7 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
         "method": method,
         "exp32": os.environ.get("BR_EXP32") == "1",
         "jac_window": jac_window,
+        "lane_cost_sorted": lane_cost is not None,
         "B": int(B),
         "wall_s": round(wall, 2),
         "cond_per_s": round(B / wall, 3),
@@ -190,6 +245,7 @@ def main():
                                               "bdf") == "bdf" else "1")),
                     segment_steps=int(os.environ.get("NORTHSTAR_SEG", "256")),
                     chunk_size=int(os.environ.get("NORTHSTAR_CHUNK", "512")),
+                    sort_lanes=os.environ.get("NORTHSTAR_SORT", "1") == "1",
                     log=lambda m: print(m, file=sys.stderr, flush=True))
     out = os.environ.get("NORTHSTAR_OUT", os.path.join(REPO,
                                                        "NORTHSTAR.json"))

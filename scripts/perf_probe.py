@@ -29,7 +29,7 @@ OUT = os.path.join(REPO, "PERF_PROBE.json")
 
 # every variant pins BENCH_METHOD, BR_EXP32 and BENCH_LINSOLVE explicitly:
 # bench.py's rung mode now DEFAULTS to the winning config (method=bdf,
-# BR_EXP32=1, linsolve auto -> inv32nr on accelerators for BDF), so an
+# BR_EXP32=1, linsolve auto -> inv32f on accelerators for BDF), so an
 # unpinned variant would silently measure the lever it claims to isolate
 VARIANTS = {
     "base": {"BENCH_METHOD": "sdirk", "BR_EXP32": "0",

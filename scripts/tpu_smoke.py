@@ -1,0 +1,85 @@
+"""Wedge-safe runner for the on-chip pytest smoke tier (``-m tpu``).
+
+Launches ``pytest tests -m tpu`` in a child process with ``BR_TEST_TPU=1``
+(tests/conftest.py then leaves the real accelerator backend in place) and a
+SIGTERM-first timeout: a SIGKILLed TPU client wedges the tunneled chip for
+hours (PERF.md round-2/3 postmortems), so the child gets SIGTERM plus a
+45 s grace period before any KILL, and the runner itself never touches the
+device.  Writes TPU_SMOKE.json (override with TPU_SMOKE_OUT) recording
+pass/fail counts, duration, and the tail of the pytest output — the
+per-round artifact the round-3 verdict asked for (chip regressions caught
+by tests, not only bench).
+
+Usage:
+  python scripts/tpu_smoke.py                      # full tier, 2400 s cap
+  TPU_SMOKE_TIMEOUT=900 TPU_SMOKE_K=file_driven python scripts/tpu_smoke.py
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    timeout = int(os.environ.get("TPU_SMOKE_TIMEOUT", "2400"))
+    out_path = os.environ.get("TPU_SMOKE_OUT",
+                              os.path.join(REPO, "TPU_SMOKE.json"))
+    env = {**os.environ, "BR_TEST_TPU": "1",
+           "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
+           "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "2"}
+    cmd = [sys.executable, "-m", "pytest", os.path.join(REPO, "tests"),
+           "-m", "tpu", "-q", "--no-header", "-rA"]
+    if os.environ.get("TPU_SMOKE_K"):
+        cmd += ["-k", os.environ["TPU_SMOKE_K"]]
+
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        stdout, _ = proc.communicate(timeout=timeout)
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            stdout, _ = proc.communicate(timeout=45)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, _ = proc.communicate()
+        timed_out = True
+    wall = time.time() - t0
+
+    counts = {}
+    m = re.search(r"(\d+) passed", stdout or "")
+    counts["passed"] = int(m.group(1)) if m else 0
+    m = re.search(r"(\d+) failed", stdout or "")
+    counts["failed"] = int(m.group(1)) if m else 0
+    m = re.search(r"(\d+) skipped", stdout or "")
+    counts["skipped"] = int(m.group(1)) if m else 0
+    m = re.search(r"(\d+) error", stdout or "")
+    counts["errors"] = int(m.group(1)) if m else 0
+
+    rec = {
+        "tier": "tpu-smoke (-m tpu)",
+        "rc": proc.returncode,
+        "timed_out": timed_out,
+        "wall_s": round(wall, 1),
+        "counts": counts,
+        "ok": (not timed_out and proc.returncode == 0
+               and counts["passed"] > 0 and counts["failed"] == 0),
+        "output_tail": (stdout or "")[-3000:],
+    }
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps({k: v for k, v in rec.items() if k != "output_tail"}))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

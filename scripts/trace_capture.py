@@ -1,0 +1,209 @@
+"""Capture a jax.profiler device trace of a bench segment (round-4 task:
+direct evidence for the attempt-cost decomposition that round 3 could only
+infer from lever deltas, PERF.md).
+
+Runs the bench workload's segmented BDF sweep (GRI-3.0, B lanes) with the
+bench-protocol configuration, warms one segment (compile excluded), then
+traces a handful of steady-state segments with ``jax.profiler.trace``.
+The xplane trace lands in ``perf_trace/<ts>/`` and — when the
+tensorboard_plugin_profile toolchain is importable — is immediately
+digested into TRACE_SUMMARY.json: top self-time ops from the device
+op-profile, the per-category split (the data PERF.md's findings paragraph
+cites).
+
+Wedge-safe usage (the capture touches the chip — background + SIGTERM):
+  timeout -s TERM -k 45 1800 python scripts/trace_capture.py
+  TC_B=256 TC_SEGMENTS=4 TC_CPU=1 ... (CPU control run)
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+os.environ.setdefault("BR_EXP32", "1")
+
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+if not os.path.isdir(LIB):
+    LIB = os.path.join(REPO, "tests", "fixtures")
+
+
+def _analyze(log_dir):
+    """Run _analyze_inproc in a child: the profile toolchain's generated
+    protos need PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python, which must
+    be set before ANY protobuf import — impossible in a process that has
+    already initialized jax/tensorflow."""
+    import subprocess
+
+    env = {**os.environ,
+           "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION": "python",
+           "TC_ANALYZE": log_dir}
+    try:
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             env=env, capture_output=True, text=True,
+                             timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"error": "analysis subprocess timed out"}
+    for line in (out.stdout or "").splitlines():
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"error": f"analysis subprocess rc={out.returncode}: "
+                     f"{(out.stderr or '')[-500:]}"}
+
+
+def _analyze_inproc(log_dir):
+    """xplane.pb -> {top ops by self time, category split}."""
+    try:
+        from xprof.convert import raw_to_tool_data
+    except Exception:
+        try:
+            from tensorboard_plugin_profile.convert import raw_to_tool_data
+        except Exception as e:  # pragma: no cover - toolchain optional
+            return {"error": f"profile toolchain unavailable: {e}"}
+    xplanes = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                        recursive=True)
+    if not xplanes:
+        return {"error": "no xplane.pb captured"}
+    try:
+        data, _ = raw_to_tool_data.xspace_to_tool_data(
+            xplanes, "op_profile", {})
+        if isinstance(data, bytes):
+            data = data.decode()
+        op = json.loads(data)
+    except Exception as e:
+        return {"error": f"op_profile conversion failed: {e}",
+                "xplane_files": xplanes}
+
+    out = {"xplane_files": xplanes, "device_type": op.get("deviceType")}
+
+    def _self_ps(m):
+        v = m.get("selfTimePs", m.get("self_time_ps", 0))
+        return float(v or 0)
+
+    # op_profile tree shapes vary by backend/version: byCategory (TPU) or
+    # byProgram; descend to the deepest nodes and aggregate self time
+    root = None
+    for key in ("byCategoryExcludeIdle", "byCategory",
+                "byProgramExcludeIdle", "byProgram"):
+        node = op.get(key)
+        if node and node.get("children"):
+            root = node
+            out["tree"] = key
+            break
+    if root is None:
+        out["parse_error"] = "no populated op-profile tree"
+        out["raw_keys"] = list(op.keys())
+        return out
+
+    leaves = []
+
+    def walk(node, path):
+        kids = node.get("children") or []
+        m = node.get("metrics") or {}
+        if not kids:
+            if _self_ps(m):
+                leaves.append({"op": node.get("name"),
+                               "path": "/".join(path[-2:]),
+                               "self_time_ps": _self_ps(m)})
+            return
+        for c in kids:
+            walk(c, path + [node.get("name") or ""])
+
+    walk(root, [])
+    total = sum(o["self_time_ps"] for o in leaves) or 1.0
+    leaves.sort(key=lambda o: -o["self_time_ps"])
+    for o in leaves:
+        o["self_frac"] = round(o["self_time_ps"] / total, 4)
+    out["total_self_time_ps"] = total
+    out["n_leaf_ops"] = len(leaves)
+    out["top_ops"] = leaves[:25]
+    return out
+
+
+def main():
+    if os.environ.get("TC_ANALYZE"):  # child mode: parse-only, no jax
+        print(json.dumps(_analyze_inproc(os.environ["TC_ANALYZE"])))
+        return
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if os.environ.get("TC_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+    from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+    from batchreactor_tpu.parallel.sweep import ensemble_solve_segmented
+
+    B = int(os.environ.get("TC_B", "256"))
+    seg = int(os.environ.get("TC_SEG", "256"))
+    n_traced = int(os.environ.get("TC_SEGMENTS", "4"))
+    jw = int(os.environ.get("TC_JW", "8"))
+    log = lambda m: print(m, file=sys.stderr, flush=True)
+
+    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sp = list(gm.species)
+    X = np.zeros(len(sp))
+    X[sp.index("CH4")], X[sp.index("O2")], X[sp.index("N2")] = .25, .5, .25
+    T = jnp.linspace(1500.0, 2000.0, B)
+    y0s = sweep_solution_vectors(jnp.broadcast_to(jnp.asarray(X),
+                                                  (B, len(sp))),
+                                 th.molwt, T, 1e5)
+    rhs, jacf = make_gas_rhs(gm, th), make_gas_jac(gm, th)
+    kw = dict(rtol=1e-6, atol=1e-10, jac=jacf, method="bdf", jac_window=jw)
+
+    # warm run: pays compile, fills the executable cache; sized so the
+    # traced run below replays the exact same program shape
+    log(f"[trace] warm run B={B} seg={seg} (compile)...")
+    t0 = time.perf_counter()
+    res = ensemble_solve_segmented(rhs, y0s, 0.0, 8e-4, {"T": T},
+                                   segment_steps=seg,
+                                   max_segments=2, max_attempts=2 * seg)
+    jax.block_until_ready(res.y)
+    log(f"[trace] warm done in {time.perf_counter() - t0:.1f}s")
+
+    ts = time.strftime("%Y%m%d_%H%M%S")
+    log_dir = os.path.join(REPO, "perf_trace", ts)
+    os.makedirs(log_dir, exist_ok=True)
+    log(f"[trace] tracing {n_traced} segments -> {log_dir}")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(log_dir):
+        res = ensemble_solve_segmented(rhs, y0s, 0.0, 8e-4, {"T": T},
+                                       segment_steps=seg,
+                                       max_segments=n_traced,
+                                       max_attempts=n_traced * seg)
+        jax.block_until_ready(res.y)
+    wall = time.perf_counter() - t0
+    log(f"[trace] traced window: {wall:.1f}s")
+
+    summary = {
+        "backend": jax.default_backend(),
+        "B": B, "segment_steps": seg, "n_segments": n_traced,
+        "jac_window": jw,
+        "traced_wall_s": round(wall, 2),
+        "log_dir": os.path.relpath(log_dir, REPO),
+        "analysis": _analyze(log_dir),
+    }
+    out = os.environ.get("TC_OUT", os.path.join(REPO, "TRACE_SUMMARY.json"))
+    with open(out, "w") as fh:
+        json.dump(summary, fh, indent=1)
+    print(json.dumps({k: v for k, v in summary.items() if k != "analysis"}))
+    an = summary["analysis"]
+    if isinstance(an, dict) and an.get("top_ops"):
+        for o in an["top_ops"][:10]:
+            print(f"  {o['self_frac']:6.1%}  {o['category']:<28} {o['op']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,18 +6,26 @@ fake-multi-device trick, XLA_FLAGS=--xla_force_host_platform_device_count=N).
 
 import os
 
+# BR_TEST_TPU=1 runs the on-chip smoke tier (-m tpu, scripts/tpu_smoke.py):
+# the real accelerator backend is left in place and no virtual devices are
+# forced.  Default: CPU pinned with 8 virtual devices for the mesh tests.
+_TPU_TIER = os.environ.get("BR_TEST_TPU") == "1"
+
 # The axon TPU plugin in this image overrides the JAX_PLATFORMS env var, so the
 # cpu pin must go through jax.config (verified: env alone still yields the TPU).
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+if not _TPU_TIER:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 import pathlib
 
 import jax
 import pytest
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_TIER:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 # BR_REFERENCE= (empty/nonexistent) simulates a bare clone: mechanism tests

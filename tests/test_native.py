@@ -1,7 +1,7 @@
 """Native (C++) runtime tests: RHS parity vs the JAX kernels, BDF accuracy
 vs scipy/SDIRK oracles, trajectory buffers, and the Python-callback path.
 
-The native runtime (native/br_native.cpp) is the framework's analog of the
+The native runtime (batchreactor_tpu/native/br_native.cpp) is the framework's analog of the
 reference's wrapped C libraries (SUNDIALS CVODE at
 /root/reference/src/BatchReactor.jl:138,210): a CHEMKIN-semantics gas RHS
 plus a CVODE-class variable-order BDF, loaded via ctypes."""

@@ -1,0 +1,112 @@
+"""On-chip smoke tier (``-m tpu``): the round-3 verdict's gap that no test
+ever ran on the real accelerator — chip-specific regressions (e.g. the
+coupled-mode TPU compile wall, PERF.md) were only visible through bench
+artifacts, never through the test workflow.
+
+Excluded from the default run (pyproject addopts ``-m 'not tpu'``).  Run
+through ``scripts/tpu_smoke.py`` (wedge-safe: subprocess + SIGTERM timeout,
+writes a TPU_SMOKE json artifact) or directly:
+
+    BR_TEST_TPU=1 python -m pytest tests -m tpu -q
+
+Workload sizes are deliberately small (h2o2 + B=8) so one full pass stays
+inside a single rung-scale compile budget on the tunneled chip.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import batchreactor_tpu as br
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(
+        jax.default_backend() == "cpu",
+        reason="on-chip tier needs a real accelerator (BR_TEST_TPU=1 and "
+               "an attached TPU); default runs exclude it via -m 'not tpu'"),
+]
+
+
+@pytest.fixture(scope="module")
+def h2o2(lib_dir):
+    gm = br.compile_gaschemistry(f"{lib_dir}/h2o2.dat")
+    th = br.create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+    return gm, th
+
+
+def test_file_driven_h2o2_on_chip(tmp_path, reference_dir, lib_dir):
+    """The reference's batch_h2o2 testset (runtests.jl:19-23), solved on the
+    accelerator end-to-end: parse -> jit -> segmented implicit solve ->
+    golden-format output files."""
+    import shutil
+
+    xml = tmp_path / "batch.xml"
+    shutil.copy(reference_dir / "test" / "batch_h2o2" / "batch.xml", xml)
+    ret = br.batch_reactor(str(xml), lib_dir, gaschem=True, verbose=False)
+    assert ret == "Success"
+    rows = np.loadtxt(tmp_path / "gas_profile.csv", delimiter=",",
+                      skiprows=1)
+    assert rows[-1, 0] == pytest.approx(10.0)
+    x = rows[:, 4:]
+    assert np.allclose(x.sum(axis=1), 1.0, atol=1e-8)
+
+
+def test_gri_sweep_b8_on_chip(gri_lib_dir):
+    """B=8 GRI-Mech temperature sweep through the product sweep API on the
+    chip: all lanes succeed, ignition delays are finite and decrease with
+    temperature (the bench workload's physics, tiny shape)."""
+    gm = br.compile_gaschemistry(f"{gri_lib_dir}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{gri_lib_dir}/therm.dat")
+    out = br.batch_reactor_sweep(
+        {"CH4": 0.25, "O2": 0.5, "N2": 0.25},
+        jnp.linspace(1500.0, 2000.0, 8), 1e5, 8e-4,
+        chem=br.Chemistry(gaschem=True), thermo_obj=th, md=gm,
+        segment_steps=256, ignition_marker="CH4")
+    assert out["report"]["counts"]["success"] == 8, out["report"]
+    tau = out["tau"]
+    assert np.all(np.isfinite(tau)) and np.all(tau > 0)
+    assert tau[-1] < tau[0]  # hotter ignites faster
+
+
+def test_segmented_resume_on_chip(tmp_path, h2o2):
+    """Checkpointed sweep on the accelerator: solve all 4 chunks, delete
+    2 chunk files, re-invoke — the partial resume must re-solve exactly the
+    missing chunks and reproduce the straight-through result bit-for-bit
+    (the exact-multistep-resume contract exercised where it ships)."""
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+    from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+    from batchreactor_tpu.solver.sdirk import SUCCESS
+
+    gm, th = h2o2
+    sp = list(gm.species)
+    B = 8
+    X = np.zeros((B, len(sp)))
+    X[:, sp.index("H2")], X[:, sp.index("O2")] = 0.25, 0.25
+    X[:, sp.index("N2")] = 0.5
+    T = jnp.linspace(1150.0, 1350.0, B)
+    y0s = sweep_solution_vectors(jnp.asarray(X), th.molwt, T, 1e5)
+    rhs, jac = make_gas_rhs(gm, th), make_gas_jac(gm, th)
+    kw = dict(rtol=1e-6, atol=1e-10, jac=jac, segment_steps=128,
+              jac_window=1)  # jw=1: resume is bit-exact (solver/bdf.py)
+
+    import os
+
+    ckpt = tmp_path / "ckpt"
+    full = checkpointed_sweep(rhs, y0s, 0.0, 2e-4, {"T": T},
+                              str(ckpt), chunk_size=2, **kw)
+    assert np.all(np.asarray(full.status) == SUCCESS)
+    # partial resume: drop 2 of the 4 chunk files, re-invoke — the missing
+    # chunks re-solve on the accelerator, the survivors load from disk
+    os.remove(ckpt / "chunk_00001.npz")
+    os.remove(ckpt / "chunk_00003.npz")
+    resumed = checkpointed_sweep(rhs, y0s, 0.0, 2e-4, {"T": T},
+                                 str(ckpt), chunk_size=2, **kw)
+    np.testing.assert_array_equal(np.asarray(full.status),
+                                  np.asarray(resumed.status))
+    np.testing.assert_array_equal(np.asarray(full.y), np.asarray(resumed.y))
+    np.testing.assert_array_equal(np.asarray(full.t), np.asarray(resumed.t))
