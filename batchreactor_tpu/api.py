@@ -535,13 +535,29 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         # lane (even shards are a sharding requirement); sliced off below
         y0s, cfgs, B = pad_to_mesh(y0s, cfgs, mesh)
 
+    # resolve accelerator-vs-CPU defaults from the devices the sweep
+    # actually runs on: a CPU-device mesh on a TPU-attached host must keep
+    # the CVODE-exact per-attempt Jacobian the docstring promises for CPU
+    platform = (mesh.devices.flat[0].platform if mesh is not None
+                else jax.default_backend())
     if jac_window is None:
-        # resolve from the devices the sweep actually runs on: a CPU-device
-        # mesh on a TPU-attached host must keep the CVODE-exact per-attempt
-        # Jacobian the docstring promises for CPU runs
-        platform = (mesh.devices.flat[0].platform if mesh is not None
-                    else jax.default_backend())
         jac_window = 8 if (method == "bdf" and platform != "cpu") else 1
+    if platform == "cpu":
+        # the exp32 selection is frozen process-wide at first kernel trace
+        # (ops/gas_kinetics._exp) and CANNOT follow per-call devices; on a
+        # TPU-attached host it freezes to the f32 formulation, so a
+        # CPU-mesh parity run there must be told how to get f64-exact rates
+        from .ops.gas_kinetics import _EXP32
+
+        if _EXP32:
+            import warnings
+
+            warnings.warn(
+                "rate exponentials are frozen to the accelerator f32 "
+                "formulation (process-wide, resolved at first trace) but "
+                "this sweep runs on CPU devices; for f64-exact CPU rates "
+                "set BR_EXP32=0 before importing batchreactor_tpu",
+                RuntimeWarning, stacklevel=2)
     common = dict(mesh=mesh, rtol=rtol, atol=atol, jac=jac,
                   observer=observer, observer_init=obs0, method=method,
                   jac_window=jac_window)

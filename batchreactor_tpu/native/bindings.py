@@ -20,12 +20,26 @@ _SRC = os.path.join(_PKG_DIR, "br_native.cpp")
 
 
 def _so_path():
+    """Build target named by a content hash of the C++ source: the cache
+    directory is shared across package versions and wheel-extracted files
+    can carry archive timestamps older than a previously built .so, so an
+    mtime freshness check could silently load a stale library with an
+    incompatible struct ABI.  A hash-named .so is correct by construction
+    (exists == built from exactly this source)."""
+    import hashlib
+
+    try:
+        with open(_SRC, "rb") as fh:
+            tag = hashlib.sha256(fh.read()).hexdigest()[:12]
+    except OSError:
+        tag = "nosrc"
+    name = f"libbr_native-{tag}.so"
     if os.access(_PKG_DIR, os.W_OK):
-        return os.path.join(_PKG_DIR, "libbr_native.so")
+        return os.path.join(_PKG_DIR, name)
     cache = os.path.join(os.path.expanduser("~"), ".cache",
                          "batchreactor_tpu")
     os.makedirs(cache, exist_ok=True)
-    return os.path.join(cache, "libbr_native.so")
+    return os.path.join(cache, name)
 
 
 _SO = _so_path()
@@ -149,8 +163,10 @@ def load_library():
             return _lib
         if not os.path.exists(_SRC):
             raise NativeUnavailable(f"native source missing: {_SRC}")
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        # the .so name embeds a content hash of the source (_so_path), so
+        # existence alone proves freshness — no mtime comparison, which
+        # wheel-extracted archive timestamps would defeat
+        if not os.path.exists(_SO):
             _build()
         try:
             lib = ctypes.CDLL(_SO)

@@ -15,6 +15,7 @@ anywhere and dependency-free.)
 import hashlib
 import json
 import os
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -166,7 +167,7 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
 
 
 def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
-                       lane_cost=None, **solve_kw):
+                       lane_cost=None, chunk_log=None, **solve_kw):
     """ensemble_solve with chunk-level checkpoint/resume.
 
     Splits the (B, ...) batch into ``chunk_size`` pieces; chunk i's result is
@@ -277,9 +278,23 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
         chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
         if os.path.exists(path):
             res, _ = load_result(path)
+            if chunk_log is not None:
+                chunk_log(f"[ckpt] chunk {i} loaded from {path}")
         else:
+            t_c = _time.perf_counter()
             res = _solve_chunk(y0s[lo:hi], chunk_cfgs)
+            jax.block_until_ready(res.y)
+            solve_s = _time.perf_counter() - t_c
+            t_c = _time.perf_counter()
             save_result(path, res, chunk_cfgs)
+            if chunk_log is not None:
+                att = (np.asarray(res.n_accepted)
+                       + np.asarray(res.n_rejected))
+                chunk_log(
+                    f"[ckpt] chunk {i} ({hi - lo} lanes): solve "
+                    f"{solve_s:.2f}s ({(hi - lo) / solve_s:.1f} cond/s), "
+                    f"save {_time.perf_counter() - t_c:.2f}s, attempts "
+                    f"mean {att.mean():.0f} max {att.max()}")
         parts.append(res)
     out = _concat_results(parts)
     if inv_perm is not None:

@@ -37,6 +37,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .linalg import make_solve_m
@@ -51,9 +52,12 @@ _M = MAXORD + 1             # active change_D block, 6
 _GAMMA_TAB = [0.0]
 for _j in range(1, _ROWS):
     _GAMMA_TAB.append(_GAMMA_TAB[-1] + 1.0 / _j)
-_GAMMA = jnp.asarray(_GAMMA_TAB)
+# numpy, not jnp: module-level device arrays would initialize the
+# backend at import (hangs host-only use when the tunneled TPU is
+# wedged); they enter jitted code as constants either way
+_GAMMA = np.asarray(_GAMMA_TAB)
 # local error constant at order q is 1/(q+1)
-_ERRC = jnp.asarray([1.0 / (q + 1) for q in range(_ROWS)])
+_ERRC = np.asarray([1.0 / (q + 1) for q in range(_ROWS)])
 
 
 def _change_D(D, order, factor):
@@ -253,9 +257,11 @@ def solve(
         n_equal = jnp.where(factor_clip < 1.0, 0, n_equal)
 
         t_new = t + h
-        gam = _GAMMA[order]
+        # jnp.asarray at use: the tables live as numpy so import
+        # stays device-free, but traced-order indexing needs jnp
+        gam = jnp.asarray(_GAMMA)[order]
         y_pred = _masked_row_sum(D, jnp.ones((_ROWS,), y0.dtype), order)
-        psi = _masked_row_sum(D, _GAMMA[:_ROWS], order, lo=1) / gam
+        psi = _masked_row_sum(D, jnp.asarray(_GAMMA[:_ROWS]), order, lo=1) / gam
         c = h / gam
         scale = atol + rtol * jnp.abs(y_pred)
 
@@ -264,7 +270,7 @@ def solve(
         solve_m = make_solve_m(M, linsolve, y0.dtype)
         d, conv = newton(solve_m, t_new, y_pred, psi, c, scale)
 
-        err = _scaled_norm(_ERRC[order] * d, y_pred, rtol, atol)
+        err = _scaled_norm(jnp.asarray(_ERRC)[order] * d, y_pred, rtol, atol)
         accept = conv & (err <= 1.0) & jnp.isfinite(err) & running & ~already
 
         # ---- rejected: shrink h (newton failure: halve; error: PI-free
@@ -295,11 +301,11 @@ def solve(
         e_mid = err
         e_m = jnp.where(
             order > 1,
-            _scaled_norm(_ERRC[order - 1] * jnp.take(D_acc, order, axis=0),
+            _scaled_norm(jnp.asarray(_ERRC)[order - 1] * jnp.take(D_acc, order, axis=0),
                          y_new, rtol, atol), jnp.inf)
         e_p = jnp.where(
             order < MAXORD,
-            _scaled_norm(_ERRC[order + 1] *
+            _scaled_norm(jnp.asarray(_ERRC)[order + 1] *
                          jnp.take(D_acc, order + 2, axis=0),
                          y_new, rtol, atol), jnp.inf)
         of = order.astype(y0.dtype)

@@ -24,6 +24,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..utils.pytree import pytree_dataclass
@@ -31,7 +32,8 @@ from .linalg import lu_factor, lu_solve, make_solve_m  # noqa: F401
 
 # --- SDIRK4 tableau (Hairer & Wanner II, Table 6.5; gamma = 1/4) ---
 _GAMMA = 0.25
-_C = jnp.array([1 / 4, 3 / 4, 11 / 20, 1 / 2, 1.0])
+# numpy, not jnp: see solver/bdf.py — import must not touch a device
+_C = np.array([1 / 4, 3 / 4, 11 / 20, 1 / 2, 1.0])
 _A = (
     (1 / 4,),
     (1 / 2, 1 / 4),
@@ -39,8 +41,8 @@ _A = (
     (371 / 1360, -137 / 2720, 15 / 544, 1 / 4),
     (25 / 24, -49 / 48, 125 / 16, -85 / 12, 1 / 4),
 )
-_B = jnp.array([25 / 24, -49 / 48, 125 / 16, -85 / 12, 1 / 4])
-_B_ERR = _B - jnp.array([59 / 48, -17 / 96, 225 / 32, -85 / 12, 0.0])
+_B = np.array([25 / 24, -49 / 48, 125 / 16, -85 / 12, 1 / 4])
+_B_ERR = _B - np.array([59 / 48, -17 / 96, 225 / 32, -85 / 12, 0.0])
 
 # status codes (per lane)
 RUNNING, SUCCESS, MAX_STEPS_REACHED, DT_UNDERFLOW = 0, 1, 2, 3

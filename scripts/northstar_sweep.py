@@ -61,8 +61,15 @@ def _lane_cost_model(T, phi, log=print):
     if not per_lane:
         return None
     pts = np.asarray([[r["T"], r["phi"]] for r in per_lane])
-    w = np.asarray([r.get("native_s", r.get("scipy_s", np.nan))
-                    for r in per_lane])
+    # all-or-nothing solver choice: native_s and scipy_s differ ~3.6x in
+    # absolute scale, so a per-row fallback would order lanes by which
+    # solver timed them, not by cost
+    key = ("native_s" if all("native_s" in r for r in per_lane)
+           else "scipy_s" if all("scipy_s" in r for r in per_lane)
+           else None)
+    if key is None:
+        return None
+    w = np.asarray([r[key] for r in per_lane])
     if np.isnan(w).any():
         return None
     Tg = np.unique(pts[:, 0])
@@ -143,7 +150,8 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
         if ckpt_dir:
             res = checkpointed_sweep(rhs, y0s, 0.0, t1, cfgs, ckpt_dir,
                                      chunk_size=chunk_size,
-                                     lane_cost=lane_cost, **solve_kw)
+                                     lane_cost=lane_cost, chunk_log=log,
+                                     **solve_kw)
         else:
             kw = {k: v for k, v in solve_kw.items() if k != "segment_steps"}
             res = ensemble_solve_segmented(rhs, y0s, 0.0, t1, cfgs,

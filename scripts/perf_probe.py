@@ -69,6 +69,12 @@ VARIANTS = {
     "bdf_exp32f_jw8": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
                        "BENCH_LINSOLVE": "inv32f",
                        "BENCH_JAC_WINDOW": "8"},
+    # window-depth probe beyond the adopted default: CVODE reuses J up to
+    # ~50 steps; jw 8->16 measures whether the window is exhausted (r3
+    # measured 4->8 at +7%, so expect small-but-nonzero or a tau-shift cost)
+    "bdf_exp32f_jw16": {"BENCH_METHOD": "bdf", "BR_EXP32": "1",
+                        "BENCH_LINSOLVE": "inv32f",
+                        "BENCH_JAC_WINDOW": "16"},
 }
 
 
