@@ -546,10 +546,13 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         # the exp32 selection is frozen process-wide at first kernel trace
         # (ops/gas_kinetics._exp) and CANNOT follow per-call devices; on a
         # TPU-attached host it freezes to the f32 formulation, so a
-        # CPU-mesh parity run there must be told how to get f64-exact rates
-        from .ops.gas_kinetics import _EXP32
+        # CPU-mesh parity run there must be told how to get f64-exact rates.
+        # _exp32_enabled() (not the raw global, which is None before the
+        # first trace) — resolving here matches what the upcoming trace
+        # would freeze anyway, and makes the warning fire on the FIRST sweep
+        from .ops.gas_kinetics import _exp32_enabled
 
-        if _EXP32:
+        if _exp32_enabled():
             import warnings
 
             warnings.warn(

@@ -53,12 +53,32 @@ class NativeUnavailable(RuntimeError):
 
 
 def _build():
-    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _SO, _SRC]
+    # compile to a temp path and rename: the hash-named target is trusted
+    # by existence alone, so a partial file from an interrupted g++ must
+    # never land at _SO (rename on the same filesystem is atomic)
+    tmp = _SO + f".build{os.getpid()}"
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
     except (OSError, subprocess.CalledProcessError) as e:
         detail = getattr(e, "stderr", "") or str(e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         raise NativeUnavailable(f"g++ build failed: {detail}") from e
+    # GC stale revisions: hash-named siblings accumulate one per source
+    # edit / wheel upgrade otherwise
+    import glob
+
+    for old in glob.glob(os.path.join(os.path.dirname(_SO),
+                                      "libbr_native-*.so")):
+        if old != _SO:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
 
 
 class _BrGasMech(ctypes.Structure):

@@ -1,3 +1,4 @@
+from . import multihost
 from .checkpoint import checkpointed_sweep, load_result, save_result
 from .grid import condition_grid, premixed_mole_fracs, sweep_solution_vectors
 from .sweep import (
@@ -20,6 +21,7 @@ __all__ = [
     "ignition_observer",
     "load_result",
     "make_mesh",
+    "multihost",
     "pad_batch",
     "premixed_mole_fracs",
     "save_result",

@@ -1,0 +1,106 @@
+"""Multi-host (multi-process) ensemble sweeps over DCN — the distributed
+scaling tier above the single-process ICI mesh.
+
+The reference has no distributed execution at all (one serial CVODE call
+per process, /root/reference/src/BatchReactor.jl:210; SURVEY.md §2c states
+the gap explicitly).  Here the ensemble batch axis shards across EVERY
+device of EVERY participating process: within a host, lanes ride the ICI
+mesh exactly as in :mod:`.sweep`; across hosts, XLA's runtime carries the
+(zero) collective traffic over DCN — lanes never exchange data, so the
+only cross-host communication is the final result gather.
+
+Pattern (mirrors JAX multi-process SPMD):
+
+    from batchreactor_tpu.parallel import multihost as mh
+    mh.initialize(coordinator_address="host0:1234",
+                  num_processes=N, process_id=i)   # once per process
+    mesh = mh.global_mesh()
+    res = mh.ensemble_solve_multihost(rhs, y0s, 0.0, t1, cfgs, mesh=mesh,
+                                      jac=jac)     # y0s: full array on
+    # every process; res fields are fully-replicated numpy (gathered)
+
+On a real TPU pod slice ``jax.distributed.initialize()`` autodetects all
+arguments; the explicit form here is what the CPU multi-process test tier
+uses (tests/test_multihost.py spawns 2 processes x 4 virtual devices).
+
+Every process passes the SAME full-batch ``y0s``/``cfgs`` (host-replicated
+inputs — sweeps are built from broadcastable condition grids, so this
+costs nothing); :func:`scatter_batch` then materializes the global sharded
+array without any cross-host data movement (each process reads its own
+lanes from its local copy).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .sweep import ensemble_solve, pad_batch
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None, **kw):
+    """Join (or start) the distributed runtime.  Thin wrapper over
+    ``jax.distributed.initialize`` so callers need no direct jax.distributed
+    import; on TPU pods call with no arguments (autodetected)."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kw)
+
+
+def global_mesh(axis="batch"):
+    """1-D mesh over ALL devices of ALL processes (jax.devices() is the
+    global device list under the distributed runtime)."""
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def scatter_batch(x, mesh, axis="batch"):
+    """Host-replicated (B, ...) numpy -> global jax.Array sharded P(axis).
+
+    Uses ``make_array_from_callback``: each process materializes only the
+    shards its local devices own, read from its local full copy — no
+    cross-host transfer (``jax.device_put`` cannot target non-addressable
+    devices, so the single-process sweep path does not work here)."""
+    x = np.asarray(x)
+    sharding = NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+    return jax.make_array_from_callback(x.shape, sharding,
+                                        lambda idx: x[idx])
+
+
+def gather_batch(arr):
+    """Global sharded array -> fully-replicated numpy on every process."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def ensemble_solve_multihost(rhs, y0s, t0, t1, cfgs, *, mesh=None,
+                             axis="batch", gather=True, **solve_kw):
+    """:func:`.sweep.ensemble_solve` across every process's devices.
+
+    ``y0s`` (B, S) and each ``cfgs`` leaf (B,) must be identical on every
+    process (host-replicated); B must divide the global device count (use
+    :func:`.sweep.pad_batch`).  Inputs are scattered with
+    :func:`scatter_batch`; the jitted solve then follows its input
+    shardings (SPMD — no device_put inside, which cannot address remote
+    devices).  With ``gather=True`` (default) every result leaf comes back
+    as fully-replicated numpy on every process; ``gather=False`` returns
+    the sharded global arrays (each process can address only its shards).
+    """
+    if mesh is None:
+        mesh = global_mesh(axis)
+    B = int(np.asarray(y0s).shape[0])
+    if pad_batch(B, mesh) != B:
+        raise ValueError(
+            f"the global device count {mesh.devices.size} must divide the "
+            f"batch size {B}; pad to {pad_batch(B, mesh)} lanes first "
+            f"(pad_to_mesh/pad_batch)")
+    y0s_g = scatter_batch(y0s, mesh, axis)
+    cfgs_g = {k: scatter_batch(v, mesh, axis) for k, v in cfgs.items()}
+    # mesh=None: inputs are already globally sharded and jit follows them
+    res = ensemble_solve(rhs, y0s_g, t0, t1, cfgs_g, mesh=None, **solve_kw)
+    if not gather:
+        return res
+    return jax.tree.map(
+        lambda x: gather_batch(x) if (hasattr(x, "ndim") and x.ndim >= 1
+                                      and x.shape[:1] == (B,)) else x, res)

@@ -1,0 +1,122 @@
+"""Multi-process (multi-host analog) sweep tier: 2 OS processes x 4 virtual
+CPU devices each, joined through jax.distributed + Gloo — the DCN story
+exercised for real, not just a single-process mesh (SURVEY.md §2c: the
+reference has nothing here; our scaling surface must).
+
+The test spawns both processes from a child script (jax.distributed cannot
+re-initialize inside a pytest process that already has a backend), waits
+for both, and asserts the multihost sweep result matches a single-process
+reference solve bit-for-tolerance."""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+CHILD = r"""
+import json, os, sys
+pid, n, port, lib = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from batchreactor_tpu.parallel import multihost as mh
+
+mh.initialize(coordinator_address=f"localhost:{port}", num_processes=n,
+              process_id=pid)
+assert len(jax.devices()) == 4 * n, jax.devices()
+
+import jax.numpy as jnp
+import numpy as np
+import batchreactor_tpu as br
+from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+from batchreactor_tpu.solver.sdirk import SUCCESS
+
+gm = br.compile_gaschemistry(f"{lib}/h2o2.dat")
+th = br.create_thermo(list(gm.species), f"{lib}/therm.dat")
+sp = list(gm.species)
+B = 16  # 2 lanes per device across the 8 global devices
+X = np.zeros((B, len(sp)))
+X[:, sp.index("H2")], X[:, sp.index("O2")], X[:, sp.index("N2")] = .25, .25, .5
+T = jnp.linspace(1150.0, 1350.0, B)
+y0s = np.asarray(sweep_solution_vectors(jnp.asarray(X), th.molwt, T, 1e5))
+rhs, jac = make_gas_rhs(gm, th), make_gas_jac(gm, th)
+
+res = mh.ensemble_solve_multihost(rhs, y0s, 0.0, 2e-4, {"T": np.asarray(T)},
+                                  jac=jac, rtol=1e-6, atol=1e-10)
+assert np.all(np.asarray(res.status) == SUCCESS), res.status
+if pid == 0:
+    print("RESULT " + json.dumps({"y": np.asarray(res.y).tolist(),
+                                  "t": np.asarray(res.t).tolist()}))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_global_mesh_matches_single(tmp_path, lib_dir):
+    child = tmp_path / "mh_child.py"
+    child.write_text(CHILD)
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": str(REPO)}
+    env.pop("XLA_FLAGS", None)  # child pins its own 4-device count
+    procs = [subprocess.Popen(
+        [sys.executable, str(child), str(i), "2", str(port), lib_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=str(tmp_path)) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        # a Gloo rendezvous hang (port race, dead peer) must not leak two
+        # live JAX processes pinning the port across reruns
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+    payload = next(line for line in outs[0].splitlines()
+                   if line.startswith("RESULT "))
+    got = json.loads(payload[len("RESULT "):])
+
+    # single-process reference on the plain 8-virtual-device CPU mesh
+    import jax.numpy as jnp
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+    from batchreactor_tpu.parallel import ensemble_solve
+    from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+
+    gm = br.compile_gaschemistry(f"{lib_dir}/h2o2.dat")
+    th = br.create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+    sp = list(gm.species)
+    B = 16
+    X = np.zeros((B, len(sp)))
+    X[:, sp.index("H2")], X[:, sp.index("O2")] = 0.25, 0.25
+    X[:, sp.index("N2")] = 0.5
+    T = jnp.linspace(1150.0, 1350.0, B)
+    y0s = sweep_solution_vectors(jnp.asarray(X), th.molwt, T, 1e5)
+    ref = ensemble_solve(make_gas_rhs(gm, th), y0s, 0.0, 2e-4, {"T": T},
+                         jac=make_gas_jac(gm, th), rtol=1e-6, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(got["y"]), np.asarray(ref.y),
+                               rtol=1e-9, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(got["t"]), np.asarray(ref.t),
+                               rtol=1e-12)
