@@ -389,24 +389,24 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
 _SWEEP_FNS = {}
 
 
-def _sweep_fns(mode, gm, sm, thermo_obj, kc_compat, asv_quirk, marker_idx,
-               ignition_mode):
+def _sweep_fns(mode, udf, gm, sm, thermo_obj, kc_compat, asv_quirk,
+               marker_idx, ignition_mode):
     from .parallel import ignition_observer
 
-    key = (mode, id(gm), id(sm), id(thermo_obj), kc_compat, asv_quirk,
-           marker_idx, ignition_mode)
+    key = (mode, id(udf), id(gm), id(sm), id(thermo_obj), kc_compat,
+           asv_quirk, marker_idx, ignition_mode)
     hit = _SWEEP_FNS.get(key)
     if (hit is not None and hit[0] is gm and hit[1] is sm
-            and hit[2] is thermo_obj):
-        return hit[3:]
-    rhs = _make_rhs(mode, None, gm, sm, thermo_obj, kc_compat, asv_quirk)
+            and hit[2] is thermo_obj and hit[3] is udf):
+        return hit[4:]
+    rhs = _make_rhs(mode, udf, gm, sm, thermo_obj, kc_compat, asv_quirk)
     jac = _make_jac(mode, gm, sm, thermo_obj, kc_compat, asv_quirk)
     observer = obs0 = None
     if marker_idx is not None:
         observer, obs0 = ignition_observer(marker_idx, mode=ignition_mode)
     if len(_SWEEP_FNS) >= 64:
         _SWEEP_FNS.pop(next(iter(_SWEEP_FNS)))
-    _SWEEP_FNS[key] = (gm, sm, thermo_obj, rhs, jac, observer, obs0)
+    _SWEEP_FNS[key] = (gm, sm, thermo_obj, udf, rhs, jac, observer, obs0)
     return rhs, jac, observer, obs0
 
 
@@ -434,10 +434,12 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     device launch and continues on host (parallel.ensemble_solve_segmented).
 
     Chemistry modes: gas (``md=`` or ``gmd=``), surface (``md=`` or
-    ``smd=``), or coupled gas+surf (``gmd=`` AND ``smd=`` with both chem
+    ``smd=``), coupled gas+surf (``gmd=`` AND ``smd=`` with both chem
     flags — e.g. the catalyst-loading Asv sweep on the batch_gas_and_surf
-    workload).  Coupled mode is net-new relative to the reference's
-    programmatic form, whose params collision forbids it (SURVEY.md §3.3).
+    workload), or user-defined (``chem.userchem`` with a JAX-traceable
+    ``chem.udf`` — the reference's UDF seam widened to the ensemble).
+    Coupled mode is net-new relative to the reference's programmatic form,
+    whose params collision forbids it (SURVEY.md §3.3).
     ``method="bdf"`` selects the variable-order BDF solver (the fast path
     for sweeps — PERF.md), and ``jac_window=K`` holds one Jacobian across
     K step attempts (CVODE's quasi-constant iteration matrix; measured
@@ -460,6 +462,19 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
 
     if chem is None or thermo_obj is None:
         raise TypeError("batch_reactor_sweep needs chem= and thermo_obj=")
+    if chem.userchem and (chem.gaschem or chem.surfchem):
+        # the reference's du assembly is an exclusive 4-way branch
+        # (/root/reference/src/BatchReactor.jl:362-373): user mode never
+        # combines with mechanism chemistry — fail loudly rather than
+        # silently ignoring the udf
+        raise ValueError("userchem is exclusive: combine it with neither "
+                         "gaschem nor surfchem")
+    if chem.udf is not None and not chem.userchem:
+        # a udf without the flag would be silently dropped by the
+        # mechanism branches below — the same silent-ignore failure the
+        # guards in those branches exist to prevent
+        raise ValueError("chem.udf is set but chem.userchem is False; "
+                         "set userchem=True for user-defined chemistry")
     if chem.surfchem and chem.gaschem:
         # coupled mode (net-new vs the reference's programmatic form, whose
         # params collision forbids it — SURVEY.md §3.3): both mechanisms
@@ -494,8 +509,21 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         if gm is None:
             raise TypeError("gas sweep needs md= or gmd=")
         mode, sm, covg0 = "gas", None, None
+    elif chem.userchem:
+        # the reference's UDF mode (/root/reference/src/BatchReactor.jl:
+        # 358-360,372) widened to the ensemble: the user source function
+        # must be JAX-traceable (it vmaps over lanes); Jacobian falls back
+        # to jacfwd inside the solver (no closed form for user code)
+        if chem.udf is None:
+            raise TypeError("userchem sweep needs chem.udf")
+        if md is not None or gmd is not None or smd is not None:
+            raise TypeError("md=/gmd=/smd= passed with userchem — a "
+                            "silently ignored mechanism would make this a "
+                            "udf-only run; user mode takes no mechanism")
+        mode, gm, sm, covg0 = "udf", None, None, None
     else:
-        raise ValueError("batch_reactor_sweep needs surfchem and/or gaschem")
+        raise ValueError("batch_reactor_sweep needs surfchem, gaschem, "
+                         "and/or userchem")
     species = thermo_obj.species
 
     T = jnp.atleast_1d(jnp.asarray(T, dtype=jnp.float64))
@@ -524,9 +552,9 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
             raise KeyError(f"ignition_marker {ignition_marker!r} not in "
                            f"species list")
         marker_idx = idx[key]
-    rhs, jac, observer, obs0 = _sweep_fns(mode, gm, sm, thermo_obj,
-                                          kc_compat, asv_quirk, marker_idx,
-                                          ignition_mode)
+    rhs, jac, observer, obs0 = _sweep_fns(mode, chem.udf, gm, sm,
+                                          thermo_obj, kc_compat, asv_quirk,
+                                          marker_idx, ignition_mode)
     if not analytic_jac:
         jac = None  # solver falls back to jax.jacfwd
 

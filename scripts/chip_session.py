@@ -1,0 +1,160 @@
+"""One-shot orchestrator for a healthy-chip window (round-4 deliverables).
+
+The tunneled chip wedges for hours at a time (PERF.md), so when it IS
+healthy every deliverable must run in one supervised pass, banking results
+incrementally.  Steps, in priority order (each its own subprocess with a
+SIGTERM-first timeout; a mid-session wedge stops the ladder but keeps
+everything already banked):
+
+  1. bench      — live rung ladder (bench.py banks each healthy rung)
+  2. compile    — coupled compile-wall localization ladder
+                  (scripts/coupled_compile_probe.py -> COMPILE_PROBE.json)
+  3. coupled    — coupled gas+surf TPU throughput (scripts/coupled_probe.py
+                  -> COUPLED_TPU.json); analytic J if stage s5 compiled,
+                  else the jacfwd fallback that did
+  4. northstar  — 4096-lane map, chunk-512 instrumented + chunk-4096 A/B
+  5. smoke      — on-chip pytest tier (scripts/tpu_smoke.py)
+  6. trace      — device trace of a bench segment (scripts/trace_capture.py)
+
+Usage (ALWAYS as a background task):
+  python scripts/chip_session.py                 # all steps
+  CS_STEPS=bench,coupled python scripts/chip_session.py
+Writes CHIP_SESSION.json progress after every step.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "CHIP_SESSION.json")
+
+
+def run(cmd, timeout, extra_env=None, label=""):
+    env = {**os.environ, **(extra_env or {})}
+    t0 = time.time()
+    print(f"=== {label or cmd}: start (timeout {timeout}s)",
+          file=sys.stderr, flush=True)
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=45)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        timed_out = True
+    wall = time.time() - t0
+    print((out or "")[-1500:], file=sys.stderr, flush=True)
+    print(f"=== {label}: rc={proc.returncode} timed_out={timed_out} "
+          f"{wall:.0f}s", file=sys.stderr, flush=True)
+    return {"label": label, "rc": proc.returncode, "timed_out": timed_out,
+            "wall_s": round(wall, 1), "tail": (out or "")[-1200:]}
+
+
+def probe():
+    r = run([sys.executable, os.path.join(REPO, "bench.py")], 240,
+            {"BENCH_MODE": "probe"}, "probe")
+    return r["rc"] == 0 and not r["timed_out"]
+
+
+def main():
+    known = ["bench", "compile", "coupled", "northstar", "smoke", "trace"]
+    if os.environ.get("CS_STEPS"):
+        steps = [s.strip() for s in os.environ["CS_STEPS"].split(",")
+                 if s.strip()]
+        unknown = [s for s in steps if s not in known]
+        if unknown:
+            raise SystemExit(f"unknown CS_STEPS {unknown}; known: {known}")
+    else:
+        steps = known
+    state = {"t_start": time.strftime("%H:%M:%S"), "steps": []}
+
+    def record(rec):
+        state["steps"].append(rec)
+        with open(OUT, "w") as fh:
+            json.dump(state, fh, indent=1)
+
+    if not probe():
+        record({"label": "probe", "rc": 1,
+                "note": "chip unreachable at session start"})
+        return 1
+
+    py = sys.executable
+    if "bench" in steps:
+        record(run([py, os.path.join(REPO, "bench.py")], 7200, {},
+                   "bench-ladder"))
+        if not probe():
+            record({"label": "abort", "note": "chip wedged after bench"})
+            return 1
+    if "compile" in steps:
+        record(run([py, "scripts/coupled_compile_probe.py"], 6000,
+                   {"CCP_TIMEOUT": "600"}, "coupled-compile-ladder"))
+        if not probe():
+            record({"label": "abort", "note": "chip wedged after compile"})
+            return 1
+    if "coupled" in steps:
+        # choose the Jacobian mode that compiled: analytic if stage s5 ok
+        # choose the Jacobian mode the compile ladder proved out; with no
+        # evidence (ladder skipped/failed) prefer the jacfwd fallback —
+        # the analytic mode is the KNOWN compile wall (PERF.md), so
+        # defaulting to it would burn the healthy-chip window re-failing
+        cp_jac, skip = "fwd", False
+        try:
+            with open(os.path.join(REPO, "COMPILE_PROBE.json")) as fh:
+                stages = {s["stage"]: s for s in json.load(fh)["stages"]}
+            if stages.get("s5_bdf_ana", {}).get("ok"):
+                cp_jac = "analytic"
+            elif not stages.get("s4_bdf_fwd", {}).get("ok") and stages:
+                # (an s7-remat-only success is recorded in COMPILE_PROBE
+                # for follow-up wiring but coupled_probe has no remat mode)
+                skip = True  # nothing it can run compiles; don't burn time
+        except (OSError, KeyError, json.JSONDecodeError):
+            pass
+        if skip:
+            record({"label": "coupled-probe", "skipped":
+                    "no coupled variant compiled in COMPILE_PROBE.json"})
+        else:
+            record(run([py, "scripts/coupled_probe.py"], 5400,
+                       {"CP_JAC": cp_jac,
+                        "CP_OUT": os.path.join(REPO, "COUPLED_TPU.json")},
+                       f"coupled-probe-{cp_jac}"))
+        if not probe():
+            record({"label": "abort", "note": "chip wedged after coupled"})
+            return 1
+    if "northstar" in steps:
+        record(run([py, "scripts/northstar_sweep.py"], 3600,
+                   {"NORTHSTAR_CKPT": "/tmp/ns_chip512",
+                    "NORTHSTAR_OUT": os.path.join(REPO,
+                                                  "NORTHSTAR_TPU.json")},
+                   "northstar-chunk512"))
+        # A/B: the whole map as ONE chunk — no checkpoint halo
+        record(run([py, "scripts/northstar_sweep.py"], 3600,
+                   {"NORTHSTAR_CKPT": "/tmp/ns_chip4096",
+                    "NORTHSTAR_CHUNK": "4096",
+                    "NORTHSTAR_OUT": os.path.join(
+                        REPO, "NORTHSTAR_TPU_1CHUNK.json")},
+                   "northstar-chunk4096"))
+        if not probe():
+            record({"label": "abort", "note": "chip wedged after northstar"})
+            return 1
+    if "smoke" in steps:
+        record(run([py, "scripts/tpu_smoke.py"], 2700, {},
+                   "tpu-smoke-tier"))
+    if "trace" in steps:
+        record(run([py, "scripts/trace_capture.py"], 1800, {},
+                   "trace-capture"))
+    record({"label": "done", "chip_healthy_at_end": probe()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,7 +130,8 @@ def main():
         "x_parity_native": spot,
         "phases_s": {k: round(v, 2) for k, v in ph.summary().items()},
     }
-    out_path = os.path.join(REPO, f"COUPLED_{rec['device'].upper()}.json")
+    out_path = os.environ.get(
+        "CP_OUT", os.path.join(REPO, f"COUPLED_{rec['device'].upper()}.json"))
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec))
