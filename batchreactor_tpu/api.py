@@ -390,17 +390,28 @@ _SWEEP_FNS = {}
 
 
 def _sweep_fns(mode, udf, gm, sm, thermo_obj, kc_compat, asv_quirk,
-               marker_idx, ignition_mode):
+               marker_idx, ignition_mode, jac_mode="analytic"):
     from .parallel import ignition_observer
 
     key = (mode, id(udf), id(gm), id(sm), id(thermo_obj), kc_compat,
-           asv_quirk, marker_idx, ignition_mode)
+           asv_quirk, marker_idx, ignition_mode, jac_mode)
     hit = _SWEEP_FNS.get(key)
     if (hit is not None and hit[0] is gm and hit[1] is sm
             and hit[2] is thermo_obj and hit[3] is udf):
         return hit[4:]
     rhs = _make_rhs(mode, udf, gm, sm, thermo_obj, kc_compat, asv_quirk)
-    jac = _make_jac(mode, gm, sm, thermo_obj, kc_compat, asv_quirk)
+    if jac_mode == "fwd":
+        jac = None  # solver falls back to jax.jacfwd
+    else:
+        jac = _make_jac(mode, gm, sm, thermo_obj, kc_compat, asv_quirk)
+        if jac_mode == "remat" and jac is not None:
+            # rematerialized closed-form Jacobian: numerically identical,
+            # but the checkpoint barrier restructures what XLA sees — the
+            # third arrow (after analytic/fwd) against the coupled-mode
+            # TPU compile wall (PERF.md).  Wrapped HERE so the callable is
+            # cached: a per-call jax.checkpoint closure would defeat the
+            # compilation cache (identity-keyed, parallel/sweep.py)
+            jac = jax.checkpoint(jac)
     observer = obs0 = None
     if marker_idx is not None:
         observer, obs0 = ignition_observer(marker_idx, mode=ignition_mode)
@@ -451,9 +462,10 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     and segmented-bit-exactness test tiers live — keep the CVODE-exact
     per-attempt Jacobian.  Pass an explicit value to override either way.
     ``analytic_jac=False`` drops the closed-form Jacobian and lets the
-    solver fall back to ``jax.jacfwd`` — a measurement/escape knob (the
-    coupled analytic-J program currently hits a TPU-backend compile-time
-    wall, PERF.md).
+    solver fall back to ``jax.jacfwd``; ``analytic_jac="remat"`` keeps the
+    closed form but wraps it in ``jax.checkpoint`` (numerically identical,
+    different XLA program structure).  Both are measurement/escape knobs
+    for the coupled analytic-J TPU-backend compile-time wall (PERF.md).
     """
     from .parallel import (ensemble_solve, ensemble_solve_segmented,
                            sweep_report)
@@ -552,11 +564,19 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
             raise KeyError(f"ignition_marker {ignition_marker!r} not in "
                            f"species list")
         marker_idx = idx[key]
+    if isinstance(analytic_jac, str):
+        if analytic_jac != "remat":
+            raise ValueError(f"analytic_jac must be True, False, or "
+                             f"'remat'; got {analytic_jac!r}")
+        jac_mode = "remat"
+    else:
+        # truthiness, not identity: np.True_/0/1 behaved as booleans here
+        # before the remat mode existed and must keep doing so
+        jac_mode = "analytic" if analytic_jac else "fwd"
     rhs, jac, observer, obs0 = _sweep_fns(mode, chem.udf, gm, sm,
                                           thermo_obj, kc_compat, asv_quirk,
-                                          marker_idx, ignition_mode)
-    if not analytic_jac:
-        jac = None  # solver falls back to jax.jacfwd
+                                          marker_idx, ignition_mode,
+                                          jac_mode)
 
     if mesh is not None:
         # pad the batch to the mesh device count with copies of the last

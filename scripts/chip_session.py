@@ -10,8 +10,9 @@ everything already banked):
   2. compile    — coupled compile-wall localization ladder
                   (scripts/coupled_compile_probe.py -> COMPILE_PROBE.json)
   3. coupled    — coupled gas+surf TPU throughput (scripts/coupled_probe.py
-                  -> COUPLED_TPU.json); analytic J if stage s5 compiled,
-                  else the jacfwd fallback that did
+                  -> COUPLED_TPU.json) with the Jacobian mode the ladder
+                  proved: analytic (s5 ok) > remat at jw=1 (s7 ok) >
+                  jacfwd (s4 ok) > skipped (nothing compiles)
   4. northstar  — 4096-lane map, chunk-512 instrumented + chunk-4096 A/B
   5. smoke      — on-chip pytest tier (scripts/tpu_smoke.py)
   6. trace      — device trace of a bench segment (scripts/trace_capture.py)
@@ -102,7 +103,6 @@ def main():
             record({"label": "abort", "note": "chip wedged after compile"})
             return 1
     if "coupled" in steps:
-        # choose the Jacobian mode that compiled: analytic if stage s5 ok
         # choose the Jacobian mode the compile ladder proved out; with no
         # evidence (ladder skipped/failed) prefer the jacfwd fallback —
         # the analytic mode is the KNOWN compile wall (PERF.md), so
@@ -113,9 +113,9 @@ def main():
                 stages = {s["stage"]: s for s in json.load(fh)["stages"]}
             if stages.get("s5_bdf_ana", {}).get("ok"):
                 cp_jac = "analytic"
+            elif stages.get("s7_bdf_remat", {}).get("ok"):
+                cp_jac = "remat"
             elif not stages.get("s4_bdf_fwd", {}).get("ok") and stages:
-                # (an s7-remat-only success is recorded in COMPILE_PROBE
-                # for follow-up wiring but coupled_probe has no remat mode)
                 skip = True  # nothing it can run compiles; don't burn time
         except (OSError, KeyError, json.JSONDecodeError):
             pass
@@ -123,9 +123,14 @@ def main():
             record({"label": "coupled-probe", "skipped":
                     "no coupled variant compiled in COMPILE_PROBE.json"})
         else:
-            record(run([py, "scripts/coupled_probe.py"], 5400,
-                       {"CP_JAC": cp_jac,
-                        "CP_OUT": os.path.join(REPO, "COUPLED_TPU.json")},
+            env = {"CP_JAC": cp_jac,
+                   "CP_OUT": os.path.join(REPO, "COUPLED_TPU.json")}
+            if cp_jac == "remat":
+                # the ladder validated remat at jac_window=1 (stage s7);
+                # run the exact program structure that compiled, not an
+                # unproven remat+jw8 variant
+                env["CP_JW"] = "1"
+            record(run([py, "scripts/coupled_probe.py"], 5400, env,
                        f"coupled-probe-{cp_jac}"))
         if not probe():
             record({"label": "abort", "note": "chip wedged after coupled"})

@@ -46,12 +46,14 @@ def main():
 
     B = int(os.environ.get("CP_B", "64"))
     t1 = float(os.environ.get("CP_T1", "10.0"))
-    # CP_JAC=fwd drops the analytic Jacobian (jax.jacfwd fallback): the
-    # escape hatch for the coupled analytic-J TPU compile wall (PERF.md)
+    # CP_JAC selects the Jacobian mode: analytic (closed form), fwd
+    # (jax.jacfwd fallback), or remat (closed form under jax.checkpoint) —
+    # the escape hatches for the coupled analytic-J TPU compile wall
     cp_jac = os.environ.get("CP_JAC", "analytic")
-    if cp_jac not in ("analytic", "fwd"):
-        raise SystemExit(f"CP_JAC must be 'analytic' or 'fwd', got {cp_jac!r}")
-    analytic = cp_jac != "fwd"
+    if cp_jac not in ("analytic", "fwd", "remat"):
+        raise SystemExit(f"CP_JAC must be 'analytic', 'fwd' or 'remat', "
+                         f"got {cp_jac!r}")
+    analytic = {"analytic": True, "fwd": False, "remat": "remat"}[cp_jac]
     # the bench protocol's Jacobian window (PERF.md); CP_JW=1 reverts
     jw = int(os.environ.get("CP_JW", "8"))
     Asv = 1.0  # reference batch.xml has no <Asv>; the parser defaults to 1

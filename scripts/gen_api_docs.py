@@ -1,0 +1,100 @@
+"""Generate docs/api.md from the package's live docstrings.
+
+Role-equivalent of Documenter.jl's `@autodocs` blocks
+(/root/reference/docs/make.jl:1-26): the API reference is extracted from
+the installed package, so it cannot drift from the code — CI regenerates
+it and fails if the committed page is stale (`--check`).
+
+Usage:
+  python scripts/gen_api_docs.py            # (re)write docs/api.md
+  python scripts/gen_api_docs.py --check    # exit 1 if docs/api.md is stale
+"""
+
+import inspect
+import pathlib
+import sys
+import textwrap
+
+# host-only work — must not touch a device (see scripts/docs_build.py)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+OUT = REPO / "docs" / "api.md"
+
+SECTIONS = [
+    ("Top-level API", "batchreactor_tpu",
+     ["batch_reactor", "batch_reactor_sweep", "Chemistry",
+      "SensitivityProblem", "compile_gaschemistry", "compile_mech",
+      "create_thermo", "input_data"]),
+    ("Ensemble & distributed sweeps", "batchreactor_tpu.parallel",
+     ["ensemble_solve", "ensemble_solve_segmented", "checkpointed_sweep",
+      "temperature_sweep", "make_mesh", "pad_batch", "condition_grid",
+      "premixed_mole_fracs", "sweep_solution_vectors", "ignition_observer",
+      "ignition_delay", "sweep_report", "save_result", "load_result"]),
+    ("Multi-host (DCN) tier", "batchreactor_tpu.parallel.multihost",
+     ["initialize", "global_mesh", "scatter_batch", "gather_batch",
+      "ensemble_solve_multihost"]),
+    ("Solvers", "batchreactor_tpu.solver.bdf", ["solve"]),
+    ("Solvers (SDIRK)", "batchreactor_tpu.solver.sdirk", ["solve"]),
+    ("Kinetics kernels", "batchreactor_tpu.ops.rhs",
+     ["make_gas_rhs", "make_gas_jac", "make_surface_rhs",
+      "make_surface_jac", "make_udf_rhs"]),
+    ("Native C++ runtime", "batchreactor_tpu.native",
+     ["available", "gas_rhs", "solve_gas_bdf", "solve_surf_bdf"]),
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return ""
+
+
+def render():
+    import importlib
+
+    lines = ["# API reference",
+             "",
+             "Generated from live docstrings by `scripts/gen_api_docs.py` "
+             "— do not edit by hand (CI checks freshness).",
+             ""]
+    for title, modname, names in SECTIONS:
+        mod = importlib.import_module(modname)
+        lines += [f"## {title} (`{modname}`)", ""]
+        for name in names:
+            obj = getattr(mod, name, None)
+            if obj is None:
+                raise SystemExit(
+                    f"{modname}.{name} listed in gen_api_docs.SECTIONS but "
+                    f"missing from the package — update the section table")
+            doc = inspect.getdoc(obj) or "(no docstring)"
+            first_para = doc.split("\n\n")[0]
+            kind = "class" if inspect.isclass(obj) else "function"
+            lines += [f"### `{name}{_sig(obj)}`" if kind == "function"
+                      else f"### `class {name}`",
+                      "",
+                      textwrap.fill(" ".join(first_para.split()), width=78),
+                      ""]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv):
+    text = render()
+    if "--check" in argv:
+        if not OUT.exists() or OUT.read_text() != text:
+            print("docs/api.md is stale; regenerate with "
+                  "python scripts/gen_api_docs.py", file=sys.stderr)
+            return 1
+        print("docs/api.md is fresh")
+        return 0
+    OUT.write_text(text)
+    print(f"wrote {OUT.relative_to(REPO)} ({len(text)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
