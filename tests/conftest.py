@@ -24,6 +24,16 @@ import pathlib
 import jax
 import pytest
 
+try:
+    # one place, loaded for the whole session regardless of collection
+    # order: jit compilation inside properties breaks per-example deadlines
+    from hypothesis import settings as _hyp_settings
+
+    _hyp_settings.register_profile("br", deadline=None, max_examples=25)
+    _hyp_settings.load_profile("br")
+except ImportError:  # property tier simply absent without hypothesis
+    pass
+
 if not _TPU_TIER:
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
