@@ -143,6 +143,10 @@ def compile_mech(mech_file, thermo_obj, gasphase):
         raise ValueError(f"no <site> in {mech_file}")
     coord_map = _parse_pairs(site.findtext("coordination", ""))
     density_el = site.find("density")
+    if density_el is None or not (density_el.text or "").strip():
+        raise ValueError(f"no <density> inside <site> in {mech_file} "
+                         f"(site density, mol/cm2 — cf. the reference "
+                         f"fixture ch4ni.xml:6)")
     site_density = float(density_el.text)
     d_unit = (density_el.get("unit") or "mol/cm2").strip().lower()
     if d_unit == "mol/m2":
@@ -169,6 +173,11 @@ def compile_mech(mech_file, thermo_obj, gasphase):
             continue
         for el in block.findall("rxn"):
             rid = int(el.get("id"))
+            if (el.text or "").count("@") != 1:
+                raise ValueError(
+                    f"reaction {rid} in {mech_file}: expected exactly one "
+                    f"'@' separating 'equation @ rate-params', got "
+                    f"{el.text!r}")
             eq_part, rate_part = el.text.split("@")
             nums = rate_part.split()
             if is_stick:

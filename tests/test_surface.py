@@ -262,3 +262,33 @@ def test_coupled_jac_matches_jacfwd(setup):
     theta /= theta.sum()
     y1 = jnp.asarray(np.concatenate([ygas, theta]))
     _jac_match(rhs, jac, y1, cfg)
+
+
+def test_malformed_xml_raises_loudly(tmp_path, gri_lib_dir):
+    """Malformed surface XML fails with the offending element in the
+    message, never an AttributeError from a missing tag (the parsers'
+    fail-loud contract)."""
+    gasphase = ["H2", "O2", "N2"]
+    th = br.create_thermo(gasphase, f"{gri_lib_dir}/therm.dat")
+    missing_density = """<?xml version="1.0"?>
+<surface_mech unit="kJ/mol">
+ <species>x(s)</species>
+ <site><coordination>x(s)=1</coordination><initial>x(s)=1.0</initial></site>
+</surface_mech>"""
+    p = tmp_path / "bad1.xml"
+    p.write_text(missing_density)
+    with pytest.raises(ValueError, match="density"):
+        compile_mech(str(p), th, gasphase)
+
+    bad_rxn = """<?xml version="1.0"?>
+<surface_mech unit="kJ/mol">
+ <species>x(s)</species>
+ <site><coordination>x(s)=1</coordination>
+   <density unit="mol/cm2">2.6e-9</density>
+   <initial>x(s)=1.0</initial></site>
+ <arrhenius><rxn id="7">H2 + x(s) no-rate-separator</rxn></arrhenius>
+</surface_mech>"""
+    p2 = tmp_path / "bad2.xml"
+    p2.write_text(bad_rxn)
+    with pytest.raises(ValueError, match="reaction 7"):
+        compile_mech(str(p2), th, gasphase)
