@@ -180,6 +180,13 @@ def compile_mech(mech_file, thermo_obj, gasphase):
                     f"{el.text!r}")
             eq_part, rate_part = el.text.split("@")
             nums = rate_part.split()
+            need = 1 if is_stick else 3
+            if len(nums) < need:
+                raise ValueError(
+                    f"reaction {rid} in {mech_file}: expected at least "
+                    f"{need} rate parameter(s) after '@' "
+                    f"({'s0 [beta Ea]' if is_stick else 'A beta Ea'}), "
+                    f"got {rate_part.strip()!r}")
             if is_stick:
                 # stick entries may carry 1 (s0) or 3 (s0 beta Ea) numbers
                 s0 = float(nums[0])

@@ -16,6 +16,8 @@ everything already banked):
   4. northstar  — 4096-lane map, chunk-512 instrumented + chunk-4096 A/B
   5. smoke      — on-chip pytest tier (scripts/tpu_smoke.py)
   6. trace      — device trace of a bench segment (scripts/trace_capture.py)
+  7. invbudget  — amortized Newton-linear-algebra construction budget
+                  (scripts/inv_budget.py -> INV_BUDGET.json)
 
 Usage (ALWAYS as a background task):
   python scripts/chip_session.py                 # all steps
@@ -68,7 +70,8 @@ def probe():
 
 
 def main():
-    known = ["bench", "compile", "coupled", "northstar", "smoke", "trace"]
+    known = ["bench", "compile", "coupled", "northstar", "smoke", "trace",
+             "invbudget"]
     if os.environ.get("CS_STEPS"):
         steps = [s.strip() for s in os.environ["CS_STEPS"].split(",")
                  if s.strip()]
@@ -157,6 +160,9 @@ def main():
     if "trace" in steps:
         record(run([py, "scripts/trace_capture.py"], 1800, {},
                    "trace-capture"))
+    if "invbudget" in steps:
+        record(run([py, "scripts/inv_budget.py"], 1500, {},
+                   "inv-budget"))
     record({"label": "done", "chip_healthy_at_end": probe()})
     return 0
 

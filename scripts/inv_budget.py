@@ -1,0 +1,174 @@
+"""Isolate the Newton-linear-algebra construction cost at bench shapes.
+
+PERF.md's attempt-cost decomposition attributes ~2/3 of a BDF step attempt
+to "RHS + analytic J + elementwise history work", with the inverse
+APPLICATION measured via the inv32nr/inv32f levers — but the inverse
+CONSTRUCTION (jnp.linalg.inv of the (B, S, S) f32 iteration matrix, built
+fresh EVERY attempt since c = h/gamma_q changes) was never isolated: the
+round-3 kernel budget timed single dispatches, which the tunneled chip's
+25-77 ms roundtrip floor swamps.
+
+This probe amortizes dispatch away: each variant is a jitted
+``lax.fori_loop`` of K in-device iterations, so per-iteration numbers are
+real device time.  Variants at the bench shape (B lanes, S=53 species):
+
+  rhs        one gas RHS eval (B,S)
+  jac        one analytic Jacobian build (B,S,S)
+  minv_f32   build M = I - cJ and invert in f32
+  minv_f64   same in f64 (double-double emulation comparison)
+  matvec_f32 apply a cached f32 inverse (the inv32f per-iteration cost)
+  step_ratio everything together in bench proportion: 1 jac / W attempts
+             (W=8), per attempt 1 inverse + 2 matvec + 2 RHS
+
+Writes INV_BUDGET.json.  Wedge-safe usage:
+  timeout -s TERM -k 45 1500 python scripts/inv_budget.py   (background)
+  IB_CPU=1 ... for the CPU control run
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("BR_EXP32", "1")
+
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+if not os.path.isdir(LIB):
+    LIB = os.path.join(REPO, "tests", "fixtures")
+
+
+def main():
+    import jax
+
+    if os.environ.get("IB_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+    from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+
+    B = int(os.environ.get("IB_B", "512"))
+    K = int(os.environ.get("IB_K", "50"))
+    log = lambda m: print(m, file=sys.stderr, flush=True)
+
+    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sp = list(gm.species)
+    S = len(sp)
+    X = np.zeros(S)
+    X[sp.index("CH4")], X[sp.index("O2")], X[sp.index("N2")] = .25, .5, .25
+    T = jnp.linspace(1500.0, 2000.0, B)
+    y0s = sweep_solution_vectors(jnp.broadcast_to(jnp.asarray(X), (B, S)),
+                                 th.molwt, T, 1e5)
+    rhs = make_gas_rhs(gm, th)
+    jacf = make_gas_jac(gm, th)
+    vrhs = jax.vmap(lambda y, t: rhs(0.0, y, {"T": t}))
+    vjac = jax.vmap(lambda y, t: jacf(0.0, y, {"T": t}))
+    c = jnp.full((B,), 1e-7)
+    eye = jnp.eye(S)
+
+    def loop(body):
+        # live-dependence rule: every variant folds its measured output
+        # into the carry with * 1e-30 (NOT * 0.0 — a zero multiplier lets
+        # the simplifier DCE the entire computation being timed) and keeps
+        # the carry within 1e-30 of the physical y0s so iteration 2..K
+        # evaluates on the same state as iteration 1
+        def f(y0s):
+            return lax.fori_loop(0, K, lambda i, y: body(y), y0s)
+        return jax.jit(f)
+
+    variants = {}
+
+    variants["rhs"] = loop(lambda y: y + vrhs(y, T) * 1e-30)
+
+    def jac_build(y):
+        J = vjac(y, T)
+        return y + J[:, :, 0] * 1e-30
+
+    variants["jac"] = loop(jac_build)
+
+    J0 = vjac(y0s, T)
+
+    def minv_f32(y):
+        M = eye[None] - c[:, None, None] * (J0 + y[:, :, None] * 1e-30)
+        inv = jnp.linalg.inv(M.astype(jnp.float32))
+        return y + inv[:, :, 0].astype(jnp.float64) * 1e-30
+
+    variants["minv_f32"] = loop(minv_f32)
+
+    def minv_f64(y):
+        M = eye[None] - c[:, None, None] * (J0 + y[:, :, None] * 1e-30)
+        inv = jnp.linalg.inv(M)
+        return y + inv[:, :, 0] * 1e-30
+
+    variants["minv_f64"] = loop(minv_f64)
+
+    inv0 = jnp.linalg.inv(
+        (eye[None] - c[:, None, None] * J0).astype(jnp.float32))
+
+    def matvec_f32(y):
+        d = jnp.einsum("bij,bj->bi", inv0, y.astype(jnp.float32))
+        return y + d.astype(jnp.float64) * 1e-30
+
+    variants["matvec_f32"] = loop(matvec_f32)
+
+    W = 8
+
+    def step_ratio(y):
+        # bench-proportioned attempt: (1/W) jac + 1 inverse + 2 matvecs
+        # + 2 RHS evals, approximated as one window of W attempts
+        J = vjac(y, T)
+        out = y
+        for _ in range(W):
+            M = eye[None] - c[:, None, None] * J
+            inv = jnp.linalg.inv(M.astype(jnp.float32))
+            for _ in range(2):
+                r = vrhs(out, T)
+                d = jnp.einsum("bij,bj->bi", inv,
+                               r.astype(jnp.float32)).astype(jnp.float64)
+                out = out + d * 1e-30
+        return out
+
+    def loopw(body):
+        def f(y0s):
+            return lax.fori_loop(0, max(1, K // W),
+                                 lambda i, y: body(y), y0s)
+        return jax.jit(f)
+
+    variants["window_w8"] = loopw(step_ratio)
+
+    results = {}
+    for name, fn in variants.items():
+        t0 = time.perf_counter()
+        out = fn(y0s)
+        jax.block_until_ready(out)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = fn(y0s)
+        jax.block_until_ready(out)
+        wall = time.perf_counter() - t0
+        iters = (max(1, K // W) * W if name == "window_w8" else K)
+        per_ms = wall / iters * 1e3
+        results[name] = {"total_s": round(wall, 3),
+                         "per_iter_ms": round(per_ms, 3),
+                         "compile_s": round(compile_s, 1)}
+        log(f"{name:12s} {per_ms:8.3f} ms/iter  (compile {compile_s:.1f}s)")
+
+    rec = {"backend": jax.default_backend(), "B": B, "S": S, "K": K,
+           "variants": results}
+    out_path = os.environ.get("IB_OUT", os.path.join(REPO,
+                                                     "INV_BUDGET.json"))
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
