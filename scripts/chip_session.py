@@ -94,7 +94,11 @@ def main():
 
     py = sys.executable
     if "bench" in steps:
-        record(run([py, os.path.join(REPO, "bench.py")], 7200, {},
+        # +1024 over the default ladder: bench scaling was only ever
+        # measured flat to B=512; the map A/B (northstar step) wants to
+        # know whether bigger single launches keep the per-lane rate
+        record(run([py, os.path.join(REPO, "bench.py")], 7200,
+                   {"BENCH_LADDER": "64,128,256,512,1024"},
                    "bench-ladder"))
         if not probe():
             record({"label": "abort", "note": "chip wedged after bench"})
