@@ -418,6 +418,15 @@ def emit_result(best, state, cached_tpu=False):
     if cached_tpu:
         out["from_tpu_cache"] = True
         out["banked_at"] = best.get("banked_at")
+        # honesty context for the judged artifact: how long the chip has
+        # been unreachable when the banked record was served; best-effort —
+        # the fallback path must never fail to emit its JSON line
+        try:
+            with open("/tmp/chipwatch.log", errors="replace") as fh:
+                lines = [ln.strip() for ln in fh if ln.strip()]
+            out["chip_probe_log_tail"] = lines[-6:]
+        except OSError:
+            pass
     print(json.dumps(out))
 
 

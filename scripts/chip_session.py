@@ -97,7 +97,11 @@ def main():
         # +1024 over the default ladder: bench scaling was only ever
         # measured flat to B=512; the map A/B (northstar step) wants to
         # know whether bigger single launches keep the per-lane rate
-        record(run([py, os.path.join(REPO, "bench.py")], 7200,
+        # 5 rungs x 1500 s worst-case rung timeout + probes: the wrapper
+        # budget must exceed the sum or the B=1024 rung (added for the
+        # scaling question) gets killed mid-compile — and a killed TPU
+        # client wedges the tunnel
+        record(run([py, os.path.join(REPO, "bench.py")], 9000,
                    {"BENCH_LADDER": "64,128,256,512,1024"},
                    "bench-ladder"))
         if not probe():
