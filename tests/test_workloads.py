@@ -263,6 +263,24 @@ class TestSweepAPI:
             np.testing.assert_allclose(x_h2[lane], f / (0.75 + f),
                                        rtol=1e-3)
 
+    def test_remat_jac_mode_matches_analytic(self, h2o2):
+        """analytic_jac='remat' (closed form under jax.checkpoint) is the
+        same math as analytic_jac=True — results must agree to solver
+        tolerance (the knob only changes XLA program structure)."""
+        gm, th = h2o2
+        outs = {}
+        for mode in (True, "remat"):
+            outs[mode] = br.batch_reactor_sweep(
+                {"H2": 0.25, "O2": 0.25, "N2": 0.5},
+                jnp.linspace(1200.0, 1350.0, 3), 1e5, 2e-4,
+                chem=br.Chemistry(gaschem=True), thermo_obj=th, md=gm,
+                analytic_jac=mode)
+            assert outs[mode]["report"]["counts"]["success"] == 3
+        for s in th.species:
+            np.testing.assert_allclose(outs["remat"]["x"][s],
+                                       outs[True]["x"][s],
+                                       rtol=1e-9, atol=1e-14)
+
     def test_per_lane_composition(self, h2o2):
         gm, th = h2o2
         out = br.batch_reactor_sweep(
