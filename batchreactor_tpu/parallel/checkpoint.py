@@ -12,6 +12,7 @@ device.  (Orbax would also work; plain npz keeps the artifact readable
 anywhere and dependency-free.)
 """
 
+import concurrent.futures as _futures
 import hashlib
 import json
 import os
@@ -171,11 +172,15 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     """ensemble_solve with chunk-level checkpoint/resume.
 
     Splits the (B, ...) batch into ``chunk_size`` pieces; chunk i's result is
-    persisted to ``ckpt_dir/chunk_{i:05d}.npz`` as soon as it finishes.  On
-    re-invocation, chunks with an existing file are loaded instead of
-    re-solved (the manifest pins B/chunk_size so a mismatched resume fails
-    loudly rather than silently mixing sweeps).  Returns the full
-    concatenated SolveResult.
+    persisted to ``ckpt_dir/chunk_{i:05d}.npz`` as soon as it finishes.  The
+    npz compression+write runs on a background thread so the NEXT chunk's
+    device solve overlaps it (the save was measured as part of the per-chunk
+    host halo separating map throughput from single-launch throughput,
+    PERF.md); every pending save is drained before this function returns, so
+    on-disk state is complete whenever the call finishes.  On re-invocation,
+    chunks with an existing file are loaded instead of re-solved (the
+    manifest pins B/chunk_size so a mismatched resume fails loudly rather
+    than silently mixing sweeps).  Returns the full concatenated SolveResult.
 
     ``lane_cost`` — optional (B,) array of *predicted* per-lane solve cost
     (any monotone proxy: steps, seconds, stiffness score).  Lanes are
@@ -272,30 +277,65 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
         return res
 
     parts = []
-    for i, lo in enumerate(range(0, B, chunk_size)):
-        hi = min(lo + chunk_size, B)
-        path = os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
-        chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
-        if os.path.exists(path):
-            res, _ = load_result(path)
-            if chunk_log is not None:
-                chunk_log(f"[ckpt] chunk {i} loaded from {path}")
-        else:
-            t_c = _time.perf_counter()
-            res = _solve_chunk(y0s[lo:hi], chunk_cfgs)
-            jax.block_until_ready(res.y)
-            solve_s = _time.perf_counter() - t_c
+    pending = []
+    # one worker, and at most ONE save in flight: save i overlaps solve
+    # i+1, but solve i+2 waits for save i — so a save failure (disk full,
+    # bad observer pytree) surfaces within one chunk instead of after the
+    # whole sweep, and a preemption can lose at most the single queued
+    # save, preserving the module's resume guarantee.  The completion line
+    # is emitted from the worker thread, so ``chunk_log`` may be called
+    # concurrently with the main thread's per-chunk lines (fine for the
+    # stderr printers the scripts use; wrap with a lock if yours isn't).
+    executor = _futures.ThreadPoolExecutor(max_workers=1)
+
+    def _save_async(i, path, res, chunk_cfgs):
+        def job():
             t_c = _time.perf_counter()
             save_result(path, res, chunk_cfgs)
             if chunk_log is not None:
-                att = (np.asarray(res.n_accepted)
-                       + np.asarray(res.n_rejected))
-                chunk_log(
-                    f"[ckpt] chunk {i} ({hi - lo} lanes): solve "
-                    f"{solve_s:.2f}s ({(hi - lo) / solve_s:.1f} cond/s), "
-                    f"save {_time.perf_counter() - t_c:.2f}s, attempts "
-                    f"mean {att.mean():.0f} max {att.max()}")
-        parts.append(res)
+                chunk_log(f"[ckpt] chunk {i} saved "
+                          f"({_time.perf_counter() - t_c:.2f}s, async)")
+        if pending:
+            pending.pop().result()
+        pending.append(executor.submit(job))
+
+    try:
+        for i, lo in enumerate(range(0, B, chunk_size)):
+            hi = min(lo + chunk_size, B)
+            path = os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
+            chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
+            if os.path.exists(path):
+                res, _ = load_result(path)
+                if chunk_log is not None:
+                    chunk_log(f"[ckpt] chunk {i} loaded from {path}")
+            else:
+                t_c = _time.perf_counter()
+                res = _solve_chunk(y0s[lo:hi], chunk_cfgs)
+                jax.block_until_ready(res.y)
+                solve_s = _time.perf_counter() - t_c
+                if chunk_log is not None:
+                    att = (np.asarray(res.n_accepted)
+                           + np.asarray(res.n_rejected))
+                    chunk_log(
+                        f"[ckpt] chunk {i} ({hi - lo} lanes): solve "
+                        f"{solve_s:.2f}s ({(hi - lo) / solve_s:.1f} cond/s), "
+                        f"attempts mean {att.mean():.0f} max {att.max()}")
+                _save_async(i, path, res, chunk_cfgs)
+            parts.append(res)
+        # durability barrier: a failed/unfinished save must fail the sweep
+        # call, not surface later as a missing chunk on resume
+        while pending:
+            pending.pop().result()
+    finally:
+        executor.shutdown(wait=True)
+        # exceptional unwind (solve error, KeyboardInterrupt): don't let a
+        # concurrent save failure vanish behind the primary exception —
+        # log it so the operator sees e.g. the full disk before retrying
+        for fut in pending:
+            exc = fut.done() and fut.exception()
+            if exc and chunk_log is not None:
+                chunk_log(f"[ckpt] WARNING: background save also failed "
+                          f"during unwind: {exc!r}")
     out = _concat_results(parts)
     if inv_perm is not None:
         inv = jnp.asarray(inv_perm)
