@@ -296,7 +296,11 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
                 chunk_log(f"[ckpt] chunk {i} saved "
                           f"({_time.perf_counter() - t_c:.2f}s, async)")
         if pending:
-            pending.pop().result()
+            # peek-then-pop: if an interrupt lands while blocked here, the
+            # future stays in ``pending`` so the unwind loop below can still
+            # report its failure
+            pending[-1].result()
+            pending.pop()
         pending.append(executor.submit(job))
 
     try:
@@ -325,7 +329,8 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
         # durability barrier: a failed/unfinished save must fail the sweep
         # call, not surface later as a missing chunk on resume
         while pending:
-            pending.pop().result()
+            pending[-1].result()
+            pending.pop()
     finally:
         executor.shutdown(wait=True)
         # exceptional unwind (solve error, KeyboardInterrupt): don't let a

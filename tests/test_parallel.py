@@ -182,6 +182,28 @@ def test_checkpointed_sweep_resume(h2o2, tmp_path):
                            dt0=1e-12)
 
 
+def test_checkpointed_sweep_async_save_failure(h2o2, tmp_path, monkeypatch):
+    """The npz save runs on a background thread (overlapped with the next
+    chunk's solve); a save failure must still fail the sweep call itself —
+    a silently lost chunk would surface as a corrupt resume much later."""
+    import batchreactor_tpu.parallel.checkpoint as ckm
+
+    gm, th, y0 = h2o2
+    rhs = make_gas_rhs(gm, th)
+    B = 6
+    y0s = jnp.broadcast_to(y0, (B, 9))
+    cfgs = {"T": jnp.linspace(1150.0, 1300.0, B)}
+
+    def boom(path, res, cfgs=None):
+        raise OSError("injected: disk full")
+
+    monkeypatch.setattr(ckm, "save_result", boom)
+    with pytest.raises(OSError, match="injected"):
+        ckm.checkpointed_sweep(rhs, y0s, 0.0, 1e-5, cfgs,
+                               str(tmp_path / "sweep"), chunk_size=4,
+                               dt0=1e-12)
+
+
 def test_phases_timer():
     from batchreactor_tpu.utils.profiling import Phases
 
