@@ -1,0 +1,166 @@
+"""Bisect the coupled-Jacobian TPU compile wall INSIDE the jac program.
+
+Round-4's clean localization ladder (scripts/coupled_compile_probe.py, run
+on a fresh healthy chip) pinned the wall to stage s2: ``jit(vmap(
+make_surface_jac(sm, th, gm=gm)))`` at B=64 times out at 600 s while the
+single-lane surface kernel (s1) compiles in ~6 s and the gas-only analytic
+Jacobian compiles inside the full BDF bench program in ~180 s.  This script
+splits s2 along its three axes — vmap batching, gas-block coupling, and the
+final ``jnp.block`` assembly — one subprocess per variant (SIGTERM-first
+timeouts; a SIGKILLed TPU client wedges the tunnel, PERF.md):
+
+  j0_surf_only   vmap B, surface blocks only (gm=None)
+  j1_gas_only    vmap B, gas analytic jac alone (make_gas_jac)
+  j2_no_block    vmap B, coupled, returns the 4 blocks WITHOUT jnp.block
+  j3_full        vmap B, coupled, jnp.block — the s2 reproduction
+  j4_single      coupled + block, single lane (no vmap)
+  j5_small_b     coupled + block, vmap B=8 — compile-time scaling in B
+
+Writes JAC_BISECT.json incrementally.  Usage (background task):
+  python scripts/coupled_jac_bisect.py
+  CJB_STAGES=j2_no_block,j4_single CJB_TIMEOUT=900 CJB_B=64 ...
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
+if not os.path.isdir(LIB):
+    LIB = os.path.join(REPO, "tests", "fixtures")
+
+STAGES = ["j0_surf_only", "j1_gas_only", "j2_no_block", "j3_full",
+          "j4_single", "j5_small_b"]
+
+
+def _stage_main(stage):
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(REPO, ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    os.environ.setdefault("BR_EXP32", "1")
+    import jax
+
+    if os.environ.get("CJB_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.models.surface import compile_mech
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_surface_jac
+    from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+
+    B = int(os.environ.get("CJB_B", "64"))
+    gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+    th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    sm = compile_mech(f"{LIB}/ch4ni.xml", th, list(gm.species))
+    sp = list(gm.species)
+    ng = len(sp)
+
+    X = np.zeros(ng)
+    X[sp.index("CH4")], X[sp.index("O2")], X[sp.index("N2")] = .25, .5, .25
+    T_grid = jnp.linspace(1073.0, 1273.0, B)
+    y0s = sweep_solution_vectors(jnp.broadcast_to(jnp.asarray(X), (B, ng)),
+                                 th.molwt, T_grid, 1e5,
+                                 ini_covg=sm.ini_covg)
+    cfg = {"T": T_grid, "Asv": jnp.full((B,), 1.0)}
+    in_axes = (None, 0, {"T": 0, "Asv": 0})
+
+    t0 = time.perf_counter()
+    if stage == "j0_surf_only":
+        jacf = make_surface_jac(sm, th, gm=None)
+        # gm=None sizes the gas block by thermo.species; the surface-state
+        # vector is unchanged (same y layout), so y0s works as-is
+        f = jax.jit(jax.vmap(jacf, in_axes=in_axes))
+        out = f(0.0, y0s, cfg)
+    elif stage == "j1_gas_only":
+        jacg = make_gas_jac(gm, th)
+        f = jax.jit(jax.vmap(lambda t, y, c: jacg(t, y, {"T": c["T"]}),
+                             in_axes=in_axes))
+        out = f(0.0, y0s[:, :ng], cfg)
+    elif stage in ("j2_no_block", "j3_full", "j4_single", "j5_small_b"):
+        block = stage != "j2_no_block"
+        jacf = make_surface_jac(sm, th, gm=gm)
+        if not block:
+            # reproduce the assembly minus jnp.block: call the kernel's
+            # pieces by differentiating the blocks out of the full matrix
+            full = jacf
+
+            def jacf(t, y, c, _full=full, _ng=ng):
+                J = _full(t, y, c)
+                return (J[:_ng, :_ng], J[:_ng, _ng:],
+                        J[_ng:, :_ng], J[_ng:, _ng:])
+        if stage == "j4_single":
+            f = jax.jit(jacf)
+            out = f(0.0, y0s[0],
+                    {"T": T_grid[0], "Asv": jnp.asarray(1.0)})
+        else:
+            if stage == "j5_small_b":
+                y0s, cfg = y0s[:8], {k: v[:8] for k, v in cfg.items()}
+            f = jax.jit(jax.vmap(jacf, in_axes=in_axes))
+            out = f(0.0, y0s, cfg)
+    else:
+        raise SystemExit(f"unknown stage {stage}")
+    jax.block_until_ready(out)
+    print(json.dumps({"stage": stage, "ok": True,
+                      "backend": jax.default_backend(), "B": B,
+                      "compile_and_run_s": round(time.perf_counter() - t0,
+                                                 1)}))
+
+
+def main():
+    if os.environ.get("CJB_STAGE"):
+        _stage_main(os.environ["CJB_STAGE"])
+        return
+
+    timeout = int(os.environ.get("CJB_TIMEOUT", "600"))
+    stages = (os.environ.get("CJB_STAGES", "").split(",")
+              if os.environ.get("CJB_STAGES") else STAGES)
+    out_path = os.environ.get("CJB_OUT", os.path.join(REPO,
+                                                      "JAC_BISECT.json"))
+    results = []
+    for stage in stages:
+        print(f"--- {stage} (timeout {timeout}s)", file=sys.stderr,
+              flush=True)
+        env = {**os.environ, "CJB_STAGE": stage}
+        t0 = time.time()
+        proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                                env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                stdout, stderr = proc.communicate(timeout=45)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                stdout, stderr = proc.communicate()
+            timed_out = True
+        rec = {"stage": stage, "rc": proc.returncode, "timed_out": timed_out,
+               "wall_s": round(time.time() - t0, 1)}
+        for line in (stdout or "").splitlines():
+            try:
+                rec.update(json.loads(line))
+                break
+            except json.JSONDecodeError:
+                continue
+        if not rec.get("ok"):
+            rec["stderr_tail"] = (stderr or "")[-800:]
+        results.append(rec)
+        print(json.dumps(rec), file=sys.stderr, flush=True)
+        with open(out_path, "w") as fh:
+            json.dump({"stages": results, "B": os.environ.get("CJB_B", "64"),
+                       "lib": LIB}, fh, indent=1)
+    print(json.dumps({"stages": results}))
+
+
+if __name__ == "__main__":
+    main()
