@@ -17,6 +17,8 @@ dynamics are scaled by Asv relative to the textbook equation.  We reproduce
 this behaviour behind ``asv_quirk`` (default True for parity).
 """
 
+import os
+
 import jax.numpy as jnp
 
 from ..utils.composition import mass_to_mole, pressure
@@ -145,6 +147,15 @@ def make_surface_jac(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
             _, dwdot = gas_kinetics.production_rates_and_jac(
                 T, conc, gm, thermo, kc_compat)
             J_gg = J_gg + dwdot * (molwt[:, None] / molwt[None, :])
+        if os.environ.get("BR_JAC_BARRIER") == "1":
+            # compile-wall escape hatch under probe (scripts/
+            # coupled_jac_bisect.py): fence the four blocks so XLA's fusion
+            # search cannot chase producers across the assembly boundary —
+            # numerically the identity
+            import jax
+
+            J_gg, J_gt, J_tg, J_tt = jax.lax.optimization_barrier(
+                (J_gg, J_gt, J_tg, J_tt))
         return jnp.block([[J_gg, J_gt], [J_tg, J_tt]])
 
     return jac

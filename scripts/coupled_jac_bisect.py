@@ -15,6 +15,10 @@ timeouts; a SIGKILLed TPU client wedges the tunnel, PERF.md):
   j3_full        vmap B, coupled, jnp.block — the s2 reproduction
   j4_single      coupled + block, single lane (no vmap)
   j5_small_b     coupled + block, vmap B=8 — compile-time scaling in B
+  j6_barrier     j3 with BR_JAC_BARRIER=1 (optimization_barrier fences the
+                 four blocks before assembly) — fix candidate
+  j7_low_effort  j3 compiled with exec_time_optimization_effort=-1.0 —
+                 fix candidate (skips expensive late optimization passes)
 
 Writes JAC_BISECT.json incrementally.  Usage (background task):
   python scripts/coupled_jac_bisect.py
@@ -36,7 +40,7 @@ if not os.path.isdir(LIB):
     LIB = os.path.join(REPO, "tests", "fixtures")
 
 STAGES = ["j0_surf_only", "j1_gas_only", "j2_no_block", "j3_full",
-          "j4_single", "j5_small_b"]
+          "j4_single", "j5_small_b", "j6_barrier", "j7_low_effort"]
 
 
 def _stage_main(stage):
@@ -84,7 +88,10 @@ def _stage_main(stage):
         f = jax.jit(jax.vmap(lambda t, y, c: jacg(t, y, {"T": c["T"]}),
                              in_axes=in_axes))
         out = f(0.0, y0s[:, :ng], cfg)
-    elif stage in ("j2_no_block", "j3_full", "j4_single", "j5_small_b"):
+    elif stage in ("j2_no_block", "j3_full", "j4_single", "j5_small_b",
+                   "j6_barrier", "j7_low_effort"):
+        if stage == "j6_barrier":
+            os.environ["BR_JAC_BARRIER"] = "1"
         block = stage != "j2_no_block"
         jacf = make_surface_jac(sm, th, gm=gm)
         if not block:
@@ -100,6 +107,12 @@ def _stage_main(stage):
             f = jax.jit(jacf)
             out = f(0.0, y0s[0],
                     {"T": T_grid[0], "Asv": jnp.asarray(1.0)})
+        elif stage == "j7_low_effort":
+            f = jax.jit(jax.vmap(jacf, in_axes=in_axes))
+            lowered = f.lower(0.0, y0s, cfg)
+            compiled = lowered.compile(compiler_options={
+                "exec_time_optimization_effort": -1.0})
+            out = compiled(0.0, y0s, cfg)
         else:
             if stage == "j5_small_b":
                 y0s, cfg = y0s[:8], {k: v[:8] for k, v in cfg.items()}
