@@ -20,6 +20,16 @@ timeouts; a SIGKILLed TPU client wedges the tunnel, PERF.md):
   j7_low_effort  j3 compiled with exec_time_optimization_effort=-1.0 —
                  fix candidate (skips expensive late optimization passes)
 
+RHS-axis stages (added after the localization ladder found s3 — the
+coupled RHS with NO Jacobian — also walls, so the trigger predates the
+Jacobian assembly):
+
+  r0_surf_rhs    vmap B, surface-only RHS (gm=None)
+  r1_coupled_rhs vmap B, coupled RHS — the s3 reproduction
+  r2_rhs_single  coupled RHS, single lane (no vmap)
+  r3_surf_kernel vmap B, bare surface production_rates kernel
+  r4_rhs_low     r1 at exec_time_optimization_effort=-1.0 — fix candidate
+
 Writes JAC_BISECT.json incrementally.  Usage (background task):
   python scripts/coupled_jac_bisect.py
   CJB_STAGES=j2_no_block,j4_single CJB_TIMEOUT=900 CJB_B=64 ...
@@ -39,7 +49,9 @@ LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
 if not os.path.isdir(LIB):
     LIB = os.path.join(REPO, "tests", "fixtures")
 
-STAGES = ["j0_surf_only", "j1_gas_only", "j2_no_block", "j3_full",
+STAGES = ["r3_surf_kernel", "r0_surf_rhs", "r2_rhs_single",
+          "r1_coupled_rhs", "r4_rhs_low",
+          "j0_surf_only", "j1_gas_only", "j2_no_block", "j3_full",
           "j4_single", "j5_small_b", "j6_barrier", "j7_low_effort"]
 
 
@@ -57,7 +69,9 @@ def _stage_main(stage):
 
     import batchreactor_tpu as br
     from batchreactor_tpu.models.surface import compile_mech
-    from batchreactor_tpu.ops.rhs import make_gas_jac, make_surface_jac
+    from batchreactor_tpu.ops import surface_kinetics
+    from batchreactor_tpu.ops.rhs import (make_gas_jac, make_surface_jac,
+                                          make_surface_rhs)
     from batchreactor_tpu.parallel.grid import sweep_solution_vectors
 
     B = int(os.environ.get("CJB_B", "64"))
@@ -77,7 +91,31 @@ def _stage_main(stage):
     in_axes = (None, 0, {"T": 0, "Asv": 0})
 
     t0 = time.perf_counter()
-    if stage == "j0_surf_only":
+    if stage in ("r0_surf_rhs", "r1_coupled_rhs", "r2_rhs_single",
+                 "r4_rhs_low"):
+        rhsf = make_surface_rhs(sm, th,
+                                gm=None if stage == "r0_surf_rhs" else gm)
+        if stage == "r2_rhs_single":
+            f = jax.jit(rhsf)
+            out = f(0.0, y0s[0], {"T": T_grid[0], "Asv": jnp.asarray(1.0)})
+        elif stage == "r4_rhs_low":
+            f = jax.jit(jax.vmap(rhsf, in_axes=in_axes))
+            compiled = f.lower(0.0, y0s, cfg).compile(compiler_options={
+                "exec_time_optimization_effort": -1.0})
+            out = compiled(0.0, y0s, cfg)
+        else:
+            f = jax.jit(jax.vmap(rhsf, in_axes=in_axes))
+            out = f(0.0, y0s, cfg)
+    elif stage == "r3_surf_kernel":
+        gamma_sig = None
+
+        def kernel(T, x, theta):
+            return surface_kinetics.production_rates(T, 1e5, x, theta, sm)
+
+        X_b = jnp.broadcast_to(jnp.asarray(X), (B, ng))
+        f = jax.jit(jax.vmap(kernel, in_axes=(0, 0, None)))
+        out = f(T_grid, X_b, sm.ini_covg)
+    elif stage == "j0_surf_only":
         jacf = make_surface_jac(sm, th, gm=None)
         # gm=None sizes the gas block by thermo.species; the surface-state
         # vector is unchanged (same y layout), so y0s works as-is
