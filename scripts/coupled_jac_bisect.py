@@ -29,6 +29,8 @@ Jacobian assembly):
   r2_rhs_single  coupled RHS, single lane (no vmap)
   r3_surf_kernel vmap B, bare surface production_rates kernel
   r4_rhs_low     r1 at exec_time_optimization_effort=-1.0 — fix candidate
+  r5_roundtrip   vmap B, just the mass->mole->pressure round-trip the
+                 surface path does and the gas-only path reduces away
 
 Writes JAC_BISECT.json incrementally.  Usage (background task):
   python scripts/coupled_jac_bisect.py
@@ -49,7 +51,7 @@ LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
 if not os.path.isdir(LIB):
     LIB = os.path.join(REPO, "tests", "fixtures")
 
-STAGES = ["r3_surf_kernel", "r0_surf_rhs", "r2_rhs_single",
+STAGES = ["r5_roundtrip", "r3_surf_kernel", "r0_surf_rhs", "r2_rhs_single",
           "r1_coupled_rhs", "r4_rhs_low",
           "j0_surf_only", "j1_gas_only", "j2_no_block", "j3_full",
           "j4_single", "j5_small_b", "j6_barrier", "j7_low_effort"]
@@ -106,6 +108,18 @@ def _stage_main(stage):
         else:
             f = jax.jit(jax.vmap(rhsf, in_axes=in_axes))
             out = f(0.0, y0s, cfg)
+    elif stage == "r5_roundtrip":
+        from batchreactor_tpu.utils.composition import (mass_to_mole,
+                                                        pressure)
+
+        def roundtrip(y, T):
+            rho_k = y[:ng]
+            rho = jnp.sum(rho_k)
+            x = mass_to_mole(rho_k / rho, th.molwt)
+            return x * pressure(rho, x, th.molwt, T)
+
+        f = jax.jit(jax.vmap(roundtrip, in_axes=(0, 0)))
+        out = f(y0s, T_grid)
     elif stage == "r3_surf_kernel":
         gamma_sig = None
 
