@@ -19,9 +19,20 @@ abstol=1e-10 (/root/reference/src/BatchReactor.jl:210), so float64 is enabled
 at import.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+if os.environ.get("BR_PLATFORM"):
+    # one-knob platform pin, resolved before any backend use.  The axon TPU
+    # plugin ignores the standard JAX_PLATFORMS env var, so without this an
+    # operator whose tunneled chip is wedged has NO env-level way to run
+    # the CPU paths (incl. backend="cpu", whose mechanism pytrees are jnp
+    # arrays on the default device) — every jnp.asarray would hang on
+    # backend init.  BR_PLATFORM=cpu makes the native runtime usable as
+    # the chip-is-down fallback it exists to be.
+    jax.config.update("jax_platforms", os.environ["BR_PLATFORM"])
 
 from .models.thermo import ThermoTable, create_thermo  # noqa: E402
 from .models.gas import GasMechanism, compile_gaschemistry  # noqa: E402

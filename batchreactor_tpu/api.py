@@ -91,6 +91,21 @@ def get_solution_vector(mole_fracs, molwt, T, p, ini_covg=None):
     return y
 
 
+def resolve_jac_window(jac_window, method, platform=None):
+    """The ONE resolution rule for ``jac_window=None`` (docs/api.md): 8 on
+    accelerator backends under BDF (the bench-protocol default — CVODE's
+    quasi-constant iteration matrix, +70% sweep throughput on TPU, PERF.md),
+    1 everywhere else (CPU keeps the CVODE-exact per-attempt Jacobian the
+    golden-parity tiers pin).  Shared by ``batch_reactor_sweep`` and the
+    single-condition ``batch_reactor`` jax path so the knob cannot drift
+    between entry points."""
+    if jac_window is not None:
+        return jac_window
+    if platform is None:
+        platform = jax.default_backend()
+    return 8 if (method == "bdf" and platform != "cpu") else 1
+
+
 def _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk):
     """RHS for a chemistry mode (the reference's 4-way branch,
     /root/reference/src/BatchReactor.jl:314-373).  Called both eagerly and
@@ -140,9 +155,10 @@ def _segmented_builder(mode, udf, kc_compat, asv_quirk):
 @functools.partial(
     jax.jit,
     static_argnames=("mode", "udf", "kc_compat", "asv_quirk", "n_save",
-                     "max_steps", "method"))
+                     "max_steps", "method", "jac_window"))
 def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
-           n_save, max_steps, kc_compat, asv_quirk, method="bdf"):
+           n_save, max_steps, kc_compat, asv_quirk, method="bdf",
+           jac_window=1):
     """Jitted solve, cache-keyed on the chemistry *mode* rather than a
     per-call rhs closure: mechanism tensor bundles enter as traced pytree
     operands, so repeated calls with any same-shaped mechanism (the
@@ -157,6 +173,7 @@ def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
     return solver(
         rhs, y0, t0, t1, cfg,
         rtol=rtol, atol=atol, n_save=n_save, max_steps=max_steps, jac=jac,
+        jac_window=jac_window,
     )
 
 
@@ -193,7 +210,8 @@ def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
 
 def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
                atol, n_save, max_steps, kc_compat, asv_quirk,
-               segmented=None, progress=None, method="bdf"):
+               segmented=None, progress=None, method="bdf",
+               jac_window=None):
     """Dispatch one solve to the requested backend and normalize the result:
     returns (status_str, t_end, y_end, ts, ys, truncated, n_acc, n_rej)
     with ts/ys the saved trajectory *including* the initial row.
@@ -215,6 +233,7 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
                 res.n_accepted, res.n_rejected)
     if backend != "jax":
         raise ValueError(f"unknown backend {backend!r}; use 'jax' or 'cpu'")
+    jac_window = resolve_jac_window(jac_window, method)
     if segmented is None:
         segmented = jax.default_backend() != "cpu"
     if segmented:
@@ -233,7 +252,8 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
             segment_steps=seg_steps,
             max_segments=max(1, -(-int(max_steps) // seg_steps)),
             max_attempts=int(max_steps),
-            rhs_bundle=(gm, sm, thermo), progress=progress, method=method)
+            rhs_bundle=(gm, sm, thermo), progress=progress, method=method,
+            jac_window=jac_window)
         res = jax.tree.map(
             lambda x: x[0] if hasattr(x, "ndim") and x.ndim >= 1 else x,
             resb)
@@ -241,7 +261,7 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
         res = _solve(mode, udf, gm, sm, thermo, y0,
                      jnp.asarray(t0), jnp.asarray(t1), cfg,
                      rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-                     method=method)
+                     method=method, jac_window=jac_window)
     ts, ys, truncated = trim_trajectory(float(t0), y0, res)
     return (_STATUS.get(int(res.status), "Failure"), float(res.t),
             np.asarray(res.y), ts, ys, truncated, int(res.n_accepted),
@@ -262,7 +282,7 @@ def _mode(chem):
 
 def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
                      max_steps, kc_compat, asv_quirk, verbose, backend,
-                     segmented=None, method="bdf"):
+                     segmented=None, method="bdf", jac_window=None):
     """Core driver: parse XML -> build RHS -> solve -> write profiles
     (reference :152-217)."""
     import sys
@@ -304,7 +324,8 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
         status, t_end, _, ts, ys, truncated, n_acc, n_rej = _run_solve(
             backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
             0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat,
-            asv_quirk, segmented=segmented, progress=prog, method=method)
+            asv_quirk, segmented=segmented, progress=prog, method=method,
+            jac_window=jac_window)
     if verbose and n_live == 0:
         # ts[0] is the initial row, not an accepted step; a truncated run
         # appends a final-state bridge row that is not an accepted step
@@ -332,7 +353,8 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
 
 def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
                       rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-                      backend, segmented=None, method="bdf"):
+                      backend, segmented=None, method="bdf",
+                      jac_window=None):
     """Dict-in/dict-out API (reference :86-147): no files; returns
     ``(accepted_times, {species: final mole fraction})``.
 
@@ -365,7 +387,7 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
     status, t_end, y_end, ts, _, _, _, _ = _run_solve(
         backend, mode, None, gm, sm, thermo_obj, y0, 0.0, float(time), cfg,
         rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-        segmented=segmented, method=method)
+        segmented=segmented, method=method, jac_window=jac_window)
     if status != "Success":
         # fail loudly: a partial-integration composition is worse than an
         # error for reactor-network callers
@@ -588,8 +610,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     # the CVODE-exact per-attempt Jacobian the docstring promises for CPU
     platform = (mesh.devices.flat[0].platform if mesh is not None
                 else jax.default_backend())
-    if jac_window is None:
-        jac_window = 8 if (method == "bdf" and platform != "cpu") else 1
+    jac_window = resolve_jac_window(jac_window, method, platform)
     if platform == "cpu":
         # the exp32 selection is frozen process-wide at first kernel trace
         # (ops/gas_kinetics._exp) and CANNOT follow per-call devices; on a
@@ -641,7 +662,8 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   Asv=1.0, chem=None, thermo_obj=None, md=None,
                   rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,
                   kc_compat=False, asv_quirk=True, verbose=True,
-                  backend="jax", segmented=None, method="bdf"):
+                  backend="jax", segmented=None, method="bdf",
+                  jac_window=None):
     """Simulate an isothermal constant-volume batch reactor (three forms).
 
     Form 1 — file-driven:   ``batch_reactor(input_file, lib_dir,
@@ -668,7 +690,10 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
     ``method`` selects the jax-backend integrator: ``"bdf"`` (default;
     variable-order BDF 1..5, the CVODE family the reference's solver
     belongs to — fewer steps and one Newton solve per step; solver/bdf.py)
-    or ``"sdirk"`` (L-stable one-step SDIRK4).
+    or ``"sdirk"`` (L-stable one-step SDIRK4).  ``jac_window`` follows the
+    same ``None -> platform`` resolution rule as ``batch_reactor_sweep``
+    (:func:`resolve_jac_window`: 8 on accelerators under BDF, 1 on CPU) —
+    one knob, one rule, both entry points.
     """
     if args and isinstance(args[0], dict):
         if len(args) != 4:
@@ -682,7 +707,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             thermo_obj=thermo_obj, md=md, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
             asv_quirk=asv_quirk, backend=backend, segmented=segmented,
-            method=method)
+            method=method, jac_window=jac_window)
 
     if len(args) == 3 and callable(args[2]):
         chem = Chemistry(False, False, True, args[2])
@@ -690,7 +715,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
             asv_quirk=asv_quirk, verbose=verbose, backend=backend,
-            segmented=segmented, method=method)
+            segmented=segmented, method=method, jac_window=jac_window)
 
     if len(args) == 2:
         if chem is None:
@@ -699,6 +724,6 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
             asv_quirk=asv_quirk, verbose=verbose, backend=backend,
-            segmented=segmented, method=method)
+            segmented=segmented, method=method, jac_window=jac_window)
 
     raise TypeError(f"unrecognized batch_reactor argument pattern: {args!r}")

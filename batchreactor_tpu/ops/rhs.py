@@ -22,7 +22,6 @@ import os
 import jax.numpy as jnp
 
 from ..utils.composition import mass_to_mole, pressure
-from ..utils.constants import R
 from . import gas_kinetics, surface_kinetics
 
 
@@ -71,6 +70,15 @@ def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
     y = [rho_k (n_gas), theta_k (n_surf)]; cfg = {'T': K, 'Asv': 1/m}.
     ``sm`` is a SurfaceMechanism; ``gm`` adds gas-phase chemistry on top
     (the reference's gas+surf mode, /root/reference/src/BatchReactor.jl:368-370).
+
+    The reference's mole-frac/pressure round-trip (:334-353) is an
+    algebraic identity in this state vector — both kinetics kernels consume
+    concentrations, and x_k p/(RT) reduces exactly to rho_k/M_k — so no
+    lane-local reduction (rho sum, x normalization, p) ever reaches the
+    compiled program: the coupled RHS is the gas RHS plus the surface
+    kernel plus a concat, the structure the TPU backend compiles
+    (COMPILE_PROBE.json s1; the round-trip composition was a prime suspect
+    in the round-4 coupled compile-wall bisect).
     """
     ng = len(thermo.species) if gm is None else gm.n_species
 
@@ -78,19 +86,16 @@ def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
         T, Asv = cfg["T"], cfg["Asv"]
         rho_k = y[:ng]
         theta = y[ng:]
-        rho = jnp.sum(rho_k)
-        mass_fracs = rho_k / rho
-        mole_fracs = mass_to_mole(mass_fracs, thermo.molwt)
-        p = pressure(rho, mole_fracs, thermo.molwt, T)
-        sdot_gas, sdot_surf = surface_kinetics.production_rates(
-            T, p, mole_fracs, theta, sm
+        c_gas_cgs = rho_k / (thermo.molwt * 1e6)  # mol/cm^3
+        sdot_gas, sdot_surf = surface_kinetics.production_rates_c(
+            T, c_gas_cgs, theta, sm
         )
         sdot_gas = sdot_gas * Asv
         if asv_quirk:
             sdot_surf = sdot_surf * Asv  # reference :345 scales coverages too
         dy_gas = sdot_gas * thermo.molwt
         if gm is not None:
-            conc = mole_fracs * p / (R * T)
+            conc = rho_k / thermo.molwt  # mol/m^3
             wdot = gas_kinetics.production_rates(T, conc, gm, thermo, kc_compat)
             dy_gas = dy_gas + wdot * thermo.molwt
         # Gamma stored in mol/cm^2 like the reference's site density
@@ -101,7 +106,8 @@ def make_surface_rhs(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
     return rhs
 
 
-def make_surface_jac(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
+def make_surface_jac(sm, thermo, gm=None, asv_quirk=True, kc_compat=False,
+                     return_blocks=False, fence_blocks=None):
     """Analytic Jacobian companion to :func:`make_surface_rhs`.
 
     ``jac(t, y, cfg) -> (S, S)`` over the full state y = [rho_k, theta_k].
@@ -121,20 +127,32 @@ def make_surface_jac(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
     roundoff (tests/test_surface.py) at a fraction of its n-forward-pass
     cost — this matrix is the Newton iteration matrix of every implicit
     step on the gas+surf flagship workload.
+
+    ``return_blocks=True`` returns the four blocks ``(J_gg, J_gt, J_tg,
+    J_tt)`` without ever building the concatenated matrix — the compile-
+    wall bisect needs a program that truly lacks the ``jnp.block`` op
+    (slicing the blocks back out of the full matrix leaves the concat in
+    the traced program and only differs if XLA's slice-of-concatenate
+    simplification fires).  ``fence_blocks`` wraps the four blocks in
+    ``jax.lax.optimization_barrier`` before assembly so XLA's fusion
+    search cannot chase producers across the assembly boundary —
+    numerically the identity.  ``None`` consults the ``BR_JAC_BARRIER``
+    env var ONCE per process (the decision is baked into each jit trace,
+    so a post-trace env toggle would otherwise be silently ignored).
     """
     ng = len(thermo.species) if gm is None else gm.n_species
     molwt = thermo.molwt
+    if fence_blocks is None:
+        fence_blocks = os.environ.get("BR_JAC_BARRIER") == "1"
 
     def jac(t, y, cfg):
         T, Asv = cfg["T"], cfg["Asv"]
         rho_k = y[:ng]
         theta = y[ng:]
-        rho = jnp.sum(rho_k)
-        mole_fracs = mass_to_mole(rho_k / rho, molwt)
-        p = pressure(rho, mole_fracs, molwt, T)
+        c_gas_cgs = rho_k / (molwt * 1e6)  # mol/cm^3 (same identity as rhs)
         _, _, (dg_dcg, dg_dth, ds_dcg, ds_dth) = (
-            surface_kinetics.production_rates_and_jac(
-                T, p, mole_fracs, theta, sm))
+            surface_kinetics.production_rates_and_jac_c(
+                T, c_gas_cgs, theta, sm))
         dcg = 1e-6 / molwt                      # d c_gas_cgs_b / d rho_b
         quirk = Asv if asv_quirk else 1.0
         coef = quirk * sm.site_coordination / (sm.site_density * 1e4)
@@ -147,15 +165,13 @@ def make_surface_jac(sm, thermo, gm=None, asv_quirk=True, kc_compat=False):
             _, dwdot = gas_kinetics.production_rates_and_jac(
                 T, conc, gm, thermo, kc_compat)
             J_gg = J_gg + dwdot * (molwt[:, None] / molwt[None, :])
-        if os.environ.get("BR_JAC_BARRIER") == "1":
-            # compile-wall escape hatch under probe (scripts/
-            # coupled_jac_bisect.py): fence the four blocks so XLA's fusion
-            # search cannot chase producers across the assembly boundary —
-            # numerically the identity
+        if fence_blocks:
             import jax
 
             J_gg, J_gt, J_tg, J_tt = jax.lax.optimization_barrier(
                 (J_gg, J_gt, J_tg, J_tt))
+        if return_blocks:
+            return J_gg, J_gt, J_tg, J_tt
         return jnp.block([[J_gg, J_gt], [J_tg, J_tt]])
 
     return jac

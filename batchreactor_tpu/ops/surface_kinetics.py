@@ -44,7 +44,11 @@ def rate_constants(T, theta, sm, with_grad=False):
     s_raw = sm.stick_s0 * jnp.exp(jnp.clip(log_arg, -_EXP_MAX, _EXP_MAX))
     denom = 1.0 - s_raw / 2.0
     s_eff = jnp.where(sm.mwc > 0, s_raw / denom, s_raw)
-    flux = jnp.sqrt(_R_CGS * T / (2.0 * _PI * sm.stick_molwt))
+    # sqrt(T) * sqrt(const): the T-independent factor carries no batch dim
+    # under vmap, so the per-lane cost is ONE scalar f64 sqrt instead of an
+    # (R,)-row of them (f64 sqrt is emulated on TPU); <=2 ulp from the
+    # fused form
+    flux = jnp.sqrt(T) * jnp.sqrt(_R_CGS / (2.0 * _PI * sm.stick_molwt))
     k = jnp.where(sm.stick > 0, s_eff * flux, k_arr)
     if not with_grad:
         return k
@@ -57,9 +61,9 @@ def rate_constants(T, theta, sm, with_grad=False):
     return k, dk_dEa[:, None] * sm.cov_eps
 
 
-def reaction_rates(T, p, mole_fracs, theta, sm):
-    """Rate of progress per reaction (R,), mol/cm^2/s."""
-    c_gas = mole_fracs * p / (R * T) * 1e-6           # mol/cm^3
+def reaction_rates_c(T, c_gas, theta, sm):
+    """Rate of progress per reaction (R,), mol/cm^2/s, from cgs gas
+    concentrations c_gas [mol/cm^3] directly."""
     c_surf = theta * sm.site_density / sm.site_coordination  # mol/cm^2
     k = rate_constants(T, theta, sm)
     gas_part = _stoich_prod(c_gas, sm.expo_gas, sm.int_expo)
@@ -70,12 +74,31 @@ def reaction_rates(T, p, mole_fracs, theta, sm):
     return k * gas_part * surf_part
 
 
-def production_rates(T, p, mole_fracs, theta, sm):
-    """(sdot_gas (Sg,), sdot_surf (Ss,)) in SI mol/m^2/s."""
-    q = reaction_rates(T, p, mole_fracs, theta, sm)  # mol/cm^2/s
+def reaction_rates(T, p, mole_fracs, theta, sm):
+    """Rate of progress per reaction (R,), mol/cm^2/s."""
+    return reaction_rates_c(T, mole_fracs * p / (R * T) * 1e-6, theta, sm)
+
+
+def production_rates_c(T, c_gas, theta, sm):
+    """(sdot_gas (Sg,), sdot_surf (Ss,)) in SI mol/m^2/s from cgs gas
+    concentrations directly.
+
+    The reactor hot loop (ops/rhs.make_surface_rhs) enters HERE: in the
+    batch-reactor state the mole-fraction/pressure round-trip reduces
+    algebraically to c_gas_k = rho_k / (M_k 1e6), so the lane-local
+    reductions (rho sum, x normalization, p) the (T, p, x) form implies
+    never reach the compiled program — the coupled RHS is then exactly the
+    gas RHS plus this kernel plus a concat, the structure the TPU backend
+    is proven to compile (COMPILE_PROBE.json s1; PERF.md round-5)."""
+    q = reaction_rates_c(T, c_gas, theta, sm)        # mol/cm^2/s
     sdot_gas = (sm.nu_r_gas - sm.nu_f_gas).T @ q * 1e4
     sdot_surf = (sm.nu_r_surf - sm.nu_f_surf).T @ q * 1e4
     return sdot_gas, sdot_surf
+
+
+def production_rates(T, p, mole_fracs, theta, sm):
+    """(sdot_gas (Sg,), sdot_surf (Ss,)) in SI mol/m^2/s."""
+    return production_rates_c(T, mole_fracs * p / (R * T) * 1e-6, theta, sm)
 
 
 def production_rates_and_jac(T, p, mole_fracs, theta, sm):
@@ -102,7 +125,15 @@ def production_rates_and_jac(T, p, mole_fracs, theta, sm):
       dS_j/dtheta_k: stick rows use raw coverages; Arrhenius rows go through
         surface concentrations c_surf = theta Gamma/sigma.
     """
-    c_gas = mole_fracs * p / (R * T) * 1e-6                  # mol/cm^3
+    return production_rates_and_jac_c(
+        T, mole_fracs * p / (R * T) * 1e-6, theta, sm)
+
+
+def production_rates_and_jac_c(T, c_gas, theta, sm):
+    """:func:`production_rates_and_jac` from cgs gas concentrations
+    directly — the reactor hot-loop entry (see
+    :func:`production_rates_c` for why the (T, p, x) round-trip stays out
+    of the compiled program)."""
     gamma_sig = sm.site_density / sm.site_coordination        # (Ss,)
     c_surf = theta * gamma_sig                                # mol/cm^2
 

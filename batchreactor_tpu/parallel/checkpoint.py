@@ -288,6 +288,20 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     # stderr printers the scripts use; wrap with a lock if yours isn't).
     executor = _futures.ThreadPoolExecutor(max_workers=1)
 
+    # the future whose own exception became the primary (propagating) one —
+    # the unwind loop skips it so the operator isn't shown the same failure
+    # twice.  An interrupt raised while *waiting* (KeyboardInterrupt is not
+    # an Exception) marks nothing, so a genuinely failed save still reports.
+    primary = []
+
+    def _await_last():
+        try:
+            pending[-1].result()
+        except Exception:
+            primary.append(pending[-1])
+            raise
+        pending.pop()
+
     def _save_async(i, path, res, chunk_cfgs):
         def job():
             t_c = _time.perf_counter()
@@ -299,8 +313,7 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
             # peek-then-pop: if an interrupt lands while blocked here, the
             # future stays in ``pending`` so the unwind loop below can still
             # report its failure
-            pending[-1].result()
-            pending.pop()
+            _await_last()
         pending.append(executor.submit(job))
 
     try:
@@ -329,14 +342,15 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
         # durability barrier: a failed/unfinished save must fail the sweep
         # call, not surface later as a missing chunk on resume
         while pending:
-            pending[-1].result()
-            pending.pop()
+            _await_last()
     finally:
         executor.shutdown(wait=True)
         # exceptional unwind (solve error, KeyboardInterrupt): don't let a
         # concurrent save failure vanish behind the primary exception —
         # log it so the operator sees e.g. the full disk before retrying
         for fut in pending:
+            if fut in primary:
+                continue
             exc = fut.done() and fut.exception()
             if exc and chunk_log is not None:
                 chunk_log(f"[ckpt] WARNING: background save also failed "

@@ -69,7 +69,7 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
                    dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
                    observer=None, observer_init=None, jac_window=1,
-                   newton_tol=0.03, method="bdf"):
+                   newton_tol=0.03, method="bdf", freeze_precond=False):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
@@ -84,9 +84,12 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     a full recompile every call, minutes at GRI scale on TPU.
     """
     _check_method(method, newton_tol)
+    if freeze_precond and method != "bdf":
+        raise ValueError(
+            f"freeze_precond is a bdf-only knob; method={method!r}")
     jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
                             dt_min_factor, linsolve, jac, observer,
-                            jac_window, newton_tol, method)
+                            jac_window, newton_tol, method, freeze_precond)
     t0 = jnp.asarray(t0, dtype=y0s.dtype)
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     obs0 = observer_init if observer is not None else 0.0
@@ -117,7 +120,7 @@ def _check_method(method, newton_tol):
 @functools.lru_cache(maxsize=64)
 def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
                    linsolve, jac=None, observer=None, jac_window=1,
-                   newton_tol=0.03, method="bdf"):
+                   newton_tol=0.03, method="bdf", freeze_precond=False):
     """One compiled batched solve per (rhs, solver-settings) combination.
 
     Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
@@ -129,7 +132,9 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
 
     def one(y0, t0, t1, cfg, obs0):
         kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
-              if method == "sdirk" else {"jac_window": jac_window})
+              if method == "sdirk"
+              else {"jac_window": jac_window,
+                    "freeze_precond": freeze_precond})
         return _SOLVERS[method](
             rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
             n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
@@ -255,24 +260,30 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         saved = np.zeros((B,), dtype=np.int64)
     for seg in range(max_segments):
         res = jitted(bundle_arg, y, t, t1, cfgs, h, e, obs, sstate)
-        status = np.asarray(res.status)
+        # ONE host round-trip for every per-segment scalar vector the host
+        # loop reads: on tunneled accelerators each separate np.asarray is
+        # its own device->host RPC, and the per-segment chatter (not the
+        # solve) was a prime suspect for the northstar map-vs-rung gap
+        # (PERF.md round-4 addendum)
+        status, seg_acc, seg_rej, seg_t, seg_saved = jax.device_get(
+            (res.status, res.n_accepted, res.n_rejected, res.t,
+             res.n_saved))
         # only lanes still live this segment contribute step counts: parked
         # lanes re-enter as zero-span solves that burn one rejected attempt
         running = final_status == int(sdirk.RUNNING)
-        n_acc += np.where(running, np.asarray(res.n_accepted), 0)
-        n_rej += np.where(running, np.asarray(res.n_rejected), 0)
+        n_acc += np.where(running, seg_acc, 0)
+        n_rej += np.where(running, seg_rej, 0)
         if n_save:
             # drain this segment's device buffer into the host trajectory —
             # vectorized masked scatter, no per-lane Python loop, and the
             # (B, seg_save, S) transfer is skipped entirely for segments
             # that saved nothing (only the small n_saved vector moves)
-            seg_n = np.asarray(res.n_saved)
+            seg_n = seg_saved
             take = np.where(running, np.minimum(seg_n, int(n_save) - saved),
                             0)
             drained_ts = None
             if take.max() > 0:
-                seg_ts = np.asarray(res.ts)
-                seg_ys = np.asarray(res.ys)
+                seg_ts, seg_ys = jax.device_get((res.ts, res.ys))
                 col = np.arange(seg_ts.shape[1])
                 src = col[None, :] < take[:, None]           # (B, seg_save)
                 b_idx, c_idx = np.nonzero(src)
@@ -287,7 +298,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         # the reported t for a terminal lane is the t at the segment where it
         # first terminated (for DT_UNDERFLOW that is the failure time, same
         # as the unsegmented path reports) — not the t1 it gets parked at
-        final_t = np.where(newly_terminal, np.asarray(res.t), final_t)
+        final_t = np.where(newly_terminal, seg_t, final_t)
         if max_attempts is not None:
             # exact per-lane attempt budget (monolithic max_steps parity):
             # park still-running lanes whose budget is spent as MaxSteps
@@ -296,7 +307,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
             final_status = np.where(exhausted,
                                     int(sdirk.MAX_STEPS_REACHED),
                                     final_status)
-            final_t = np.where(exhausted, np.asarray(res.t), final_t)
+            final_t = np.where(exhausted, seg_t, final_t)
         parked = jnp.asarray(final_status != int(sdirk.RUNNING))
         t = jnp.where(parked, t1, res.t)
         y = res.y
@@ -328,7 +339,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         final_status[final_status == int(sdirk.RUNNING)] = int(
             sdirk.MAX_STEPS_REACHED)
     # lanes that never terminated (budget exhausted) report their current t
-    final_t = np.where(np.isnan(final_t), np.asarray(res.t), final_t)
+    final_t = np.where(np.isnan(final_t), seg_t, final_t)
 
     if n_save:
         ts_out = jnp.asarray(all_ts, dtype=y0s.dtype)

@@ -124,12 +124,15 @@ def solve(
     is accepted for sdirk interface compatibility and ignored (the BDF
     history carries its own memory).
 
-    ``jac_window=K`` (K > 1) evaluates the Jacobian once per K step
+    ``jac_window=K`` (K > 1) evaluates the Jacobian once per up-to-K step
     attempts (CVODE's quasi-constant iteration matrix; M and its inverse
-    stay c-correct every attempt).  Stale-J Newton converges to the same
-    corrector solution — only its rate degrades, gated by the displacement
-    test — but accept/reject patterns can shift at newton_tol scale, and
-    segmented == monolithic bit-exactness holds only for ``jac_window=1``.
+    stay c-correct every attempt).  A Newton convergence failure closes
+    the window early, so the retry at halved h opens a fresh window with a
+    fresh J — CVODE's convergence-triggered refresh.  Stale-J Newton
+    converges to the same corrector solution — only its rate degrades,
+    gated by the displacement test — but accept/reject patterns can shift
+    at newton_tol scale, and segmented == monolithic bit-exactness holds
+    only for ``jac_window=1``.
 
     ``freeze_precond=True`` (requires ``jac_window>1``) extends the window
     economy to the Newton linear algebra itself: M = I - c0 J and its
@@ -394,32 +397,34 @@ def solve(
                       jnp.where(out_of_steps, MAX_STEPS_REACHED, RUNNING))
         ).astype(jnp.int32)
         status2 = jnp.where(running, status2, status)
+        newton_failed = running & ~already & ~conv
         return (t_out, D_new, order_new, h_new, n_equal_new, status2,
-                n_acc2, n_rej2, ts2, ys2, n_saved2, obs)
+                n_acc2, n_rej2, ts2, ys2, n_saved2, obs), newton_failed
 
     def cond(carry):
         return carry[5] == RUNNING
 
     if jac_window == 1:
         def body(carry):
-            return step_once(carry, None)
+            return step_once(carry, None)[0]
     else:
         def body(carry):
             # one Jacobian (evaluated at the window-opening predictor)
-            # serves jac_window attempts; a lane that terminates mid-window
-            # idles for the remainder (step_once's running/hold gates keep
-            # its carry frozen).  Window phase resets at segment
+            # serves up to jac_window attempts; a lane that terminates
+            # mid-window idles for the remainder (step_once's running/hold
+            # gates keep its carry frozen).  Window phase resets at segment
             # boundaries, so segmented == monolithic bit-exactness holds
             # only for jac_window=1; step budgets may overshoot by up to
             # jac_window-1 attempts.
-            # Divergence from CVODE: a Newton convergence failure inside
-            # the window does NOT trigger an early J refresh — up to
-            # jac_window-1 attempts can reject at halved h on the same
-            # stale J before the window reopens.  Bounded (the error
-            # controller still gates acceptance) and tau-validated
-            # (PERF.md: <=2.6e-5 shift at jac_window=8), but n_rejected
-            # can inflate near stiffness transients relative to CVODE's
-            # convergence-triggered refresh.
+            # CVODE's convergence-triggered refresh: a Newton convergence
+            # failure CLOSES the window early (the while_loop below), so
+            # the next attempt reopens with a fresh J — and, under
+            # freeze_precond, a fresh M — at the halved h.  At most ONE
+            # attempt per window rejects on a stale J (CVODE re-setups
+            # proactively at |c/c0 - 1| > ~0.3; ours is reactive-on-
+            # failure, which the displacement test makes equivalent at
+            # tau level).  vmap-compatible: an early-closed lane idles
+            # masked inside the window loop while siblings finish.
             t, D, order, h = carry[0], carry[1], carry[2], carry[3]
             y_pred = _masked_row_sum(D, jnp.ones((_ROWS,), y0.dtype), order)
             J = jac(t + h, y_pred)
@@ -427,14 +432,29 @@ def solve(
                 # build the Newton solver once per window at the opening
                 # c0 = h/gamma_q; attempts inside the window rescale by the
                 # cj-ratio factor instead of re-inverting (CVODE's setup
-                # economy)
+                # economy).  In-window c/c0 drift comes from accepted-step
+                # rescales (factor in [0.2, 10]) and is self-healing: if
+                # the drifted preconditioner stalls Newton, the failure
+                # closes the window and the next open rebuilds M at c.
                 c0 = h / jnp.asarray(_GAMMA)[order]
                 solve0 = make_solve_m(eye - c0 * J, linsolve, y0.dtype)
                 pre = (solve0, c0)
             else:
                 pre = None
-            return lax.fori_loop(0, jac_window,
-                                 lambda _, c: step_once(c, J, pre), carry)
+
+            def win_cond(s):
+                i, nf, c = s
+                return (i < jac_window) & ~nf & (c[5] == RUNNING)
+
+            def win_body(s):
+                i, _, c = s
+                c2, nf = step_once(c, J, pre)
+                return (i + 1, nf, c2)
+
+            _, _, out = lax.while_loop(
+                win_cond, win_body,
+                (jnp.asarray(0, dtype=jnp.int32), jnp.asarray(False), carry))
+            return out
 
     zero = jnp.asarray(0, dtype=jnp.int32)
     init = (t0, D_init, order_init, h_init, nequal_init,

@@ -1,23 +1,27 @@
-"""One-shot orchestrator for a healthy-chip window (round-4 deliverables).
+"""One-shot orchestrator for a healthy-chip window (round-5 ordering).
 
 The tunneled chip wedges for hours at a time (PERF.md), so when it IS
 healthy every deliverable must run in one supervised pass, banking results
-incrementally.  Steps, in priority order (each its own subprocess with a
-SIGTERM-first timeout; a mid-session wedge stops the ladder but keeps
-everything already banked):
+incrementally.  ROUND-4 LESSON (VERDICT r4 weak #1): compile probes are
+the wedge vector — a SIGTERM'd mid-compile axon client wedged the tunnel
+at step 2 of 8 and sacrificed the other six deliverables, and every probe
+stage after the first timeout measured a wedged chip, not the program.
+So round 5 runs strictly safest-first, re-probes after EVERY step, and
+puts the wedge-prone compile work DEAD LAST:
 
   1. bench      — live rung ladder (bench.py banks each healthy rung)
-  2. compile    — coupled compile-wall localization ladder
-                  (scripts/coupled_compile_probe.py -> COMPILE_PROBE.json)
-  3. coupled    — coupled gas+surf TPU throughput (scripts/coupled_probe.py
-                  -> COUPLED_TPU.json) with the Jacobian mode the ladder
-                  proved: analytic (s5 ok) > remat at jw=1 (s7 ok) >
-                  jacfwd (s4 ok) > skipped (nothing compiles)
-  4. northstar  — 4096-lane map, chunk-512 instrumented + chunk-4096 A/B
-  5. smoke      — on-chip pytest tier (scripts/tpu_smoke.py)
-  6. trace      — device trace of a bench segment (scripts/trace_capture.py)
-  7. invbudget  — amortized Newton-linear-algebra construction budget
-                  (scripts/inv_budget.py -> INV_BUDGET.json)
+  2. northstar  — 4096-lane map, chunk-512 instrumented + chunk-4096 A/B
+  3. smoke      — on-chip pytest tier (scripts/tpu_smoke.py)
+  4. trace      — device trace of a bench segment (scripts/trace_capture.py)
+  5. invbudget  — amortized Newton-linear-algebra construction budget
+  6. coupled    — the PRODUCT attempt (scripts/coupled_probe.py ->
+                  COUPLED_TPU.json): analytic J on the round-5 round-trip-
+                  free RHS structure; on timeout, one retry at XLA
+                  exec_time_optimization_effort=-1.0 (probe between)
+  7. compile    — diagnostic localization ladder, ONLY reached if the
+                  chip is still healthy; aborts at the first timed-out
+                  stage (later stages would measure the wedge, not the
+                  program)
 
 Usage (ALWAYS as a background task):
   python scripts/chip_session.py                 # all steps
@@ -70,8 +74,8 @@ def probe():
 
 
 def main():
-    known = ["bench", "compile", "coupled", "northstar", "smoke", "trace",
-             "invbudget"]
+    known = ["bench", "northstar", "smoke", "trace", "invbudget",
+             "coupled", "compile"]
     if os.environ.get("CS_STEPS"):
         steps = [s.strip() for s in os.environ["CS_STEPS"].split(",")
                  if s.strip()]
@@ -107,45 +111,6 @@ def main():
         if not probe():
             record({"label": "abort", "note": "chip wedged after bench"})
             return 1
-    if "compile" in steps:
-        record(run([py, "scripts/coupled_compile_probe.py"], 6000,
-                   {"CCP_TIMEOUT": "600"}, "coupled-compile-ladder"))
-        if not probe():
-            record({"label": "abort", "note": "chip wedged after compile"})
-            return 1
-    if "coupled" in steps:
-        # choose the Jacobian mode the compile ladder proved out; with no
-        # evidence (ladder skipped/failed) prefer the jacfwd fallback —
-        # the analytic mode is the KNOWN compile wall (PERF.md), so
-        # defaulting to it would burn the healthy-chip window re-failing
-        cp_jac, skip = "fwd", False
-        try:
-            with open(os.path.join(REPO, "COMPILE_PROBE.json")) as fh:
-                stages = {s["stage"]: s for s in json.load(fh)["stages"]}
-            if stages.get("s5_bdf_ana", {}).get("ok"):
-                cp_jac = "analytic"
-            elif stages.get("s7_bdf_remat", {}).get("ok"):
-                cp_jac = "remat"
-            elif not stages.get("s4_bdf_fwd", {}).get("ok") and stages:
-                skip = True  # nothing it can run compiles; don't burn time
-        except (OSError, KeyError, json.JSONDecodeError):
-            pass
-        if skip:
-            record({"label": "coupled-probe", "skipped":
-                    "no coupled variant compiled in COMPILE_PROBE.json"})
-        else:
-            env = {"CP_JAC": cp_jac,
-                   "CP_OUT": os.path.join(REPO, "COUPLED_TPU.json")}
-            if cp_jac == "remat":
-                # the ladder validated remat at jac_window=1 (stage s7);
-                # run the exact program structure that compiled, not an
-                # unproven remat+jw8 variant
-                env["CP_JW"] = "1"
-            record(run([py, "scripts/coupled_probe.py"], 5400, env,
-                       f"coupled-probe-{cp_jac}"))
-        if not probe():
-            record({"label": "abort", "note": "chip wedged after coupled"})
-            return 1
     if "northstar" in steps:
         record(run([py, "scripts/northstar_sweep.py"], 3600,
                    {"NORTHSTAR_CKPT": "/tmp/ns_chip512",
@@ -171,6 +136,41 @@ def main():
     if "invbudget" in steps:
         record(run([py, "scripts/inv_budget.py"], 1500, {},
                    "inv-budget"))
+    if "coupled" in steps:
+        # PRODUCT attempt first (VERDICT r4: the diagnostic ladder wedged
+        # the chip before the product ever ran).  The round-5 RHS structure
+        # has no mole-frac/pressure round-trip — the prime structural
+        # suspect — so analytic J at the bench-protocol jw=8 is the right
+        # first try; the 3000 s budget covers the round-3 observed 30-58
+        # min walls becoming a finite-but-slow compile.
+        rec = run([py, "scripts/coupled_probe.py"], 3000,
+                  {"CP_JAC": "analytic",
+                   "CP_OUT": os.path.join(REPO, "COUPLED_TPU.json")},
+                  "coupled-product-analytic")
+        record(rec)
+        if not probe():
+            record({"label": "abort", "note": "chip wedged after coupled"})
+            return 1
+        if rec["rc"] != 0 or rec["timed_out"]:
+            # one retry with the global XLA effort knob lowered — skips the
+            # expensive late optimization passes
+            rec = run([py, "scripts/coupled_probe.py"], 3000,
+                      {"CP_JAC": "analytic", "CP_EFFORT": "-1.0",
+                       "CP_OUT": os.path.join(REPO, "COUPLED_TPU.json")},
+                      "coupled-product-loweffort")
+            record(rec)
+            if not probe():
+                record({"label": "abort",
+                        "note": "chip wedged after coupled retry"})
+                return 1
+    if "compile" in steps:
+        # dead last, diagnostic only: CCP_ABORT_ON_TIMEOUT stops the ladder
+        # at the first timed-out stage — every later stage would measure
+        # the wedge the timeout likely caused, not the program (that is
+        # exactly how round 4 burned six deliverables)
+        record(run([py, "scripts/coupled_compile_probe.py"], 4800,
+                   {"CCP_TIMEOUT": "420", "CCP_ABORT_ON_TIMEOUT": "1"},
+                   "coupled-compile-ladder"))
     record({"label": "done", "chip_healthy_at_end": probe()})
     return 0
 

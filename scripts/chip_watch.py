@@ -1,0 +1,68 @@
+"""Wait for the tunneled TPU to come back, then exit 0.
+
+The chip wedges for hours (PERF.md); this watcher lets an operator start
+on-chip work the moment it returns instead of polling by hand.  Every
+``CW_INTERVAL`` seconds it runs a tiny device probe in a subprocess with a
+SIGTERM-first timeout (a SIGKILLed axon client can deepen a tunnel wedge —
+round-2/3 postmortems), appending one status line per attempt to stderr.
+Exits 0 the first time the probe succeeds; exits 1 when ``CW_MAX_S`` is
+exhausted without a healthy probe.
+
+Usage (background task):  python scripts/chip_watch.py
+  CW_INTERVAL=600 CW_MAX_S=39600 CW_PROBE_TIMEOUT=120 ...
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+PROBE = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((256, 256)) @ jnp.ones((256, 256));"
+    "jax.block_until_ready(x);"
+    "print('healthy', jax.default_backend(), len(jax.devices()))"
+)
+
+
+def probe_once(timeout):
+    proc = subprocess.Popen([sys.executable, "-c", PROBE],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode == 0 and "healthy" in (out or ""), out
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return False, "timeout"
+
+
+def main():
+    interval = int(os.environ.get("CW_INTERVAL", "600"))
+    max_s = int(os.environ.get("CW_MAX_S", "39600"))
+    probe_timeout = int(os.environ.get("CW_PROBE_TIMEOUT", "120"))
+    t0 = time.time()
+    attempt = 0
+    while time.time() - t0 < max_s:
+        attempt += 1
+        ok, out = probe_once(probe_timeout)
+        stamp = time.strftime("%H:%M:%S")
+        print(f"[{stamp}] attempt {attempt}: "
+              f"{'HEALTHY' if ok else 'wedged'} ({(out or '').strip()})",
+              file=sys.stderr, flush=True)
+        if ok:
+            print("chip healthy")
+            return 0
+        time.sleep(interval)
+    print("gave up: chip never returned", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,13 @@ def main():
         if stage == "s0_probe" and (timed_out or proc.returncode != 0):
             print("chip unreachable; aborting ladder", file=sys.stderr)
             break
+        if timed_out and os.environ.get("CCP_ABORT_ON_TIMEOUT") == "1":
+            # round-4 lesson: the SIGTERM'd mid-compile client likely
+            # wedged the tunnel, so every later stage would measure the
+            # wedge, not the program — stop and leave the chip alone
+            print("stage timed out; aborting ladder "
+                  "(CCP_ABORT_ON_TIMEOUT)", file=sys.stderr)
+            break
     print(json.dumps({"stages": results}))
 
 

@@ -144,17 +144,11 @@ def _stage_main(stage):
                    "j6_barrier", "j7_low_effort"):
         if stage == "j6_barrier":
             os.environ["BR_JAC_BARRIER"] = "1"
-        block = stage != "j2_no_block"
-        jacf = make_surface_jac(sm, th, gm=gm)
-        if not block:
-            # reproduce the assembly minus jnp.block: call the kernel's
-            # pieces by differentiating the blocks out of the full matrix
-            full = jacf
-
-            def jacf(t, y, c, _full=full, _ng=ng):
-                J = _full(t, y, c)
-                return (J[:_ng, :_ng], J[:_ng, _ng:],
-                        J[_ng:, :_ng], J[_ng:, _ng:])
+        # j2: the four blocks straight from the kernel — the traced program
+        # truly lacks the jnp.block concat (slicing it back out would leave
+        # the concat in the program; ADVICE r4)
+        jacf = make_surface_jac(sm, th, gm=gm,
+                                return_blocks=stage == "j2_no_block")
         if stage == "j4_single":
             f = jax.jit(jacf)
             out = f(0.0, y0s[0],

@@ -35,6 +35,14 @@ if not os.path.isdir(LIB):
 
 def main():
     import jax
+
+    if os.environ.get("CP_EFFORT"):
+        # global XLA scheduling-effort knob (applies to every jit in this
+        # process): -1.0 skips the expensive late optimization passes — an
+        # escape hatch for the coupled compile wall worth a try before the
+        # structural fallbacks (fwd/remat Jacobians)
+        jax.config.update("jax_exec_time_optimization_effort",
+                          float(os.environ["CP_EFFORT"]))
     import jax.numpy as jnp
     import numpy as np
 
@@ -125,6 +133,7 @@ def main():
                     f"1073-1273 K, rtol 1e-6 atol 1e-10",
         "method": "bdf", "B": B, "analytic_jac": analytic,
         "jac_window": jw,
+        "xla_effort": float(os.environ.get("CP_EFFORT", "0")),
         "wall_s": round(wall, 2), "cond_per_s": round(B / wall, 3),
         "warm_s": round(warm, 1),
         "device": jax.default_backend(),

@@ -257,6 +257,57 @@ def test_gri_jac_window_matches_fresh_jacobian(gri):
     np.testing.assert_allclose(taus[3], taus[1], rtol=1e-3)
 
 
+def test_gri_freeze_precond_matches_fresh(gri):
+    """freeze_precond (window-frozen M with CVODE's cj-ratio rescale, on
+    top of jac_window=8): same ignition delays as the per-attempt-exact
+    jw=1 run, statuses clean, and step counts comparable — the frozen
+    preconditioner only changes the quasi-Newton convergence RATE, and an
+    in-window stall closes the window (fresh J and M at the retry h), so
+    drift cannot cascade for the remainder of the window."""
+    gm, th = gri
+    sp, T_grid, y0s = _gri_sweep_inputs(gm, th, 4)
+    rhs, jacf = make_gas_rhs(gm, th), make_gas_jac(gm, th)
+    obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
+    runs = {}
+    for label, kw in (("fresh", dict(jac_window=1)),
+                      ("frozen", dict(jac_window=8, freeze_precond=True))):
+        r = ensemble_solve(rhs, y0s, 0.0, 8e-4, {"T": T_grid}, method="bdf",
+                           rtol=1e-6, atol=1e-10, jac=jacf, observer=obs,
+                           observer_init=obs0, **kw)
+        assert np.all(np.asarray(r.status) == SUCCESS), label
+        runs[label] = r
+    np.testing.assert_allclose(np.asarray(runs["frozen"].observed["tau"]),
+                               np.asarray(runs["fresh"].observed["tau"]),
+                               rtol=1e-3)
+    acc_f = np.asarray(runs["fresh"].n_accepted, dtype=float)
+    acc_z = np.asarray(runs["frozen"].n_accepted, dtype=float)
+    assert np.all(acc_z <= 1.5 * acc_f + 10)
+    # the early-close refresh keeps stale-J/M rejection inflation bounded
+    # across the ignition front (the stiffness transient of this sweep)
+    rej_f = np.asarray(runs["fresh"].n_rejected, dtype=float)
+    rej_z = np.asarray(runs["frozen"].n_rejected, dtype=float)
+    assert np.all(rej_z <= rej_f + 0.25 * acc_f + 10)
+
+
+def test_gri_jac_window_reject_parity_at_ignition_front(gri):
+    """Newton-failure-triggered early window close: jac_window=8 must not
+    inflate rejected attempts across the ignition front relative to the
+    fresh-J run (CVODE's convergence-triggered refresh semantics)."""
+    gm, th = gri
+    sp, T_grid, y0s = _gri_sweep_inputs(gm, th, 6)
+    rhs, jacf = make_gas_rhs(gm, th), make_gas_jac(gm, th)
+    runs = {}
+    for jw in (1, 8):
+        r = ensemble_solve(rhs, y0s, 0.0, 8e-4, {"T": T_grid}, method="bdf",
+                           rtol=1e-6, atol=1e-10, jac=jacf, jac_window=jw)
+        assert np.all(np.asarray(r.status) == SUCCESS), jw
+        runs[jw] = r
+    rej1 = np.asarray(runs[1].n_rejected, dtype=float)
+    rej8 = np.asarray(runs[8].n_rejected, dtype=float)
+    acc1 = np.asarray(runs[1].n_accepted, dtype=float)
+    assert np.all(rej8 <= rej1 + 0.25 * acc1 + 10), (rej1, rej8)
+
+
 def test_forward_sensitivity_through_bdf():
     """jax.jacfwd through bdf.solve: d(final state)/d(rate param) finite and
     matching a central finite difference — the sens=True capability on the
