@@ -1,0 +1,28 @@
+"""brlint: JAX tracer-safety and recompilation-hazard static analysis.
+
+Two tiers enforce the purity contract the whole reproduction rests on
+(PAPER.md; README architecture): the kinetics RHS and the BDF/SDIRK
+solvers must stay pure, vmap-able, fixed-shape JAX programs.
+
+* **Tier A** (:mod:`.rules_ast`) — AST rules over the source tree.  A
+  visitor framework (:mod:`.core`) classifies every function by how it
+  reaches the device (:mod:`.reachability`) and runs the registered
+  rules with per-line ``# brlint: disable=RULE`` suppressions and a
+  JSON baseline for pre-existing debt.
+* **Tier B** (:mod:`.jaxpr_audit`) — traces the four RHS chemistry
+  modes and both solvers' step programs on the tiny vendored fixtures
+  and walks the jaxprs for host callbacks, host transfers, and dtype
+  leaks the AST cannot see.
+
+CLI: ``python scripts/brlint.py batchreactor_tpu/`` (see
+docs/development.md for the rule catalogue and suppression policy).
+"""
+
+from .core import (Finding, Baseline, all_rules, lint_file, lint_paths,
+                   load_suppressions)
+from . import rules_ast  # noqa: F401,E402  (registers the tier-A rules:
+#                          without this import the registry is empty and
+#                          lint_paths would vacuously scan clean)
+
+__all__ = ["Finding", "Baseline", "all_rules", "lint_file", "lint_paths",
+           "load_suppressions"]

@@ -1,0 +1,124 @@
+"""brlint command line: package scan (tier A) + jaxpr audit (tier B).
+
+Exit codes: 0 clean (or fully baselined), 1 findings, 2 usage error.
+
+Examples (docs/development.md):
+  python scripts/brlint.py batchreactor_tpu/
+  python scripts/brlint.py batchreactor_tpu/ --baseline brlint_baseline.json
+  python scripts/brlint.py --jaxpr                  # tier B on fixtures
+  python scripts/brlint.py batchreactor_tpu/ --json
+  python scripts/brlint.py batchreactor_tpu/ --write-baseline debt.json
+"""
+
+import argparse
+import json
+import sys
+
+from .core import Baseline, all_rules, lint_paths
+from . import rules_ast  # noqa: F401  (registers the tier-A rules)
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="brlint",
+        description="JAX tracer-safety / recompilation-hazard linter for "
+                    "batchreactor_tpu (see docs/development.md)")
+    p.add_argument("paths", nargs="*", help="files or directories to scan")
+    p.add_argument("--select", help="comma-separated rule names to run "
+                                    "(default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="tracked-debt file: only findings absent from it "
+                        "fail the scan; stale entries are reported")
+    p.add_argument("--write-baseline", metavar="FILE",
+                   help="record current findings as the new baseline and "
+                        "exit 0")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="run the tier-B jaxpr audit (traces the four RHS "
+                        "modes and both solver step programs on the "
+                        "vendored fixtures; needs a working jax backend)")
+    p.add_argument("--fixtures", default=None,
+                   help="fixture directory for --jaxpr (default: "
+                        "tests/fixtures next to the package)")
+    return p
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name:24s} {rule.rule_doc}")
+        return 0
+
+    if not args.paths and not args.jaxpr:
+        print("brlint: nothing to do (pass paths and/or --jaxpr)",
+              file=sys.stderr)
+        return 2
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = select - set(all_rules())
+        if unknown:
+            print(f"brlint: unknown rules {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    findings, n_suppressed, sources = [], 0, {}
+    if args.paths:
+        findings, n_suppressed, sources = lint_paths(args.paths, select)
+
+    if args.write_baseline:
+        if args.jaxpr:
+            # a combined run would return before the audit and leave the
+            # user believing the hot path was traced clean; baselines are
+            # a tier-A (source-fingerprint) concept anyway
+            print("brlint: --write-baseline cannot be combined with "
+                  "--jaxpr (baselines track tier-A source findings only)",
+                  file=sys.stderr)
+            return 2
+        Baseline.from_findings(findings, sources).save(args.write_baseline)
+        print(f"brlint: wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    stale = []
+    baselined = []
+    if args.baseline:
+        bl = Baseline.load(args.baseline)
+        findings, baselined, stale = bl.apply(findings, sources)
+
+    jaxpr_findings = []
+    if args.jaxpr:
+        from .jaxpr_audit import run_audit
+
+        jaxpr_findings = run_audit(fixtures_dir=args.fixtures)
+        findings = findings + jaxpr_findings
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "baselined": len(baselined),
+            "suppressed": n_suppressed,
+            "stale_baseline": stale,
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        for fp in stale:
+            print(f"brlint: stale baseline entry {fp} (finding no longer "
+                  f"produced — remove it from the baseline)")
+        tier_b = f", {len(jaxpr_findings)} from jaxpr audit" if args.jaxpr \
+            else ""
+        print(f"brlint: {len(findings)} finding(s){tier_b}, "
+              f"{len(baselined)} baselined, {n_suppressed} suppressed")
+
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

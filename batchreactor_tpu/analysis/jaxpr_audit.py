@@ -1,0 +1,183 @@
+"""brlint tier B: jaxpr audit of the four RHS modes and both solvers.
+
+The AST tier sees the *source*; this tier sees the *traced program* —
+the thing XLA actually compiles.  It builds the four chemistry modes
+(gas / surf / gas+surf / udf) and both solvers' step programs on the
+tiny vendored fixtures (tests/fixtures: h2o2.dat + therm.dat +
+h2oni.xml — small enough that every trace is sub-second on CPU) and
+walks each jaxpr, recursively through while/cond/scan sub-jaxprs, for
+three hazard classes the purity contract forbids in the hot loop:
+
+* **host callbacks** (``pure_callback`` / ``io_callback`` /
+  ``debug_callback`` / ...): a Python round-trip per device step — the
+  one thing that single-handedly voids the 100x sweep headline.
+* **host transfers** (``device_put`` inside the traced program): a
+  traced operand was captured on the wrong device or re-staged
+  per-iteration.
+* **float-width conversions** in the RHS/Jacobian programs
+  (``convert_element_type`` between f32/f64): the kinetics kernels are
+  uniformly f64 under x64 — a width change means a constant or
+  intermediate silently dropped precision (the x64-emulation TPU paths
+  make this a 10x *cost* leak too, models/gas.py).  The check is
+  skipped when the f32 rate-exponential formulation is active
+  (``ops.gas_kinetics._exp32_enabled``) and never applied to solver
+  programs, whose mixed-precision Newton preconditioner converts by
+  design (solver/linalg.py).
+"""
+
+import os
+
+from .core import Finding
+
+_CALLBACK_MARKERS = ("callback", "outside_call", "host_local")
+_FLOAT_WIDTHS = {"float16", "bfloat16", "float32", "float64"}
+
+
+def _fixture_dir(fixtures_dir=None):
+    if fixtures_dir:
+        return fixtures_dir
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "tests", "fixtures")
+
+
+def _iter_eqns(jaxpr, in_loop=False):
+    """(eqn, in_loop) for every equation of a (closed) jaxpr, descending
+    into sub-jaxprs (while_loop body/cond, scan, cond branches, pjit,
+    custom_jvp...).  ``in_loop`` marks equations that execute once per
+    device iteration — the scope where a host transfer actually hurts
+    (one-time operand staging in the outer program is benign)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn, in_loop
+        child_in_loop = in_loop or eqn.primitive.name in ("while", "scan")
+        for val in eqn.params.values():
+            for sub in _sub_jaxprs(val):
+                yield from _iter_eqns(sub, child_in_loop)
+
+
+def _sub_jaxprs(val):
+    if hasattr(val, "eqns") or hasattr(val, "jaxpr"):
+        yield val
+    elif isinstance(val, (list, tuple)):
+        for v in val:
+            yield from _sub_jaxprs(v)
+
+
+def _audit_jaxpr(tag, jaxpr, check_dtype):
+    findings = []
+    for eqn, in_loop in _iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        if any(m in prim for m in _CALLBACK_MARKERS):
+            findings.append(Finding(
+                "jaxpr-host-callback", f"<jaxpr:{tag}>", 0, 0,
+                f"host callback primitive {prim!r} inside the traced "
+                f"program: a Python round-trip per device step"))
+        elif prim == "device_put" and in_loop:
+            findings.append(Finding(
+                "jaxpr-device-transfer", f"<jaxpr:{tag}>", 0, 0,
+                "device_put inside the traced loop body: an operand is "
+                "re-staged on device every iteration (hoist the "
+                "conversion out of the loop)"))
+        elif check_dtype and prim == "convert_element_type":
+            src = str(eqn.invars[0].aval.dtype)
+            dst = str(eqn.params.get("new_dtype", ""))
+            if (src in _FLOAT_WIDTHS and dst in _FLOAT_WIDTHS
+                    and src != dst):
+                findings.append(Finding(
+                    "jaxpr-dtype-leak", f"<jaxpr:{tag}>", 0, 0,
+                    f"float width change {src} -> {dst} in a kernel "
+                    f"program that should be uniformly f64 (x64 "
+                    f"emulation: silent precision or 10x cost leak)"))
+    return findings
+
+
+def _build_modes(fixtures):
+    """(tag, rhs, jac, y0, cfg) for the four chemistry modes on the tiny
+    fixtures.  Import here: tier A must not pay the jax import."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.gas import compile_gaschemistry
+    from ..models.surface import compile_mech
+    from ..models.thermo import create_thermo
+    from ..ops.rhs import (make_gas_jac, make_gas_rhs, make_surface_jac,
+                           make_surface_rhs, make_udf_rhs)
+    from ..utils.composition import density, mole_to_mass
+
+    gm = compile_gaschemistry(os.path.join(fixtures, "h2o2.dat"))
+    th = create_thermo(list(gm.species), os.path.join(fixtures, "therm.dat"))
+    sm = compile_mech(os.path.join(fixtures, "h2oni.xml"), th,
+                      list(gm.species))
+
+    T, p = 1100.0, 1e5
+    sp = list(gm.species)
+    x = np.zeros(len(sp))
+    x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = 0.3, 0.2, 0.5
+    x = jnp.asarray(x, dtype=jnp.float64)
+    rho = density(x, th.molwt, T, p)
+    y_gas = rho * mole_to_mass(x, th.molwt)
+    y_coupled = jnp.concatenate([y_gas, jnp.asarray(sm.ini_covg,
+                                                    dtype=jnp.float64)])
+    cfg = {"T": jnp.asarray(T, dtype=jnp.float64),
+           "Asv": jnp.asarray(1.0, dtype=jnp.float64)}
+
+    def udf(t, state):
+        # traceable toy source: first-order decay toward equal mole
+        # fractions — exercises the full UDF state plumbing
+        return (1.0 / len(state["molwt"]) - state["mole_frac"]) * 1e-3
+
+    modes = [
+        ("gas-rhs", make_gas_rhs(gm, th), make_gas_jac(gm, th),
+         y_gas, cfg),
+        ("surf-rhs", make_surface_rhs(sm, th),
+         make_surface_jac(sm, th), y_coupled, cfg),
+        ("coupled-rhs", make_surface_rhs(sm, th, gm=gm),
+         make_surface_jac(sm, th, gm=gm), y_coupled, cfg),
+        ("udf-rhs", make_udf_rhs(udf, th.molwt, species=th.species),
+         None, y_gas, cfg),
+    ]
+    return modes
+
+
+def run_audit(fixtures_dir=None):
+    """Trace and audit every mode + both solver step programs; returns a
+    list of :class:`~.core.Finding` (empty = the hot path is clean)."""
+    import jax
+
+    # the package __init__ enables x64 at import, but under the light CLI
+    # entry (scripts/brlint.py loads analysis through a namespace parent,
+    # never running that init) it must be pinned here — the kernels and
+    # the dtype-leak check are defined in f64 terms.  Idempotent when the
+    # real package imported first.
+    jax.config.update("jax_enable_x64", True)
+
+    from ..ops.gas_kinetics import _exp32_enabled
+    from ..solver import bdf, sdirk
+
+    fixtures = _fixture_dir(fixtures_dir)
+    check_dtype = not _exp32_enabled()
+    findings = []
+
+    modes = _build_modes(fixtures)
+    for tag, rhs, jac, y0, cfg in modes:
+        jaxpr = jax.make_jaxpr(rhs)(0.0, y0, cfg)
+        findings.extend(_audit_jaxpr(tag, jaxpr, check_dtype))
+        if jac is not None:
+            jjaxpr = jax.make_jaxpr(jac)(0.0, y0, cfg)
+            findings.extend(_audit_jaxpr(
+                tag.replace("-rhs", "-jac"), jjaxpr, check_dtype))
+
+    # both solvers' step programs, traced exactly as api._solve compiles
+    # them (the while_loop body IS the step program; sub-jaxpr descent
+    # covers it).  Gas mode, bounded steps: trace cost only.
+    tag_rhs, rhs, jac, y0, cfg = modes[0]
+    for sname, solver in (("bdf-step", bdf.solve), ("sdirk-step",
+                                                    sdirk.solve)):
+        def run(y0_, solver=solver):
+            return solver(rhs, y0_, 0.0, 1e-7, cfg, rtol=1e-6,
+                          atol=1e-10, max_steps=3, n_save=0, jac=jac).y
+
+        jaxpr = jax.make_jaxpr(run)(y0)
+        findings.extend(_audit_jaxpr(sname, jaxpr, check_dtype=False))
+    return findings

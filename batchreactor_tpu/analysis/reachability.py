@@ -1,0 +1,311 @@
+"""Device-reachability classification for brlint's AST rules.
+
+The tier-A rules all hinge on one question the AST alone does not
+answer: *which functions run under a JAX trace?*  This module answers
+it conservatively, with three device classes per function:
+
+* ``STRICT`` — every parameter is a tracer when the function runs:
+  closures handed to ``jax.jit``/``vmap``/``grad``/``lax.while_loop``/
+  ``scan``/``cond``/... at a call site, and closures returned by the
+  package's device-closure factories (``make_*`` / ``*_rhs`` /
+  ``*_jac`` / ``*observer*`` — the ops/rhs contract: the returned
+  callable is traced later by a solver or sweep).
+* ``JIT_ENTRY`` — decorated with ``jax.jit`` (directly or via
+  ``functools.partial(jax.jit, static_argnames=...)``): every
+  parameter is traced *except* the declared statics.
+* ``MIXED`` — reachable by direct call from device code (helpers like
+  the kinetics kernels): *some* arguments may be traced, but the AST
+  cannot tell which, so rules only act on locally-provable tracer
+  values (jnp/lax-derived expressions) inside these.
+
+Everything else is ``HOST``.  Resolution is module-local and
+name-based — deliberately: cross-module reachability would need real
+import resolution for marginal gain (the hot-path packages are scanned
+whole, so their helpers classify MIXED through their own call sites or
+the device-package scoping the rules add on top).
+"""
+
+import ast
+
+STRICT = "strict"
+JIT_ENTRY = "jit_entry"
+MIXED = "mixed"
+HOST = "host"
+
+# canonical dotted names whose callable arguments are traced; values are
+# the argument positions that receive functions ("*" = every positional)
+_TRACE_CONSUMERS = {
+    "jax.jit": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.jacfwd": (0,),
+    "jax.jacrev": (0,),
+    "jax.hessian": (0,),
+    "jax.linearize": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.custom_jvp": (0,),
+    "jax.custom_vjp": (0,),
+    "jax.make_jaxpr": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.scan": (0,),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": "*_from_1",
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.custom_root": "*",
+}
+
+def _is_factory_name(name):
+    return (name.startswith("make_") or name.endswith("_rhs")
+            or name.endswith("_jac") or "observer" in name)
+
+
+class _Aliases:
+    """import-table: local name -> canonical dotted path."""
+
+    def __init__(self, tree):
+        self.map = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.map[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mod = node.module
+                # jax-internal renames: jax.numpy etc. stay canonical
+                for a in node.names:
+                    self.map[a.asname or a.name] = f"{mod}.{a.name}"
+        # the idiomatic spellings this repo uses
+        self.map.setdefault("jnp", "jax.numpy")
+        self.map.setdefault("lax", "jax.lax")
+        self.map.setdefault("np", "numpy")
+
+    def resolve(self, node):
+        """Canonical dotted name of an expression like ``lax.scan`` /
+        ``jnp.asarray`` / ``jit``, or None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.map.get(node.id, node.id)
+        return ".".join([root] + list(reversed(parts)))
+
+
+class FunctionInfo:
+    def __init__(self, node, qualname, parent):
+        self.node = node
+        self.name = getattr(node, "name", "<lambda>")
+        self.qualname = qualname
+        self.parent = parent        # enclosing FunctionInfo or None
+        self.kind = HOST
+        self.static_params = set()  # JIT_ENTRY only
+        self.children = {}          # name -> FunctionInfo (nested defs)
+        self.calls = set()          # bare names called in the body
+
+    @property
+    def params(self):
+        a = self.node.args
+        names = [p.arg for p in
+                 list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    @property
+    def traced_params(self):
+        if self.kind == STRICT:
+            return set(self.params)
+        if self.kind == JIT_ENTRY:
+            return set(self.params) - self.static_params
+        return set()
+
+    def device_reachable(self):
+        return self.kind in (STRICT, JIT_ENTRY, MIXED)
+
+
+class ModuleIndex:
+    """Per-file function table with device classification.
+
+    Built once per :class:`~.core.FileContext`; rules iterate
+    ``functions`` (FunctionInfo, including lambdas) and use
+    ``aliases.resolve``.  The intra-function taint analysis lives with
+    the rules (:mod:`.rules_ast`), which need static-projection cutoffs
+    this index has no opinion on.
+    """
+
+    def __init__(self, tree, path=""):
+        self.tree = tree
+        self.path = path
+        self.aliases = _Aliases(tree)
+        self.functions = []          # all FunctionInfo, outer-first
+        self.by_node = {}
+        self._collect(tree, None, "")
+        self._collect_calls()
+        self._classify()
+
+    # -- collection --------------------------------------------------------
+    def _collect(self, node, parent, prefix):
+        """Register every function node (defs at any nesting depth and
+        lambdas), tracking the enclosing-function parent chain."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            name = getattr(node, "name", "<lambda>")
+            qual = f"{prefix}{name}" if prefix else name
+            info = FunctionInfo(node, qual, parent)
+            self.functions.append(info)
+            self.by_node[node] = info
+            if parent is not None and name != "<lambda>":
+                parent.children[name] = info
+            parent, prefix = info, qual + "."
+        for child in ast.iter_child_nodes(node):
+            self._collect(child, parent, prefix)
+
+    def _collect_calls(self):
+        """Record the bare names each function calls in its OWN body —
+        nested defs keep their calls to themselves (they have their own
+        FunctionInfo and their own reachability)."""
+        for info in self.functions:
+            body = info.node.body
+            stack = list(body) if isinstance(body, list) else [body]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+                    info.calls.add(n.func.id)
+                stack.extend(ast.iter_child_nodes(n))
+
+    # -- classification ----------------------------------------------------
+    def _jit_decoration(self, node):
+        """(is_jit, static_param_names) from a def's decorator list —
+        ``static_argnames`` taken verbatim, ``static_argnums`` mapped to
+        names through the def's positional parameter list."""
+        args = getattr(node, "args", None)
+        positional = ([p.arg for p in args.posonlyargs + args.args]
+                      if args is not None else [])
+        for dec in getattr(node, "decorator_list", []):
+            target, kwargs = dec, []
+            if isinstance(dec, ast.Call):
+                resolved = self.aliases.resolve(dec.func)
+                if resolved in ("functools.partial", "partial"):
+                    if not dec.args:
+                        continue
+                    target, kwargs = dec.args[0], dec.keywords
+                else:
+                    target, kwargs = dec.func, dec.keywords
+            resolved = self.aliases.resolve(target)
+            if resolved in ("jax.jit", "jit"):
+                statics = set()
+                for kw in kwargs:
+                    if kw.arg == "static_argnames":
+                        for el in ast.walk(kw.value):
+                            if (isinstance(el, ast.Constant)
+                                    and isinstance(el.value, str)):
+                                statics.add(el.value)
+                    elif kw.arg == "static_argnums":
+                        for el in ast.walk(kw.value):
+                            if (isinstance(el, ast.Constant)
+                                    and isinstance(el.value, int)
+                                    and 0 <= el.value < len(positional)):
+                                statics.add(positional[el.value])
+                return True, statics
+        return False, set()
+
+    def _mark_strict(self, func_expr, scope):
+        """Mark the function a trace-consumer call site refers to."""
+        if isinstance(func_expr, ast.Lambda):
+            info = self.by_node.get(func_expr)
+            if info and info.kind == HOST:
+                info.kind = STRICT
+        elif isinstance(func_expr, ast.Name):
+            info = self._resolve_name(func_expr.id, scope)
+            if info and info.kind == HOST:
+                info.kind = STRICT
+
+    def _resolve_name(self, name, scope):
+        """Resolve a bare name to a FunctionInfo: nested defs of the
+        enclosing scopes first, then module-level defs."""
+        s = scope
+        while s is not None:
+            if name in s.children:
+                return s.children[name]
+            if s.name == name:
+                return s
+            s = s.parent
+        for info in self.functions:
+            if info.parent is None and info.name == name:
+                return info
+        return None
+
+    def _classify(self):
+        # 1. jit-decorated entry points
+        for info in self.functions:
+            is_jit, statics = self._jit_decoration(info.node)
+            if is_jit:
+                info.kind = JIT_ENTRY
+                info.static_params = statics
+
+        # 2. functions handed to trace consumers at call sites
+        node_scope = {}
+        for info in self.functions:
+            for n in ast.walk(info.node):
+                if isinstance(n, ast.Call):
+                    node_scope.setdefault(n, info)
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            resolved = self.aliases.resolve(n.func)
+            spec = _TRACE_CONSUMERS.get(resolved or "")
+            if spec is None:
+                continue
+            scope = node_scope.get(n)
+            if spec == "*":
+                positions = range(len(n.args))
+            elif spec == "*_from_1":
+                positions = range(1, len(n.args))
+            else:
+                positions = spec
+            for i in positions:
+                if i < len(n.args):
+                    arg = n.args[i]
+                    if isinstance(arg, (ast.List, ast.Tuple)):
+                        for el in arg.elts:
+                            self._mark_strict(el, scope)
+                    else:
+                        self._mark_strict(arg, scope)
+
+        # 3. closures returned by device-closure factories
+        for info in self.functions:
+            if not _is_factory_name(info.name):
+                continue
+            for n in ast.walk(info.node):
+                if isinstance(n, ast.Return) and n.value is not None:
+                    vals = (n.value.elts
+                            if isinstance(n.value, ast.Tuple) else [n.value])
+                    for v in vals:
+                        self._mark_strict(v, info)
+
+        # 4. propagate by direct call: device code -> MIXED helpers
+        changed = True
+        while changed:
+            changed = False
+            for info in self.functions:
+                if not info.device_reachable():
+                    continue
+                for name in info.calls:
+                    callee = self._resolve_name(name, info)
+                    if callee is not None and callee.kind == HOST:
+                        callee.kind = MIXED
+                        changed = True
+                # nested defs of device functions execute at trace time
+                # as part of the traced program build; calls *through*
+                # them already propagate above

@@ -1,0 +1,383 @@
+"""brlint tier-A rules: the five JAX-specific hazard classes.
+
+Each rule documents (a) the failure it prevents and (b) the
+device-reachability scope it runs at (:mod:`.reachability`).  The scan
+must stay near-zero-false-positive on this repo's hot path, so every
+rule acts only on *locally provable* tracer values: traced parameters
+of strict closures / jit entries, and jnp/lax-derived locals anywhere
+device-reachable.  docs/development.md carries the user-facing
+catalogue; tests/test_analysis.py holds one seeded violation per rule.
+"""
+
+import ast
+import os as _os
+
+from .core import Finding, register
+from .reachability import JIT_ENTRY, STRICT, _is_factory_name
+
+# attribute reads that yield static (trace-time Python) values even on
+# tracers — shape math must never count as a device value
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "aval"}
+# builtin predicates whose results are static under trace
+_STATIC_CALLS = {"len", "isinstance", "callable", "hasattr", "type",
+                 "getattr", "id", "repr", "str.format"}
+# packages whose modules are device code wholesale: every function there
+# feeds a traced program (ops kernels, solver loops, mechanism bundles)
+_DEVICE_PKGS = ("ops", "solver", "models")
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_ARRAY_CTORS_LITERAL = {"asarray", "array"}
+# (name, index of positional dtype arg or None if keyword-only)
+_ARRAY_CTORS_DTYPE = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                      "eye": None, "arange": None, "linspace": None}
+
+
+def _in_device_pkg(path):
+    parts = _os.path.normpath(path).split(_os.sep)
+    return any(p in _DEVICE_PKGS for p in parts[:-1])
+
+
+def _own_nodes(info):
+    """Walk a function's body without descending into nested defs or
+    lambdas (those carry their own FunctionInfo and their own pass)."""
+    body = info.node.body
+    stack = list(body) if isinstance(body, list) else [body]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                stack.append(child)
+
+
+def _resolve(ctx, node):
+    return ctx.index.aliases.resolve(node)
+
+
+def _expr_tainted(ctx, node, tainted):
+    """Does this expression *provably* carry a device value?  Static
+    projections (shape/ndim/len/isinstance/...) cut the recursion: shape
+    math on tracers is trace-time Python and must not trigger rules."""
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(ctx, node.value, tainted)
+    if isinstance(node, ast.Call):
+        resolved = _resolve(ctx, node.func) or ""
+        if resolved in _STATIC_CALLS:
+            return False
+        if resolved.startswith(("jax.numpy", "jax.lax", "jax.scipy",
+                                "jax.nn")):
+            return True
+        # method calls on device values stay device values (y.sum(),
+        # x.astype(...)); the func recursion hits the _STATIC_ATTRS
+        # cutoff for shape/ndim projections
+        return any(_expr_tainted(ctx, c, tainted)
+                   for c in [node.func] + list(node.args)
+                   + [k.value for k in node.keywords])
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    return any(_expr_tainted(ctx, c, tainted)
+               for c in ast.iter_child_nodes(node))
+
+
+def _tainted_names(ctx, info):
+    """Traced params plus locals assigned from device expressions; two
+    sweeps approximate a fixpoint over straight-line reassignment."""
+    tainted = set(info.traced_params)
+    nodes = list(_own_nodes(info))
+    for _ in range(2):
+        for n in nodes:
+            value, targets = None, []
+            if isinstance(n, ast.Assign):
+                value, targets = n.value, n.targets
+            elif isinstance(n, ast.AugAssign):
+                value, targets = n.value, [n.target]
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                value, targets = n.value, [n.target]
+            if value is not None and _expr_tainted(ctx, value, tainted):
+                for t in targets:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name):
+                            tainted.add(nm.id)
+    return tainted
+
+
+def _static_test(ctx, node, tainted):
+    """True when a conditional test is trace-time static by construction:
+    is/is-not comparisons (identity never calls ``__bool__`` on a
+    tracer), isinstance/callable/hasattr/len, shape projections, and
+    boolean algebra over those."""
+    if isinstance(node, ast.BoolOp):
+        return all(_static_test(ctx, v, tainted) for v in node.values)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _static_test(ctx, node.operand, tainted)
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return True
+        return (_static_test(ctx, node.left, tainted)
+                and all(_static_test(ctx, c, tainted)
+                        for c in node.comparators))
+    if isinstance(node, ast.BinOp):
+        return (_static_test(ctx, node.left, tainted)
+                and _static_test(ctx, node.right, tainted))
+    if isinstance(node, ast.Call):
+        resolved = _resolve(ctx, node.func) or ""
+        return resolved in _STATIC_CALLS
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS or not _expr_tainted(
+            ctx, node, tainted)
+    if isinstance(node, (ast.Constant, ast.Name)):
+        return not _expr_tainted(ctx, node, tainted)
+    if isinstance(node, ast.Subscript):
+        return not _expr_tainted(ctx, node, tainted)
+    return False
+
+
+@register("traced-control-flow",
+          "Python if/while/assert/for on a traced value inside device code")
+def traced_control_flow(ctx):
+    """Python control flow on a tracer raises ``TracerBoolConversionError``
+    at best and silently bakes one branch into the compiled program at
+    worst (the branch taken at trace time becomes *the* program).  Use
+    ``jnp.where`` / ``lax.cond`` / ``lax.while_loop``; static config may
+    be tested via ``is None`` / ``isinstance`` / shape projections,
+    which this rule exempts."""
+    for info in ctx.index.functions:
+        if not info.device_reachable():
+            continue
+        tainted = _tainted_names(ctx, info)
+        if not tainted:
+            continue
+        for n in _own_nodes(info):
+            test = None
+            if isinstance(n, (ast.If, ast.While, ast.IfExp)):
+                test = n.test
+            elif isinstance(n, ast.Assert):
+                test = n.test
+            elif isinstance(n, ast.For):
+                test = n.iter
+            if test is None:
+                continue
+            if not _expr_tainted(ctx, test, tainted):
+                continue
+            if _static_test(ctx, test, tainted):
+                continue
+            kind = type(n).__name__.lower().replace("ifexp", "if-expression")
+            yield Finding(
+                "traced-control-flow", ctx.path, n.lineno, n.col_offset,
+                f"Python {kind} on a traced value inside device code; "
+                f"use jnp.where / lax.cond / lax.while_loop",
+                symbol=info.qualname)
+
+
+@register("host-sync-call",
+          "host-synchronizing call (.item()/float()/np.asarray/...) in "
+          "device code")
+def host_sync_call(ctx):
+    """``.item()``, ``float()``, ``np.asarray`` and friends force a
+    device->host transfer: under ``jit`` they raise on tracers, and in
+    eagerly-run hot-path code they serialize the pipeline (the role
+    ``block_until_ready`` plays deliberately in benchmarks only).  The
+    RHS closures and solver loops must stay wholly on device."""
+    for info in ctx.index.functions:
+        if not info.device_reachable():
+            continue
+        tainted = _tainted_names(ctx, info)
+        for n in _own_nodes(info):
+            if not isinstance(n, ast.Call):
+                continue
+            resolved = _resolve(ctx, n.func) or ""
+            # method-style syncs on a provable device value (or anything
+            # at all inside a strict closure — every input is traced)
+            if (isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _HOST_SYNC_METHODS):
+                if (info.kind == STRICT
+                        or _expr_tainted(ctx, n.func.value, tainted)):
+                    yield Finding(
+                        "host-sync-call", ctx.path, n.lineno, n.col_offset,
+                        f".{n.func.attr}() forces a host sync inside "
+                        f"device code", symbol=info.qualname)
+                continue
+            args_tainted = any(
+                _expr_tainted(ctx, a, tainted)
+                for a in list(n.args) + [k.value for k in n.keywords])
+            if resolved in _HOST_SYNC_BUILTINS and args_tainted:
+                yield Finding(
+                    "host-sync-call", ctx.path, n.lineno, n.col_offset,
+                    f"{resolved}() on a traced value pulls it to host "
+                    f"(TracerConversionError under jit)",
+                    symbol=info.qualname)
+            elif resolved.startswith("numpy.") and (
+                    args_tainted or info.kind == STRICT):
+                yield Finding(
+                    "host-sync-call", ctx.path, n.lineno, n.col_offset,
+                    f"{resolved}() materializes on host inside device "
+                    f"code; use jnp", symbol=info.qualname)
+            elif resolved in ("jax.device_get", "jax.block_until_ready"):
+                yield Finding(
+                    "host-sync-call", ctx.path, n.lineno, n.col_offset,
+                    f"{resolved}() is a host synchronization point and "
+                    f"must not live in device code", symbol=info.qualname)
+
+
+@register("env-read-in-trace",
+          "os.environ/getenv read inside trace-reachable code")
+def env_read_in_trace(ctx):
+    """An environment read executed while a closure is *built or traced*
+    bakes the value into the compiled program — later toggles are
+    silently ignored, and executable caches keyed on call arguments
+    serve the stale variant (the ``BR_JAC_BARRIER`` bug class,
+    ops/rhs.py round 5).  Read env at module import (one documented
+    freeze) or thread the value through explicit arguments."""
+    device_file = _in_device_pkg(ctx.path)
+    for info in ctx.index.functions:
+        if not (info.device_reachable() or _is_factory_name(info.name)
+                or device_file):
+            continue
+        seen_lines = set()
+        for n in _own_nodes(info):
+            hit = None
+            if isinstance(n, ast.Call):
+                resolved = _resolve(ctx, n.func) or ""
+                if resolved in ("os.getenv", "os.environ.get"):
+                    hit = resolved
+            elif isinstance(n, ast.Attribute):
+                # bare os.environ access (subscript/membership); the
+                # .get() form is reported once via its Call node above
+                if (n.attr == "environ"
+                        and _resolve(ctx, n) == "os.environ"):
+                    hit = "os.environ"
+            if hit and n.lineno not in seen_lines:
+                seen_lines.add(n.lineno)
+                yield Finding(
+                    "env-read-in-trace", ctx.path, n.lineno, n.col_offset,
+                    f"{hit} read inside trace-reachable code is frozen "
+                    f"into the trace (BR_JAC_BARRIER bug class); read at "
+                    f"module import or pass explicitly",
+                    symbol=info.qualname)
+
+
+@register("implicit-dtype",
+          "array creation without explicit dtype in device code")
+def implicit_dtype(ctx):
+    """On the x64-emulation TPU paths, a bare ``jnp.asarray(0)`` or
+    ``jnp.zeros(n)`` resolves its dtype from the global x64 flag and
+    weak-type promotion — f64 on the CPU parity tiers, f32 (or emulated
+    f64 at 10x cost) on accelerators, silently.  Mechanism tensors and
+    solver state must pin ``dtype=`` explicitly (models/gas.py stores
+    ln-domain tensors precisely to control this)."""
+    device_file = _in_device_pkg(ctx.path)
+    for info in ctx.index.functions:
+        if not (info.device_reachable() or device_file):
+            continue
+        for n in _own_nodes(info):
+            if not isinstance(n, ast.Call):
+                continue
+            resolved = _resolve(ctx, n.func) or ""
+            if not resolved.startswith("jax.numpy."):
+                continue
+            name = resolved.rsplit(".", 1)[1]
+            has_dtype_kw = any(k.arg == "dtype" for k in n.keywords)
+            if name in _ARRAY_CTORS_LITERAL:
+                if has_dtype_kw or len(n.args) >= 2 or not n.args:
+                    continue
+                if _is_numeric_literal(n.args[0]):
+                    yield Finding(
+                        "implicit-dtype", ctx.path, n.lineno, n.col_offset,
+                        f"jnp.{name} of a bare numeric literal without "
+                        f"dtype= resolves from the x64 flag; pin dtype",
+                        symbol=info.qualname)
+            elif name in _ARRAY_CTORS_DTYPE:
+                pos = _ARRAY_CTORS_DTYPE[name]
+                has_pos = pos is not None and len(n.args) > pos
+                if not (has_dtype_kw or has_pos):
+                    yield Finding(
+                        "implicit-dtype", ctx.path, n.lineno, n.col_offset,
+                        f"jnp.{name} without explicit dtype= resolves "
+                        f"from the x64 flag; pin dtype",
+                        symbol=info.qualname)
+
+
+def _is_numeric_literal(node):
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    if isinstance(node, ast.Constant):
+        # bools excluded: jnp.asarray(False) is dtype-stable
+        return (isinstance(node.value, (int, float))
+                and not isinstance(node.value, bool))
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return bool(node.elts) and all(
+            _is_numeric_literal(e) for e in node.elts)
+    return False
+
+
+@register("recompile-hazard",
+          "per-call closure / non-hashable or varying static into jit")
+def recompile_hazard(ctx):
+    """``jax.jit`` caches on (closure identity, static-arg values).  A
+    lambda or local def jitted inside a function body gets a fresh
+    identity every call (silent full recompile); a list/dict/set literal
+    passed to a ``static_argnames`` parameter raises unhashable (or,
+    stringified, recompiles per distinct value); an f-string static
+    recompiles per distinct rendering."""
+    # map jit-entry name -> (param order, static names) for this module
+    entries = {}
+    for info in ctx.index.functions:
+        if info.kind == JIT_ENTRY and info.static_params:
+            entries[info.name] = (info.params, info.static_params)
+
+    for info in ctx.index.functions:
+        for n in _own_nodes(info):
+            if not isinstance(n, ast.Call):
+                continue
+            resolved = _resolve(ctx, n.func) or ""
+            if resolved in ("jax.jit", "jit") and n.args:
+                target = n.args[0]
+                is_local = isinstance(target, ast.Lambda) or (
+                    isinstance(target, ast.Name)
+                    and target.id in info.children)
+                if is_local:
+                    yield Finding(
+                        "recompile-hazard", ctx.path, n.lineno,
+                        n.col_offset,
+                        "jax.jit of a per-call lambda/local function: "
+                        "fresh closure identity every call defeats the "
+                        "compilation cache; jit at module scope or cache "
+                        "the wrapped callable", severity="warning",
+                        symbol=info.qualname)
+            # calls into known jit entries: check static args
+            callee = None
+            if isinstance(n.func, ast.Name):
+                callee = n.func.id
+            elif isinstance(n.func, ast.Attribute):
+                callee = n.func.attr
+            if callee in entries:
+                params, statics = entries[callee]
+                for i, a in enumerate(n.args):
+                    pname = params[i] if i < len(params) else None
+                    if pname in statics:
+                        yield from _static_arg_hazard(
+                            ctx, a, pname, info)
+                for kw in n.keywords:
+                    if kw.arg in statics:
+                        yield from _static_arg_hazard(
+                            ctx, kw.value, kw.arg, info)
+
+
+def _static_arg_hazard(ctx, node, pname, info):
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        yield Finding(
+            "recompile-hazard", ctx.path, node.lineno, node.col_offset,
+        f"non-hashable {type(node).__name__.lower()} literal passed to "
+            f"static arg {pname!r} (TypeError at call, or per-call "
+            f"recompile if stringified)", symbol=info.qualname)
+    elif isinstance(node, ast.JoinedStr):
+        yield Finding(
+            "recompile-hazard", ctx.path, node.lineno, node.col_offset,
+            f"f-string passed to static arg {pname!r}: every distinct "
+            f"rendering is a fresh executable (recompile per call)",
+            symbol=info.qualname)

@@ -220,6 +220,15 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
     device launches (segments) with the trajectory drained to host between
     them; CPU runs one monolithic while_loop."""
     if backend == "cpu":
+        if jac_window is not None:
+            # fail loudly, mirroring the unknown-backend error below: the
+            # native BDF runtime manages its own iteration matrix, so a
+            # silently ignored explicit jac_window would report throughput
+            # for a configuration that never ran (ADVICE r5)
+            raise ValueError(
+                "jac_window is a jax-backend knob; backend='cpu' (the "
+                "native BDF runtime) does not honor it — drop the "
+                "argument or use backend='jax'")
         res = _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg,
                             rtol, atol, n_save, max_steps, kc_compat,
                             asv_quirk)
@@ -693,7 +702,9 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
     or ``"sdirk"`` (L-stable one-step SDIRK4).  ``jac_window`` follows the
     same ``None -> platform`` resolution rule as ``batch_reactor_sweep``
     (:func:`resolve_jac_window`: 8 on accelerators under BDF, 1 on CPU) —
-    one knob, one rule, both entry points.
+    one knob, one rule, both entry points.  An explicit ``jac_window``
+    with ``backend="cpu"`` raises: the native runtime manages its own
+    iteration matrix and would otherwise silently ignore it.
     """
     if args and isinstance(args[0], dict):
         if len(args) != 4:

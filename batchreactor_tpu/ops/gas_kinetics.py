@@ -66,7 +66,13 @@ _EXP32 = None
 def _exp32_enabled():
     global _EXP32
     if _EXP32 is None:
-        env = os.environ.get("BR_EXP32")
+        # justified suppression: this IS the documented once-per-process
+        # freeze (_exp docstring) — the read happens at most once, is
+        # cached in _EXP32 before the first kernel trace, and cannot be
+        # hoisted to import because the unset-var default needs
+        # jax.default_backend(), whose init at import would hang host-only
+        # use on a wedged tunneled TPU (solver/bdf.py module comment)
+        env = os.environ.get("BR_EXP32")  # brlint: disable=env-read-in-trace
         if env is not None:
             _EXP32 = env == "1"
         else:

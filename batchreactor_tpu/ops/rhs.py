@@ -24,6 +24,14 @@ import jax.numpy as jnp
 from ..utils.composition import mass_to_mole, pressure
 from . import gas_kinetics, surface_kinetics
 
+# BR_JAC_BARRIER is read ONCE, at module import (ADVICE r5 / brlint
+# env-read-in-trace): the fence decision is baked into every jit trace and
+# the compiled-executable caches key on call arguments, not env vars, so a
+# per-closure-build re-read would let a post-import toggle silently serve
+# stale variants from cache.  In-process callers who need per-closure
+# control pass ``fence_blocks=`` explicitly (scripts/coupled_jac_bisect.py).
+_JAC_BARRIER_ENV = os.environ.get("BR_JAC_BARRIER") == "1"
+
 
 def make_gas_rhs(gm, thermo, kc_compat=False):
     """Pure RHS for gas-only chemistry: rhs(t, y, cfg) with y = rho_k (S,).
@@ -137,13 +145,15 @@ def make_surface_jac(sm, thermo, gm=None, asv_quirk=True, kc_compat=False,
     ``jax.lax.optimization_barrier`` before assembly so XLA's fusion
     search cannot chase producers across the assembly boundary —
     numerically the identity.  ``None`` consults the ``BR_JAC_BARRIER``
-    env var ONCE per process (the decision is baked into each jit trace,
-    so a post-trace env toggle would otherwise be silently ignored).
+    env var ONCE per process, at module import (the decision is baked
+    into each jit trace and executable caches key on call arguments, so
+    a post-import env read would be silently stale anyway — ADVICE r5);
+    pass ``fence_blocks`` explicitly for per-closure control.
     """
     ng = len(thermo.species) if gm is None else gm.n_species
     molwt = thermo.molwt
     if fence_blocks is None:
-        fence_blocks = os.environ.get("BR_JAC_BARRIER") == "1"
+        fence_blocks = _JAC_BARRIER_ENV
 
     def jac(t, y, cfg):
         T, Asv = cfg["T"], cfg["Asv"]

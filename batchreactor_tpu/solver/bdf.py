@@ -67,8 +67,8 @@ def _change_D(D, order, factor):
     rows/cols beyond ``order`` act as identity, so a traced per-lane order
     works under vmap.  D: (_ROWS, n).
     """
-    i = jnp.arange(_M)[:, None].astype(D.dtype)
-    j = jnp.arange(_M)[None, :].astype(D.dtype)
+    i = jnp.arange(_M, dtype=D.dtype)[:, None]
+    j = jnp.arange(_M, dtype=D.dtype)[None, :]
     act = (i <= order) & (j <= order)
 
     def w_of(fac):
@@ -86,7 +86,7 @@ def _change_D(D, order, factor):
 
 def _masked_row_sum(D, weights, order, lo=0):
     """sum_{j=lo..order} weights[j] * D[j] with fixed shapes."""
-    jidx = jnp.arange(_ROWS)
+    jidx = jnp.arange(_ROWS, dtype=jnp.int32)
     w = jnp.where((jidx >= lo) & (jidx <= order), weights[:_ROWS], 0.0)
     return w @ D.reshape(_ROWS, -1)
 
@@ -218,7 +218,15 @@ def solve(
     ys_buf = jnp.zeros((n_save_buf, n), dtype=y0.dtype)
     if (observer is None) != (observer_init is None):
         raise ValueError("observer and observer_init must be given together")
-    obs0 = observer_init if observer is not None else jnp.zeros(())
+    obs0 = observer_init if observer is not None else jnp.zeros((),
+                                                                dtype=y0.dtype)
+
+    # one device staging per trace, OUTSIDE the while_loop body: the
+    # tables live as numpy so import stays device-free (module comment
+    # above), and converting them here instead of at each use site keeps
+    # device_put out of the hot loop program (brlint jaxpr audit)
+    gamma_tab = jnp.asarray(_GAMMA)
+    errc_tab = jnp.asarray(_ERRC)
 
     def newton(solve_m, t_new, y_pred, psi, c, scale):
         """Solve c f(t_new, y_pred + d) = psi + d; returns (d, converged)."""
@@ -244,7 +252,8 @@ def solve(
                 < newton_tol, dw < 0.1 * newton_tol)
             return (d2, y_pred + d2, it + 1, dw, conv & ~bad, (slow | bad))
 
-        init = (jnp.zeros_like(y_pred), y_pred, jnp.asarray(0),
+        init = (jnp.zeros_like(y_pred), y_pred,
+                jnp.asarray(0, dtype=jnp.int32),
                 jnp.asarray(-1.0, dtype=y0.dtype), jnp.asarray(False),
                 jnp.asarray(False))
         d, _, _, _, conv, _ = lax.while_loop(cond, body, init)
@@ -278,11 +287,9 @@ def solve(
         n_equal = jnp.where(factor_clip < 1.0, 0, n_equal)
 
         t_new = t + h
-        # jnp.asarray at use: the tables live as numpy so import
-        # stays device-free, but traced-order indexing needs jnp
-        gam = jnp.asarray(_GAMMA)[order]
+        gam = gamma_tab[order]
         y_pred = _masked_row_sum(D, jnp.ones((_ROWS,), y0.dtype), order)
-        psi = _masked_row_sum(D, jnp.asarray(_GAMMA[:_ROWS]), order, lo=1) / gam
+        psi = _masked_row_sum(D, gamma_tab, order, lo=1) / gam
         c = h / gam
         scale = atol + rtol * jnp.abs(y_pred)
 
@@ -302,7 +309,7 @@ def solve(
                 return solve0(b) * cj_fac
         d, conv = newton(solve_m, t_new, y_pred, psi, c, scale)
 
-        err = _scaled_norm(jnp.asarray(_ERRC)[order] * d, y_pred, rtol, atol)
+        err = _scaled_norm(errc_tab[order] * d, y_pred, rtol, atol)
         accept = conv & (err <= 1.0) & jnp.isfinite(err) & running & ~already
 
         # ---- rejected: shrink h (newton failure: halve; error: PI-free
@@ -314,13 +321,13 @@ def solve(
                             0.5)
         # ---- accepted: update differences ---------------------------------
         #   D[q+2] = d - D[q+1]; D[q+1] = d; D[j] += D[j+1] for j = q..0
-        ridx = jnp.arange(_ROWS)[:, None]
+        ridx = jnp.arange(_ROWS, dtype=jnp.int32)[:, None]
         Dq1 = jnp.take(D, order + 1, axis=0)
         D_acc = jnp.where(ridx == order + 2, (d - Dq1)[None, :], D)
         D_acc = jnp.where(ridx == order + 1, d[None, :], D_acc)
         # downward prefix: D[j] += D[j+1] for j <= order, from high to low —
         # equivalent closed form: D[j] = sum_{k=j..order+1} D_acc[k]
-        kidx = jnp.arange(_ROWS)[None, :]
+        kidx = jnp.arange(_ROWS, dtype=jnp.int32)[None, :]
         take = (kidx >= ridx) & (kidx <= (order + 1)) & (ridx <= order)
         D_summed = jnp.where(take, 1.0, 0.0) @ D_acc
         D_acc = jnp.where(ridx <= order, D_summed, D_acc)
@@ -333,11 +340,11 @@ def solve(
         e_mid = err
         e_m = jnp.where(
             order > 1,
-            _scaled_norm(jnp.asarray(_ERRC)[order - 1] * jnp.take(D_acc, order, axis=0),
+            _scaled_norm(errc_tab[order - 1] * jnp.take(D_acc, order, axis=0),
                          y_new, rtol, atol), jnp.inf)
         e_p = jnp.where(
             order < MAXORD,
-            _scaled_norm(jnp.asarray(_ERRC)[order + 1] *
+            _scaled_norm(errc_tab[order + 1] *
                          jnp.take(D_acc, order + 2, axis=0),
                          y_new, rtol, atol), jnp.inf)
         of = order.astype(y0.dtype)
@@ -436,7 +443,7 @@ def solve(
                 # rescales (factor in [0.2, 10]) and is self-healing: if
                 # the drifted preconditioner stalls Newton, the failure
                 # closes the window and the next open rebuilds M at c.
-                c0 = h / jnp.asarray(_GAMMA)[order]
+                c0 = h / gamma_tab[order]
                 solve0 = make_solve_m(eye - c0 * J, linsolve, y0.dtype)
                 pre = (solve0, c0)
             else:

@@ -25,7 +25,7 @@ def lu_factor(A):
     piv[k] is the row swapped into position k at step k (LAPACK-style ipiv).
     """
     n = A.shape[0]
-    idx = jnp.arange(n)
+    idx = jnp.arange(n, dtype=jnp.int32)
 
     def body(k, state):
         LU, piv = state
@@ -54,7 +54,7 @@ def lu_solve(lu_piv, b):
     """Solve A x = b given lu_factor(A) output."""
     LU, piv = lu_piv
     n = LU.shape[0]
-    idx = jnp.arange(n)
+    idx = jnp.arange(n, dtype=jnp.int32)
 
     def permute(k, x):
         p = piv[k]

@@ -205,7 +205,8 @@ def solve(
             bad = ~jnp.isfinite(dnorm)
             return (z_new, it + 1, dnorm, converged, growing | bad)
 
-        init = (z_init, jnp.array(0), jnp.array(jnp.inf, dtype=y0.dtype),
+        init = (z_init, jnp.array(0, dtype=jnp.int32),
+                jnp.array(jnp.inf, dtype=y0.dtype),
                 jnp.array(False), jnp.array(False))
         z, it, dnorm, converged, diverged = lax.while_loop(cond, body, init)
         return z, converged & jnp.isfinite(dnorm)
@@ -237,7 +238,8 @@ def solve(
 
     if (observer is None) != (observer_init is None):
         raise ValueError("observer and observer_init must be given together")
-    obs0 = observer_init if observer is not None else jnp.zeros(())
+    obs0 = observer_init if observer is not None else jnp.zeros((),
+                                                                dtype=y0.dtype)
 
     def cond(carry):
         t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved, obs = carry

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""brlint CLI shim: JAX tracer-safety static analysis for this repo.
+
+  python scripts/brlint.py batchreactor_tpu/            # tier-A AST scan
+  python scripts/brlint.py --jaxpr                      # tier-B jaxpr audit
+  python scripts/brlint.py batchreactor_tpu/ --baseline brlint_baseline.json
+
+The implementation lives in batchreactor_tpu/analysis/ (rule catalogue and
+suppression policy: docs/development.md).  Tier A is a stdlib-only AST scan
+and must stay runnable on a host with no (or a broken/wedged) jax install —
+so this shim loads the analysis subpackage through a lightweight namespace
+parent instead of the real ``batchreactor_tpu/__init__``, which imports jax
+and the full solver stack at module scope.  Tier B (--jaxpr) imports jax
+lazily inside the audit and should run under JAX_PLATFORMS=cpu in CI.
+"""
+
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# lightweight parent package: gives `batchreactor_tpu.analysis.*` (and, for
+# --jaxpr, the models/ops/solver subpackages via their relative imports) an
+# import path WITHOUT executing batchreactor_tpu/__init__.py — the real
+# init imports jax + api at module scope, which tier A must not pay (and
+# which fails outright where jax is absent).  setdefault: a process that
+# already imported the real package keeps it.
+_pkg = types.ModuleType("batchreactor_tpu")
+_pkg.__path__ = [os.path.join(REPO, "batchreactor_tpu")]
+sys.modules.setdefault("batchreactor_tpu", _pkg)
+
+from batchreactor_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,12 +130,22 @@ def main():
     if "smoke" in steps:
         record(run([py, "scripts/tpu_smoke.py"], 2700, {},
                    "tpu-smoke-tier"))
+        if not probe():
+            record({"label": "abort", "note": "chip wedged after smoke"})
+            return 1
     if "trace" in steps:
         record(run([py, "scripts/trace_capture.py"], 1800, {},
                    "trace-capture"))
+        if not probe():
+            record({"label": "abort", "note": "chip wedged after trace"})
+            return 1
     if "invbudget" in steps:
         record(run([py, "scripts/inv_budget.py"], 1500, {},
                    "inv-budget"))
+        if not probe():
+            record({"label": "abort",
+                    "note": "chip wedged after invbudget"})
+            return 1
     if "coupled" in steps:
         # PRODUCT attempt first (VERDICT r4: the diagnostic ladder wedged
         # the chip before the product ever ran).  The round-5 RHS structure

@@ -142,13 +142,15 @@ def _stage_main(stage):
         out = f(0.0, y0s[:, :ng], cfg)
     elif stage in ("j2_no_block", "j3_full", "j4_single", "j5_small_b",
                    "j6_barrier", "j7_low_effort"):
-        if stage == "j6_barrier":
-            os.environ["BR_JAC_BARRIER"] = "1"
         # j2: the four blocks straight from the kernel — the traced program
         # truly lacks the jnp.block concat (slicing it back out would leave
-        # the concat in the program; ADVICE r4)
+        # the concat in the program; ADVICE r4).  j6: explicit
+        # fence_blocks=True — BR_JAC_BARRIER is frozen at module import now
+        # (ADVICE r5), an in-process env poke after import is ignored
         jacf = make_surface_jac(sm, th, gm=gm,
-                                return_blocks=stage == "j2_no_block")
+                                return_blocks=stage == "j2_no_block",
+                                fence_blocks=(True if stage == "j6_barrier"
+                                              else None))
         if stage == "j4_single":
             f = jax.jit(jacf)
             out = f(0.0, y0s[0],

@@ -1,0 +1,503 @@
+"""brlint (batchreactor_tpu.analysis) tests: every tier-A rule catches its
+seeded-violation fixture, suppressions and the baseline round-trip, the
+tier-B jaxpr audit flags seeded hazards, and — the contract that makes the
+CI gate meaningful — the package itself scans clean.
+
+Also the regression tests for the three ADVICE.md round-5 findings this PR
+fixes (api.py jac_window/backend, ops/rhs.py BR_JAC_BARRIER freeze,
+scripts/chip_session.py probe placement).
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+import textwrap
+
+import pytest
+
+from batchreactor_tpu.analysis import Baseline, lint_file, lint_paths
+from batchreactor_tpu.analysis.cli import main as brlint_main
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "batchreactor_tpu"
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def _lint_snippet(tmp_path, code, name="snippet.py", select=None):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(code))
+    findings, n_suppressed, _ = lint_file(str(f), select=select)
+    return findings, n_suppressed
+
+
+# --- tier A: one seeded violation per rule --------------------------------
+
+def test_traced_control_flow_on_closure_param(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def make_bad_rhs(k):
+            def rhs(t, y, cfg):
+                if y[0] > 0.0:
+                    return -k * y
+                return y
+            return rhs
+        """)
+    assert [f.rule for f in findings] == ["traced-control-flow"]
+    assert findings[0].symbol.endswith("rhs")
+
+
+def test_traced_control_flow_while_on_jnp_local(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            e = jnp.abs(x)
+            while e > 1e-3:
+                e = e * 0.5
+            return e
+
+        batched = jax.vmap(step)
+        """)
+    assert any(f.rule == "traced-control-flow" for f in findings)
+
+
+def test_traced_control_flow_through_method_call(tmp_path):
+    # taint must survive array-method idioms: y.sum() is a device value
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def make_norm_rhs(k):
+            def rhs(t, y, cfg):
+                m = y.sum()
+                if m > 0:
+                    return y / m
+                return y
+            return rhs
+        """)
+    assert any(f.rule == "traced-control-flow" for f in findings)
+
+
+def test_tier_a_cli_needs_no_jax(tmp_path):
+    """The wedged-accelerator contract: a tier-A scan must run on a host
+    where importing jax fails outright (scripts/brlint.py loads the
+    analysis subpackage through a namespace parent, skipping the heavy
+    package __init__)."""
+    import subprocess
+    import sys as _sys
+
+    (tmp_path / "jax.py").write_text("raise ImportError('jax blocked')\n")
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                return y + jnp.zeros(3)
+            return rhs
+        """))
+    env = {**os.environ, "PYTHONPATH": str(tmp_path)}
+    res = subprocess.run(
+        [_sys.executable, str(REPO / "scripts" / "brlint.py"), str(bad)],
+        env=env, capture_output=True, text=True, cwd=str(REPO))
+    assert res.returncode == 1, res.stderr  # finding reported, no jax paid
+    assert "implicit-dtype" in res.stdout
+
+
+def test_public_api_registers_rules():
+    """Importing only batchreactor_tpu.analysis (not .cli) must register
+    the tier-A rules — otherwise lint_paths vacuously scans clean."""
+    import subprocess
+    import sys as _sys
+
+    code = ("import batchreactor_tpu.analysis as a, sys; "
+            "sys.exit(0 if len(a.all_rules()) >= 5 else 1)")
+    res = subprocess.run([_sys.executable, "-c", code], cwd=str(REPO),
+                         env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert res.returncode == 0
+
+
+def test_static_tests_not_flagged(tmp_path):
+    # is-None / isinstance / shape math are trace-time static: the exact
+    # idioms the real RHS factories use must never fire the rule
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def make_ok_rhs(gm, quirk):
+            def rhs(t, y, cfg):
+                n = y.shape[0]
+                if gm is not None and n > 2:
+                    y = y * 2.0
+                if quirk:
+                    y = y + 1.0
+                return y
+            return rhs
+        """)
+    assert findings == []
+
+
+def test_static_argnums_params_exempt(tmp_path):
+    # positionally declared statics are as exempt as static_argnames ones;
+    # the traced params still flag
+    findings, _ = _lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def run(mode, y):
+            if mode == "fast":
+                y = y * 2.0
+            if y > 0:
+                y = -y
+            return y
+        """)
+    assert len(findings) == 1 and findings[0].rule == "traced-control-flow"
+    assert findings[0].line == 9  # the `if y > 0`, not the mode test
+    findings, _ = _lint_snippet(tmp_path, """
+        import numpy as np
+
+        def make_bad_jac(a):
+            def jac(t, y, cfg):
+                return float(y[0]) * np.asarray(y)
+            return jac
+        """)
+    rules = [f.rule for f in findings]
+    assert rules.count("host-sync-call") == 2  # float() and np.asarray()
+
+
+def test_host_sync_item_method(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        def body(carry):
+            return carry + carry.item()
+
+        out = jax.lax.while_loop(lambda c: c < 3, body, 0)
+        """)
+    assert any(".item()" in f.message for f in findings)
+
+
+def test_env_read_in_trace(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import os
+
+        def make_fenced_rhs(sm):
+            fence = os.environ.get("MY_TOGGLE") == "1"
+
+            def rhs(t, y, cfg):
+                return -y if fence else y
+            return rhs
+        """)
+    assert any(f.rule == "env-read-in-trace" for f in findings)
+
+
+def test_implicit_dtype(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def make_padded_rhs(n):
+            def rhs(t, y, cfg):
+                pad = jnp.zeros(3)
+                one = jnp.asarray(1.0)
+                ok = jnp.zeros(3, dtype=y.dtype)
+                ok2 = jnp.asarray(y)
+                return y + pad + one + ok + ok2
+            return rhs
+        """)
+    assert [f.rule for f in findings] == ["implicit-dtype", "implicit-dtype"]
+
+
+def test_recompile_hazard_static_list_and_local_jit(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("opts",))
+        def run(x, opts):
+            return x
+
+        def driver(x):
+            f = jax.jit(lambda v: v + 1)
+            return f(x) + run(x, opts=["a", "b"]) + run(x, f"mode={x.ndim}")
+        """)
+    rules = [f.rule for f in findings]
+    assert rules.count("recompile-hazard") == 3  # local jit, list, f-string
+
+
+# --- suppressions & baseline ---------------------------------------------
+
+def test_suppression_silences_named_rule(tmp_path):
+    code = """
+        import jax.numpy as jnp
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                pad = jnp.zeros(3)  # brlint: disable=implicit-dtype
+                return y + pad
+            return rhs
+        """
+    findings, n_suppressed = _lint_snippet(tmp_path, code)
+    assert findings == [] and n_suppressed == 1
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    code = """
+        import jax.numpy as jnp
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                pad = jnp.zeros(3)  # brlint: disable=host-sync-call
+                return y + pad
+            return rhs
+        """
+    findings, n_suppressed = _lint_snippet(tmp_path, code)
+    assert [f.rule for f in findings] == ["implicit-dtype"]
+    assert n_suppressed == 0
+
+
+def test_suppression_in_string_literal_ignored(tmp_path):
+    code = '''
+        import jax.numpy as jnp
+
+        NOTE = "# brlint: disable=implicit-dtype"
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                return y + jnp.zeros(3)
+            return rhs
+        '''
+    findings, _ = _lint_snippet(tmp_path, code)
+    assert [f.rule for f in findings] == ["implicit-dtype"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = tmp_path / "debt.py"
+    f.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                return y + jnp.zeros(3)
+            return rhs
+        """))
+    findings, _, sources = lint_paths([str(f)])
+    assert len(findings) == 1
+    bl = Baseline.from_findings(findings, sources)
+    path = tmp_path / "baseline.json"
+    bl.save(str(path))
+    reloaded = Baseline.load(str(path))
+    new, baselined, stale = reloaded.apply(findings, sources)
+    assert new == [] and len(baselined) == 1 and stale == []
+    # fix the debt -> the entry goes stale (reported so the file shrinks)
+    new, baselined, stale = reloaded.apply([], sources)
+    assert new == [] and baselined == [] and len(stale) == 1
+
+
+def test_baseline_duplicate_lines_not_absorbed(tmp_path):
+    """A NEW finding on a line textually identical to baselined debt must
+    still fail: fingerprints carry an occurrence counter."""
+    f = tmp_path / "debt.py"
+    one = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                return y + jnp.zeros(3)
+            return rhs
+        """)
+    f.write_text(one)
+    findings, _, sources = lint_paths([str(f)])
+    bl = Baseline.from_findings(findings, sources)
+    # duplicate the identical offending line
+    f.write_text(one.replace("return y + jnp.zeros(3)",
+                             "y = y + jnp.zeros(3)\n        "
+                             "return y + jnp.zeros(3)"))
+    findings2, _, sources2 = lint_paths([str(f)])
+    assert len(findings2) == 2
+    new, baselined, _ = bl.apply(findings2, sources2)
+    assert len(baselined) == 1 and len(new) == 1
+
+
+def test_cli_write_baseline_rejects_jaxpr(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = 1\n")
+    assert brlint_main([str(bad), "--jaxpr",
+                        "--write-baseline", str(tmp_path / "b.json")]) == 2
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                return y + jnp.zeros(3)
+            return rhs
+        """))
+    assert brlint_main([str(bad)]) == 1
+    baseline = tmp_path / "bl.json"
+    assert brlint_main([str(bad), "--write-baseline", str(baseline)]) == 0
+    assert brlint_main([str(bad), "--baseline", str(baseline)]) == 0
+    assert brlint_main([]) == 2
+    assert brlint_main([str(bad), "--select", "no-such-rule"]) == 2
+
+
+def test_cli_json_output(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                return y + jnp.zeros(3)
+            return rhs
+        """))
+    assert brlint_main([str(bad), "--json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"][0]["rule"] == "implicit-dtype"
+
+
+# --- the gate itself: the package scans clean ----------------------------
+
+def test_package_scans_clean():
+    findings, _, _ = lint_paths([str(PKG)])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# --- tier B: jaxpr audit --------------------------------------------------
+
+def test_jaxpr_audit_clean_on_fixtures():
+    from batchreactor_tpu.analysis.jaxpr_audit import run_audit
+
+    findings = run_audit(fixtures_dir=str(FIXTURES))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_jaxpr_audit_flags_callback_and_loop_transfer():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from batchreactor_tpu.analysis.jaxpr_audit import _audit_jaxpr
+
+    table = np.arange(4.0)
+
+    def with_callback(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct(x.shape, x.dtype),
+            x)
+
+    jaxpr = jax.make_jaxpr(with_callback)(jnp.ones(3))
+    found = _audit_jaxpr("cb", jaxpr, check_dtype=False)
+    assert any(f.rule == "jaxpr-host-callback" for f in found)
+
+    def with_loop_transfer(x):
+        def body(i, acc):
+            return acc + jnp.asarray(table)[i]  # np->device inside the loop
+
+        return jax.lax.fori_loop(0, 4, body, x)
+
+    jaxpr = jax.make_jaxpr(with_loop_transfer)(jnp.zeros(()))
+    found = _audit_jaxpr("loop", jaxpr, check_dtype=False)
+    assert any(f.rule == "jaxpr-device-transfer" for f in found)
+
+
+def test_jaxpr_audit_flags_f32_leak():
+    import jax
+    import jax.numpy as jnp
+
+    from batchreactor_tpu.analysis.jaxpr_audit import _audit_jaxpr
+
+    def leaky(x):
+        return (x.astype(jnp.float32) * 2.0).astype(jnp.float64)
+
+    jaxpr = jax.make_jaxpr(leaky)(jnp.zeros((), dtype=jnp.float64))
+    found = _audit_jaxpr("leak", jaxpr, check_dtype=True)
+    assert any(f.rule == "jaxpr-dtype-leak" for f in found)
+
+
+# --- ADVICE.md round-5 regression tests ----------------------------------
+
+def test_jac_window_rejected_on_native_backend():
+    """api.py:222 (ADVICE r5): an explicit jac_window with backend='cpu'
+    must fail loudly, not be silently ignored."""
+    from batchreactor_tpu import api
+
+    with pytest.raises(ValueError, match="jac_window"):
+        api._run_solve("cpu", "gas", None, None, None, None, None,
+                       0.0, 1.0, {}, 1e-6, 1e-10, 0, 10, False, True,
+                       jac_window=8)
+
+
+def test_jac_barrier_frozen_at_import(monkeypatch):
+    """ops/rhs.py:139 (ADVICE r5): BR_JAC_BARRIER semantics now match the
+    docstring — frozen at module import, so a post-import env toggle does
+    NOT change newly built closures; explicit fence_blocks=True does."""
+    import jax
+
+    from batchreactor_tpu.models.gas import compile_gaschemistry
+    from batchreactor_tpu.models.surface import compile_mech
+    from batchreactor_tpu.models.thermo import create_thermo
+    from batchreactor_tpu.ops import rhs as rhs_mod
+
+    gm = compile_gaschemistry(str(FIXTURES / "h2o2.dat"))
+    th = create_thermo(list(gm.species), str(FIXTURES / "therm.dat"))
+    sm = compile_mech(str(FIXTURES / "h2oni.xml"), th, list(gm.species))
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    y0 = jnp.concatenate([jnp.ones(len(th.species), dtype=jnp.float64),
+                          jnp.asarray(sm.ini_covg, dtype=jnp.float64)])
+    cfg = {"T": jnp.asarray(1100.0, dtype=jnp.float64),
+           "Asv": jnp.asarray(1.0, dtype=jnp.float64)}
+
+    def has_barrier(jacf):
+        jaxpr = jax.make_jaxpr(jacf)(0.0, y0, cfg)
+        return "optimization_barrier" in str(jaxpr)
+
+    if rhs_mod._JAC_BARRIER_ENV:
+        pytest.skip("BR_JAC_BARRIER was set when the module imported")
+    # the env var was unset at import -> default stays off even if the
+    # env is poked afterwards (the old per-call read would flip here)
+    monkeypatch.setenv("BR_JAC_BARRIER", "1")
+    assert rhs_mod._JAC_BARRIER_ENV is False
+    assert not has_barrier(rhs_mod.make_surface_jac(sm, th))
+    # explicit per-closure control still works
+    assert has_barrier(rhs_mod.make_surface_jac(sm, th, fence_blocks=True))
+
+
+def test_chip_session_probes_before_coupled(monkeypatch):
+    """scripts/chip_session.py:139 (ADVICE r5): a wedge during smoke must
+    be caught by a probe BEFORE the coupled compile starts, so it cannot
+    be misattributed to the coupled step."""
+    spec = importlib.util.spec_from_file_location(
+        "chip_session", str(REPO / "scripts" / "chip_session.py"))
+    cs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cs)
+
+    events = []
+    probe_results = iter([True, False])  # start probe ok; wedged after smoke
+
+    def fake_run(cmd, timeout, extra_env=None, label=""):
+        events.append(("run", label))
+        return {"label": label, "rc": 0, "timed_out": False,
+                "wall_s": 0.0, "tail": ""}
+
+    monkeypatch.setattr(cs, "run", fake_run)
+    monkeypatch.setattr(cs, "probe",
+                        lambda: (events.append(("probe",)) or
+                                 next(probe_results, True)))
+    monkeypatch.setattr(cs, "OUT", str(
+        pathlib.Path(os.environ.get("TMPDIR", "/tmp")) / "_cs_test.json"))
+    monkeypatch.setenv("CS_STEPS", "smoke,coupled")
+
+    rc = cs.main()
+    assert rc == 1
+    labels = [e[1] for e in events if e[0] == "run"]
+    # the wedge was detected right after smoke: coupled never launched
+    assert labels == ["tpu-smoke-tier"]

@@ -254,6 +254,20 @@ def test_unknown_backend_raises(tmp_path, reference_dir, lib_dir):
         br.batch_reactor(xml, lib_dir, gaschem=True, backend="gpu")
 
 
+def test_jac_window_with_cpu_backend_raises(lib_dir):
+    """ADVICE r5 regression: an explicit jac_window used to be silently
+    ignored by the native backend — it must fail loudly, mirroring the
+    unknown-backend error (the check runs before any solve, so no
+    native runtime is needed)."""
+    md = br.compile_gaschemistry(f"{lib_dir}/h2o2.dat")
+    thermo = br.create_thermo(list(md.species), f"{lib_dir}/therm.dat")
+    with pytest.raises(ValueError, match="jac_window"):
+        br.batch_reactor(
+            {"H2": 0.25, "O2": 0.25, "N2": 0.5}, 1173.0, 1e5, 1e-3,
+            chem=br.Chemistry(gaschem=True), thermo_obj=thermo, md=md,
+            backend="cpu", jac_window=8)
+
+
 def test_file_driven_segmented_matches_monolithic(tmp_path, reference_dir,
                                                   lib_dir):
     """The accelerator path (segmented=True) must reproduce the monolithic
