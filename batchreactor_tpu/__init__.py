@@ -40,10 +40,12 @@ from .models.surface import SurfaceMechanism, compile_mech  # noqa: E402
 from .api import (  # noqa: E402
     Chemistry,
     SensitivityProblem,
+    SensitivitySolution,
     batch_reactor,
     batch_reactor_sweep,
 )
 from .io.config import InputData, input_data  # noqa: E402
+from . import sensitivity  # noqa: E402
 
 __all__ = [
     "ThermoTable",
@@ -54,10 +56,12 @@ __all__ = [
     "compile_mech",
     "Chemistry",
     "SensitivityProblem",
+    "SensitivitySolution",
     "batch_reactor",
     "batch_reactor_sweep",
     "InputData",
     "input_data",
+    "sensitivity",
 ]
 
 __version__ = "0.1.0"

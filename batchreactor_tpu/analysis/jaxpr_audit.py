@@ -1,12 +1,15 @@
-"""brlint tier B: jaxpr audit of the four RHS modes and both solvers.
+"""brlint tier B: jaxpr audit of the RHS modes, solvers, and sensitivity
+programs.
 
 The AST tier sees the *source*; this tier sees the *traced program* —
 the thing XLA actually compiles.  It builds the four chemistry modes
-(gas / surf / gas+surf / udf) and both solvers' step programs on the
-tiny vendored fixtures (tests/fixtures: h2o2.dat + therm.dat +
-h2oni.xml — small enough that every trace is sub-second on CPU) and
-walks each jaxpr, recursively through while/cond/scan sub-jaxprs, for
-three hazard classes the purity contract forbids in the hot loop:
+(gas / surf / gas+surf / udf), both solvers' step programs, and the two
+sensitivity programs (the tangent-carrying forward BDF step and the
+adjoint fixed-grid gradient — sensitivity/) on the tiny vendored
+fixtures (tests/fixtures: h2o2.dat + therm.dat + h2oni.xml — small
+enough that every trace is sub-second on CPU) and walks each jaxpr,
+recursively through while/cond/scan sub-jaxprs, for three hazard
+classes the purity contract forbids in the hot loop:
 
 * **host callbacks** (``pure_callback`` / ``io_callback`` /
   ``debug_callback`` / ...): a Python round-trip per device step — the
@@ -137,7 +140,7 @@ def _build_modes(fixtures):
         ("udf-rhs", make_udf_rhs(udf, th.molwt, species=th.species),
          None, y_gas, cfg),
     ]
-    return modes
+    return modes, gm, th
 
 
 def run_audit(fixtures_dir=None):
@@ -159,7 +162,7 @@ def run_audit(fixtures_dir=None):
     check_dtype = not _exp32_enabled()
     findings = []
 
-    modes = _build_modes(fixtures)
+    modes, gm, th = _build_modes(fixtures)
     for tag, rhs, jac, y0, cfg in modes:
         jaxpr = jax.make_jaxpr(rhs)(0.0, y0, cfg)
         findings.extend(_audit_jaxpr(tag, jaxpr, check_dtype))
@@ -180,4 +183,39 @@ def run_audit(fixtures_dir=None):
 
         jaxpr = jax.make_jaxpr(run)(y0)
         findings.extend(_audit_jaxpr(sname, jaxpr, check_dtype=False))
+
+    # the two sensitivity programs (sensitivity/, docs/sensitivity.md):
+    # the tangent-carrying BDF step program and the adjoint fixed-grid
+    # gradient program — both must meet the same purity contract as the
+    # plain solve from day one.  Tiny selections / grids: trace cost only.
+    # dtype checks off, same as the solver programs (the mixed-precision
+    # Newton preconditioner converts by design).
+    from ..ops.rhs import make_gas_rhs as _mk_rhs
+    from ..sensitivity import adjoint as _adj
+    from ..sensitivity import forward as _fwd
+    from ..sensitivity import params as _sp
+
+    sspec = _sp.select(gm, reactions=(0, 1))
+    stheta = _sp.extract(gm, sspec)
+    srhs_theta = _sp.make_rhs_theta(gm, sspec, lambda m: _mk_rhs(m, th))
+
+    def run_sens_forward(y0_):
+        return _fwd.solve_forward(
+            srhs_theta, y0_, 0.0, 1e-7, stheta, cfg, rtol=1e-6,
+            atol=1e-10, max_steps=3, jac=jac).tangents
+
+    jaxpr = jax.make_jaxpr(run_sens_forward)(y0)
+    findings.extend(_audit_jaxpr("sens-forward-step", jaxpr,
+                                 check_dtype=False))
+
+    def run_sens_adjoint(y0_):
+        _, grad, _ = _adj.solve_adjoint(
+            srhs_theta, _adj.final_species_qoi(0), y0_, 0.0, 1e-7,
+            stheta, cfg, rtol=1e-6, atol=1e-10, grid_size=8, segments=2,
+            max_steps=8)
+        return grad["log_A"]
+
+    jaxpr = jax.make_jaxpr(run_sens_adjoint)(y0)
+    findings.extend(_audit_jaxpr("sens-adjoint-grad", jaxpr,
+                                 check_dtype=False))
     return findings

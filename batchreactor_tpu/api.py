@@ -25,6 +25,10 @@ problem *without* solving, :205-207) — here a :class:`SensitivityProblem`
 whose ``rhs`` is jit/grad/vmap-able, which is strictly more useful than the
 reference's ODEProblem: ``jax.jacfwd`` through ``solver.sdirk.solve`` gives
 forward sensitivities natively (tests/test_solver.py exercises this).
+``sens="forward"``/``"adjoint"`` go further and SOLVE the sensitivities —
+CVODES-style staggered forward tangents riding the BDF loop, or
+checkpointed adjoint gradients of a scalar QoI — via the
+:mod:`~batchreactor_tpu.sensitivity` subsystem (docs/sensitivity.md).
 """
 
 import dataclasses
@@ -59,7 +63,15 @@ class SensitivityProblem:
     """What ``sens=True`` returns instead of solving (reference :205-207
     returns ``(params, prob, t_span)``).  ``rhs(t, y, cfg)`` is a pure JAX
     function; differentiate the solve with ``jax.jacfwd`` over ``cfg`` or
-    ``y0`` for forward sensitivities."""
+    ``y0`` for forward sensitivities.
+
+    ``theta``/``spec`` name the differentiable mechanism parameters (the
+    :mod:`~batchreactor_tpu.sensitivity` subsystem's pytree + selection,
+    default: every reaction's ln A of the primary mechanism), so the
+    legacy hook composes with ``sensitivity.params.apply``; both are
+    ``None`` for user-defined chemistry, which has no named parameters.
+    Prefer ``sens="forward"``/``"adjoint"``, which solve and return the
+    sensitivities directly."""
 
     rhs: object
     y0: jnp.ndarray
@@ -67,6 +79,35 @@ class SensitivityProblem:
     t_span: tuple
     species: tuple
     surface_species: tuple | None
+    theta: dict | None = None
+    spec: object | None = None  # sensitivity.params.ParamSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class SensitivitySolution:
+    """What ``sens="forward"``/``"adjoint"`` return: a SOLVED run plus its
+    parameter sensitivities.  ``tangents`` is the forward (P, n) block
+    dy(t_end)/dtheta in ``sensitivity.params.names(spec)`` row order
+    (``None`` in adjoint mode); ``qoi``/``qoi_grad`` are the scalar QoI
+    and its theta-pytree gradient (``None`` unless a QoI was requested).
+    """
+
+    status: str
+    t: float
+    y: object                      # (S,) final state
+    species: tuple
+    surface_species: tuple | None
+    spec: object                   # sensitivity.params.ParamSpec
+    theta: dict                    # the theta the run was evaluated at
+    names: tuple                   # one label per tangent row
+    tangents: object = None        # (P, S) forward sensitivities
+    qoi: object = None
+    qoi_grad: object = None        # theta-shaped pytree
+    n_accepted: int = 0
+    n_rejected: int = 0
+    truncated: bool = False        # adjoint only: the grid-pinning pass
+    #                                overflowed sens_grid — the re-solve
+    #                                lost resolution; raise sens_grid
 
 
 # retcode strings, role-equivalent to Symbol(sol.retcode) == :Success
@@ -77,6 +118,27 @@ _STATUS = {
     int(sdirk.DT_UNDERFLOW): "DtLessThanMin",
     int(sdirk.RUNNING): "Failure",
 }
+
+
+def _status_str(code):
+    """Status string for a solver code; unknown/future codes degrade to
+    ``"Failure(<code>)"`` instead of KeyError-ing a finished solve."""
+    return _STATUS.get(int(code)) or f"Failure({int(code)})"
+
+
+def _normalize_sens(sens):
+    """One validation point for the ``sens`` kwarg across every entry
+    form: False/None -> None (plain solve), True -> "hook" (legacy
+    return-the-problem-unsolved), "forward"/"adjoint" pass through, and
+    anything else is a loud error instead of a silently-false truthy."""
+    if sens is False or sens is None:
+        return None
+    if sens is True:
+        return "hook"
+    if sens in ("forward", "adjoint"):
+        return sens
+    raise ValueError(
+        f"sens must be False, True, 'forward' or 'adjoint'; got {sens!r}")
 
 
 def get_solution_vector(mole_fracs, molwt, T, p, ini_covg=None):
@@ -272,7 +334,7 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
                      rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
                      method=method, jac_window=jac_window)
     ts, ys, truncated = trim_trajectory(float(t0), y0, res)
-    return (_STATUS.get(int(res.status), "Failure"), float(res.t),
+    return (_status_str(res.status), float(res.t),
             np.asarray(res.y), ts, ys, truncated, int(res.n_accepted),
             int(res.n_rejected))
 
@@ -289,11 +351,190 @@ def _mode(chem):
     raise ValueError("at least one of surfchem/gaschem/userchem required")
 
 
+def _default_theta(gm, sm):
+    """(spec, theta) for the legacy ``sens=True`` hook: every reaction's
+    ln A of the primary mechanism (gas if present, else surface), or
+    (None, None) when no mechanism is in play (userchem)."""
+    from .sensitivity import params as sp_mod
+
+    mech = gm if gm is not None else sm
+    if mech is None:
+        return None, None
+    spec = sp_mod.select(mech)
+    return spec, sp_mod.extract(mech, spec)
+
+
+def _sensitivity_run(sens, mode, id_, y0, cfg, surf_species, *,
+                     sens_params, sens_qoi, sens_grid, rtol, atol,
+                     max_steps, kc_compat, asv_quirk, method, jac_window,
+                     backend, segmented, verbose):
+    """Solve WITH sensitivities (``sens="forward"|"adjoint"``) — the
+    CVODES capability the legacy hook only gestures at.  Returns a
+    :class:`SensitivitySolution`.  ``y0``/``cfg``/``surf_species`` come
+    from the caller (:func:`_file_driven_run`) so the sensitivity path
+    can never diverge from the plain solve's state construction."""
+    import sys
+
+    from .sensitivity import adjoint as adj_mod
+    from .sensitivity import forward as fwd_mod
+    from .sensitivity import params as sp_mod
+
+    if mode == "udf":
+        raise ValueError(
+            "sens='forward'/'adjoint' needs a mechanism-driven run: "
+            "user-defined chemistry has no named mechanism parameters")
+    if backend != "jax":
+        raise ValueError(
+            f"sens={sens!r} runs on the jax backend only (the native BDF "
+            f"runtime has no sensitivity support); got backend={backend!r}")
+    if method != "bdf":
+        raise ValueError(
+            f"sens={sens!r} rides the BDF step machinery; method={method!r}"
+            " is unsupported — drop the argument or pass method='bdf'")
+    if segmented is not None:
+        # loudness convention (cf. jac_window with backend='cpu'):
+        # sensitivity solves run monolithically — the tangent/adjoint
+        # state is not part of the segmented carry — so an explicit
+        # segmented= would be silently ignored otherwise
+        raise ValueError(
+            f"sens={sens!r} solves run monolithically; the tangent/"
+            f"adjoint state does not resume across segments — drop the "
+            f"segmented argument")
+    gm, sm, thermo = id_.gmd, id_.smd, id_.thermo
+
+    # ---- parameter selection: theta lives on ONE mechanism -----------------
+    if isinstance(sens_params, sp_mod.ParamSpec):
+        spec = sens_params
+    else:
+        mech = gm if gm is not None else sm
+        spec = sp_mod.select(mech, **dict(sens_params or {}))
+    if spec.kind == "gas":
+        if gm is None:
+            raise ValueError("gas-parameter spec on a run without gaschem")
+        theta = sp_mod.extract(gm, spec)
+
+        def mechs_at(th):
+            return sp_mod.apply(gm, th, spec), sm
+    else:
+        if sm is None:
+            raise ValueError("surface-parameter spec on a run without "
+                             "surfchem")
+        theta = sp_mod.extract(sm, spec)
+
+        def mechs_at(th):
+            return gm, sp_mod.apply(sm, th, spec)
+
+    # theta-parameterized RHS/Jacobian through the SAME mode dispatch the
+    # plain solve uses — the sensitivity programs differ from the solve
+    # program only by the tangent/adjoint machinery, never by physics
+    def rhs_theta(t, y, theta, cfg):
+        gmm, smm = mechs_at(theta)
+        return _make_rhs(mode, None, gmm, smm, thermo, kc_compat,
+                         asv_quirk)(t, y, cfg)
+
+    def jac_theta(t, y, theta, cfg):
+        gmm, smm = mechs_at(theta)
+        return _make_jac(mode, gmm, smm, thermo, kc_compat,
+                         asv_quirk)(t, y, cfg)
+
+    jac_window = resolve_jac_window(jac_window, method)
+    names = sp_mod.names(spec)
+
+    # ---- QoI resolution ----------------------------------------------------
+    qoi_fn = qoi_idx = None
+    if sens_qoi is not None:
+        if isinstance(sens_qoi, str):
+            idx = {s.upper(): k for k, s in enumerate(id_.species)}
+            key = sens_qoi.upper()
+            if key not in idx:
+                raise KeyError(f"sens_qoi species {sens_qoi!r} not in the "
+                               f"gas-phase species list")
+            qoi_idx = idx[key]
+            qoi_fn = adj_mod.final_species_qoi(qoi_idx)
+        elif (isinstance(sens_qoi, tuple) and sens_qoi
+              and sens_qoi[0] == "ignition"):
+            if sens == "forward":
+                raise ValueError(
+                    "ignition-delay QoIs need the trajectory-aware adjoint "
+                    "backward pass; use sens='adjoint'")
+            idx = {s.upper(): k for k, s in enumerate(id_.species)}
+            key = sens_qoi[1].upper()
+            if key not in idx:
+                raise KeyError(f"ignition marker {sens_qoi[1]!r} not in the "
+                               f"gas-phase species list")
+            frac = float(sens_qoi[2]) if len(sens_qoi) > 2 else 0.5
+            qoi_fn = adj_mod.ignition_delay_qoi(idx[key], frac=frac)
+        else:
+            raise ValueError(
+                f"sens_qoi must be a species name or ('ignition', marker"
+                f"[, frac]); got {sens_qoi!r}")
+
+    if sens == "forward":
+        def jac_fixed(t, y, cfg):
+            return jac_theta(t, y, theta, cfg)
+
+        # sens_errcon: the api path opts INTO tangent error control
+        # (CVODES errconS=True) — a few extra accepted steps buy ~2x
+        # tighter tangents, the right default for an entry point whose
+        # caller never sees the controller
+        res = fwd_mod.solve_forward(
+            rhs_theta, y0, 0.0, id_.tf, theta, cfg, rtol=rtol, atol=atol,
+            max_steps=max_steps, jac=jac_fixed, jac_window=jac_window,
+            sens_errcon=True)
+        S = res.tangents
+        qoi = qoi_grad = None
+        if qoi_idx is not None:
+            # final-state QoI from forward tangents is one chain-rule slice
+            qoi = float(res.y[qoi_idx])
+            _, unflat = sp_mod.flatten(theta)
+            qoi_grad = unflat(S[:, qoi_idx])
+        return SensitivitySolution(
+            status=_status_str(res.status), t=float(res.t),
+            y=np.asarray(res.y), species=id_.species,
+            surface_species=surf_species, spec=spec, theta=theta,
+            names=names, tangents=np.asarray(S), qoi=qoi,
+            qoi_grad=qoi_grad, n_accepted=int(res.n_accepted),
+            n_rejected=int(res.n_rejected))
+
+    # ---- adjoint -----------------------------------------------------------
+    if qoi_fn is None:
+        raise ValueError(
+            "sens='adjoint' differentiates a scalar QoI: pass "
+            "sens_qoi=<species name> (final mass density) or "
+            "sens_qoi=('ignition', marker_species[, frac])")
+    # segments is not an api knob: round the grid up to the adjoint's
+    # segment count so any sens_grid value works (the buffer size is a
+    # capacity, not a semantic)
+    sens_grid = max(8, -(-int(sens_grid) // 8) * 8)
+    qoi, grad, aux = adj_mod.solve_adjoint(
+        rhs_theta, qoi_fn, y0, 0.0, id_.tf, theta, cfg,
+        jac_theta=jac_theta, rtol=rtol, atol=atol, grid_size=sens_grid,
+        segments=8, max_steps=max_steps, jac_window=jac_window)
+    truncated = bool(aux["truncated"])
+    if truncated:
+        # unconditional (not verbose-gated): a truncated grid means the
+        # re-solve stopped short of t1 and the gradient is for the wrong
+        # horizon — the result also carries truncated=True
+        print(f"warning: adjoint grid buffer full (the grid-pinning pass "
+              f"accepted {int(aux['n_accepted'])} steps > sens_grid="
+              f"{sens_grid}); the fixed-grid re-solve lost resolution — "
+              f"raise sens_grid", file=sys.stderr)
+    return SensitivitySolution(
+        status=_status_str(aux["status"]), t=float(aux["t"]),
+        y=np.asarray(aux["y"]), species=id_.species,
+        surface_species=surf_species, spec=spec, theta=theta, names=names,
+        qoi=float(qoi), qoi_grad=grad,
+        n_accepted=int(aux["n_accepted"]),
+        n_rejected=int(aux["n_rejected"]), truncated=truncated)
+
+
 def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
                      max_steps, kc_compat, asv_quirk, verbose, backend,
-                     segmented=None, method="bdf", jac_window=None):
+                     segmented=None, method="bdf", jac_window=None,
+                     sens_params=None, sens_qoi=None, sens_grid=512):
     """Core driver: parse XML -> build RHS -> solve -> write profiles
-    (reference :152-217)."""
+    (reference :152-217).  ``sens`` arrives normalized (None / "hook" /
+    "forward" / "adjoint", :func:`_normalize_sens`)."""
     import sys
 
     from .utils.profiling import Phases
@@ -308,12 +549,24 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
            "Asv": jnp.asarray(id_.Asv, dtype=jnp.float64)}
     y0 = get_solution_vector(id_.mole_fracs, id_.thermo.molwt, id_.T, id_.p,
                              ini_covg=covg0)
-    if sens:
+    if sens in ("forward", "adjoint"):
+        # solve AND return sensitivities (sensitivity/ subsystem — the
+        # CVODES-parity path); no profile files, like the legacy hook
+        return _sensitivity_run(
+            sens, mode, id_, y0, cfg, surf_species,
+            sens_params=sens_params, sens_qoi=sens_qoi,
+            sens_grid=sens_grid, rtol=rtol, atol=atol, max_steps=max_steps,
+            kc_compat=kc_compat, asv_quirk=asv_quirk, method=method,
+            jac_window=jac_window, backend=backend, segmented=segmented,
+            verbose=verbose)
+    if sens == "hook":
         rhs = _make_rhs(mode, chem.udf, id_.gmd, id_.smd, id_.thermo,
                         kc_compat, asv_quirk)
+        spec, theta = _default_theta(id_.gmd, id_.smd)
         return SensitivityProblem(
             rhs=rhs, y0=y0, cfg=cfg, t_span=(0.0, id_.tf),
             species=id_.species, surface_species=surf_species,
+            theta=theta, spec=spec,
         )
 
     # the reference prints every accepted time to the terminal during the
@@ -672,7 +925,8 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   rtol=1e-6, atol=1e-10, n_save=16384, max_steps=200_000,
                   kc_compat=False, asv_quirk=True, verbose=True,
                   backend="jax", segmented=None, method="bdf",
-                  jac_window=None):
+                  jac_window=None, sens_params=None, sens_qoi=None,
+                  sens_grid=512):
     """Simulate an isothermal constant-volume batch reactor (three forms).
 
     Form 1 — file-driven:   ``batch_reactor(input_file, lib_dir,
@@ -705,7 +959,28 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
     one knob, one rule, both entry points.  An explicit ``jac_window``
     with ``backend="cpu"`` raises: the native runtime manages its own
     iteration matrix and would otherwise silently ignore it.
+
+    ``sens`` (file-driven forms; docs/sensitivity.md):
+
+    - ``False`` — plain solve (default).
+    - ``True`` — the reference's legacy hook: return the problem
+      *unsolved* as a :class:`SensitivityProblem` (now carrying the named
+      theta pytree + spec of the ``sensitivity`` subsystem).
+    - ``"forward"`` — solve with CVODES-style staggered forward tangents
+      riding the BDF loop; returns a :class:`SensitivitySolution` whose
+      ``tangents`` is the full (P, S) block dy(t_end)/dtheta.
+    - ``"adjoint"`` — solve, then reverse-differentiate a scalar QoI at
+      parameter-count-independent cost; needs ``sens_qoi``.
+
+    ``sens_params`` selects theta: ``None`` = every reaction's ln A of
+    the primary mechanism, a dict of ``sensitivity.params.select`` kwargs
+    (``fields=...``, ``reactions=...``), or a ready ``ParamSpec``.
+    ``sens_qoi`` is a gas species name (final-state mass density QoI) or
+    ``("ignition", marker[, frac])`` (adjoint only); ``sens_grid`` sizes
+    the adjoint's fixed re-solve grid.  Sensitivity runs are jax-backend,
+    BDF, monolithic (no segmentation), and write no profile files.
     """
+    sens = _normalize_sens(sens)
     if args and isinstance(args[0], dict):
         if len(args) != 4:
             raise TypeError(
@@ -713,6 +988,13 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                 "Asv=..., chem=..., thermo_obj=..., md=...)")
         if chem is None or thermo_obj is None or md is None:
             raise TypeError("programmatic form needs chem=, thermo_obj=, md=")
+        if sens is not None:
+            # the reference's programmatic method has no sens hook either
+            # (:86-147); silently ignoring it would report a plain solve
+            # as a sensitivity run
+            raise ValueError(
+                "sens is a file-driven-form knob; the programmatic "
+                "dict-in/dict-out form does not support it")
         return _programmatic_run(
             args[0], args[1], args[2], args[3], Asv=Asv, chem=chem,
             thermo_obj=thermo_obj, md=md, rtol=rtol, atol=atol,
@@ -726,7 +1008,9 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
             asv_quirk=asv_quirk, verbose=verbose, backend=backend,
-            segmented=segmented, method=method, jac_window=jac_window)
+            segmented=segmented, method=method, jac_window=jac_window,
+            sens_params=sens_params, sens_qoi=sens_qoi,
+            sens_grid=sens_grid)
 
     if len(args) == 2:
         if chem is None:
@@ -735,6 +1019,8 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             args[0], args[1], chem, sens, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
             asv_quirk=asv_quirk, verbose=verbose, backend=backend,
-            segmented=segmented, method=method, jac_window=jac_window)
+            segmented=segmented, method=method, jac_window=jac_window,
+            sens_params=sens_params, sens_qoi=sens_qoi,
+            sens_grid=sens_grid)
 
     raise TypeError(f"unrecognized batch_reactor argument pattern: {args!r}")

@@ -3,6 +3,7 @@ from .checkpoint import checkpointed_sweep, load_result, save_result
 from .grid import condition_grid, premixed_mole_fracs, sweep_solution_vectors
 from .sweep import (
     ensemble_solve,
+    ensemble_solve_forward,
     ensemble_solve_segmented,
     ignition_delay,
     ignition_observer,
@@ -16,6 +17,7 @@ __all__ = [
     "checkpointed_sweep",
     "condition_grid",
     "ensemble_solve",
+    "ensemble_solve_forward",
     "ensemble_solve_segmented",
     "ignition_delay",
     "ignition_observer",

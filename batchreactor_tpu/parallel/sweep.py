@@ -144,6 +144,65 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
     return jax.jit(jax.vmap(one, in_axes=(0, None, None, 0, None)))
 
 
+def ensemble_solve_forward(rhs_theta, y0s, t0, t1, theta, cfgs, *,
+                           mesh=None, axis="batch", rtol=1e-6, atol=1e-10,
+                           max_steps=200_000, jac=None, jac_window=1,
+                           linsolve="auto", sens_iters=2, S0=None):
+    """Forward-sensitivity ensemble sweep: one theta, per-lane conditions.
+
+    The sensitivity-aware twin of :func:`ensemble_solve` — each lane
+    integrates state + tangents S = dy/dtheta in one tangent-carrying BDF
+    program (``sensitivity.forward.solve_forward``), vmapped over the
+    batch and mesh-sharded exactly like the plain sweep.  This is the
+    per-reaction ignition/QoI sensitivity-ranking workload at ensemble
+    scale: ``result.tangents`` is (B, P, S) with tangent rows in
+    ``sensitivity.params.names`` order.
+
+    ``rhs_theta(t, y, theta, cfg)`` is the theta-parameterized RHS
+    (``sensitivity.params.make_rhs_theta``); ``theta`` is shared across
+    lanes (broadcast, not vmapped — the sweep answers "how does THIS
+    mechanism's ranking vary across conditions").  ``jac`` is the
+    analytic Jacobian at that theta.  Same callable-identity compile
+    caching rules as :func:`ensemble_solve`.
+    """
+    jitted = _cached_vsolve_forward(rhs_theta, rtol, atol, max_steps, jac,
+                                    jac_window, linsolve, sens_iters)
+    y0s = jnp.asarray(y0s)
+    t0 = jnp.asarray(t0, dtype=y0s.dtype)
+    t1 = jnp.asarray(t1, dtype=y0s.dtype)
+    if S0 is None:
+        from ..sensitivity.params import flatten
+
+        nP = flatten(theta)[0].shape[0]
+        S0 = jnp.zeros((nP, y0s.shape[1]), dtype=y0s.dtype)
+    if mesh is None:
+        return jitted(y0s, t0, t1, theta, cfgs, S0)
+    spec = NamedSharding(mesh, P(axis))
+    y0s = jax.device_put(y0s, spec)
+    cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
+    return jitted(y0s, t0, t1, theta, cfgs, S0)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_vsolve_forward(rhs_theta, rtol, atol, max_steps, jac,
+                           jac_window, linsolve, sens_iters):
+    """One compiled batched forward-sensitivity solve per (rhs_theta,
+    solver-settings) combination — same recompile economics as
+    :func:`_cached_vsolve`; theta enters as a traced operand so perturbed
+    re-runs (e.g. finite-difference validation sweeps) reuse the
+    executable."""
+
+    def one(y0, t0, t1, theta, cfg, S0):
+        from ..sensitivity.forward import solve_forward
+
+        return solve_forward(
+            rhs_theta, y0, t0, t1, theta, cfg, rtol=rtol, atol=atol,
+            max_steps=max_steps, jac=jac, jac_window=jac_window,
+            linsolve=linsolve, sens_iters=sens_iters, S0=S0)
+
+    return jax.jit(jax.vmap(one, in_axes=(0, None, None, None, 0, None)))
+
+
 def temperature_sweep(rhs, y0, T_grid, t1, base_cfg=None, **kw):
     """Convenience: one initial state swept over a temperature grid (the
     ignition-delay workload in BASELINE.json's batch_ch4 config)."""

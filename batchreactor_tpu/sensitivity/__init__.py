@@ -1,0 +1,45 @@
+"""Parameter-sensitivity subsystem: forward tangents, adjoint gradients,
+reaction ranking.
+
+The reference's ``sens=true`` hook returns the ODE problem *unsolved*
+(/root/reference/src/BatchReactor.jl:205-207) and leaves differentiation
+to the caller; Sundials users instead get CVODES forward sensitivities
+(``CVodeSensInit``) and checkpointed adjoints.  This package closes that
+capability gap natively in JAX, in pure ``lax`` control flow so every
+program jits, vmaps over ensemble lanes, and shards over the device mesh
+exactly like the plain solve:
+
+``params``
+    Named, differentiable parameter pytrees theta (gas Arrhenius A/beta/Ea,
+    surface A/Ea/sticking) extracted from the frozen mechanism bundles,
+    with ``apply(mech, theta, spec)`` splicing perturbed values back in.
+``forward``
+    CVODES-style staggered forward sensitivities: tangent difference
+    histories ride the existing variable-order BDF step machinery
+    (``solver.bdf.solve(tangent=...)``), and every sensitivity linear
+    solve reuses the step's already-built Newton iteration matrix.
+``adjoint``
+    Reverse-mode gradients of scalar QoIs at O(#params)-independent cost:
+    an adaptive forward pass pins the step grid, then a fixed-grid SDIRK4
+    re-solve — each implicit stage an implicit-function-theorem
+    ``custom_vjp`` — is differentiated backwards under ``jax.checkpoint``
+    segment rematerialization.
+``rank``
+    Normalized sensitivity coefficients d ln(QoI) / d ln(A_i) and top-k
+    reaction ranking (the ignition-delay sensitivity workload).
+
+Math contract and forward-vs-adjoint guidance: docs/sensitivity.md.
+"""
+
+from .params import ParamSpec, apply, extract, names, select  # noqa: F401
+from .forward import make_fdot, solve_forward  # noqa: F401
+from .adjoint import (final_species_qoi, ignition_delay_qoi,  # noqa: F401
+                      solve_adjoint)
+from .rank import normalized_sensitivities, top_k  # noqa: F401
+
+__all__ = [
+    "ParamSpec", "select", "extract", "apply", "names",
+    "make_fdot", "solve_forward",
+    "solve_adjoint", "final_species_qoi", "ignition_delay_qoi",
+    "normalized_sensitivities", "top_k",
+]

@@ -1,0 +1,268 @@
+"""Adjoint (reverse-mode) parameter gradients of scalar QoIs.
+
+Reverse mode cannot traverse the solver's adaptive ``lax.while_loop``
+(and a naive backsolve of a stiff chemistry ODE is unstable), so the
+adjoint here is discretize-then-optimize on a *pinned* grid, the
+checkpointed-adjoint shape CVODES calls ``CVodeAdjInit``:
+
+1. **Grid-pinning pass** — one plain adaptive BDF solve at
+   ``stop_gradient(theta)`` records its accepted-step times into the
+   fixed-size trajectory buffer.  The grid is frozen (zero cotangent):
+   gradients flow through solution *values*, never through step-size
+   control — exactly the quantity the discrete solution defines.
+2. **Differentiable re-solve** — a fixed-grid SDIRK4 sweep over those
+   knots (the L-stable tableau from ``solver.sdirk``), each implicit
+   stage an implicit-function-theorem ``jax.custom_vjp``: forward runs
+   Newton to convergence; backward solves ONE transposed linear system
+   ``(I - h gamma J)^T lam = zbar`` and pulls ``theta``/``cfg``
+   cotangents through a single RHS vjp — Newton's iteration history is
+   never differentiated or stored.  Padded (zero-width) grid slots are
+   exact no-ops, so the whole program is fixed-shape and jit/vmap-clean.
+3. **Checkpointing** — the step scan is chunked into segments with
+   ``jax.checkpoint``: the backward pass stores only segment-boundary
+   states and rematerializes in-segment stages, bounding live memory to
+   O(n_segments + segment_len) states.
+
+Cost of a gradient: one adaptive solve + one fixed-grid solve + one
+backward sweep — independent of the number of parameters.  That is the
+whole point: ranking every reaction of a large mechanism against one
+ignition-delay QoI is one backward pass, where forward sensitivities
+would pay P tangent rows (docs/sensitivity.md).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..solver import bdf
+from ..solver.linalg import make_solve_m
+from ..solver.sdirk import _A, _B, _C, _GAMMA
+from . import params as P
+
+
+def _resolve_linsolve(linsolve):
+    if linsolve == "auto":
+        return "lu" if jax.default_backend() == "cpu" else "inv32"
+    return linsolve
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _implicit_stage(fns, base, t_s, hg, theta, cfg):
+    """Solve the SDIRK stage equation z = base + hg * f(t_s, z) for z.
+
+    ``fns = (f, jacf, linsolve)`` is static; forward runs modified Newton
+    (iteration matrix factored once at the stage base), backward applies
+    the implicit function theorem — see module docstring."""
+    z, _ = _stage_newton(fns, base, t_s, hg, theta, cfg)
+    return z
+
+
+def _stage_newton(fns, base, t_s, hg, theta, cfg, max_iter=12):
+    f, jacf, linsolve = fns
+    M = jnp.eye(base.shape[0], dtype=base.dtype) - hg * jacf(
+        t_s, base, theta, cfg)
+    solve_m = make_solve_m(M, linsolve, base.dtype)
+    # displacement-based convergence on the state scale; tight because the
+    # backward pass assumes the stage equation holds at roundoff-ish level
+    scale = 1e-10 + 1e-8 * jnp.abs(base)
+
+    def cond(s):
+        _, it, done = s
+        return (~done) & (it < max_iter)
+
+    def body(s):
+        z, it, _ = s
+        g = z - base - hg * f(t_s, z, theta, cfg)
+        dz = solve_m(-g)
+        z2 = z + dz
+        dn = jnp.sqrt(jnp.mean(jnp.square(dz / scale)))
+        return z2, it + 1, (dn < 1e-3) | ~jnp.isfinite(dn)
+
+    z, it, _ = lax.while_loop(
+        cond, body, (base, jnp.asarray(0, dtype=jnp.int32),
+                     jnp.asarray(False)))
+    return z, it
+
+
+def _stage_fwd(fns, base, t_s, hg, theta, cfg):
+    z = _implicit_stage(fns, base, t_s, hg, theta, cfg)
+    return z, (z, t_s, hg, theta, cfg)
+
+
+def _stage_bwd(fns, res, zbar):
+    f, jacf, linsolve = fns
+    z, t_s, hg, theta, cfg = res
+    # IFT at the converged stage: (I - hg J) dz = dbase + hg f_theta dtheta
+    #   => base_bar = M^-T zbar;  theta_bar = hg f_theta^T (M^-T zbar)
+    J = jacf(t_s, z, theta, cfg)
+    MT = (jnp.eye(z.shape[0], dtype=z.dtype) - hg * J).T
+    lam = make_solve_m(MT, linsolve, z.dtype)(zbar)
+    _, fvjp = jax.vjp(lambda th, cf: f(t_s, z, th, cf), theta, cfg)
+    theta_bar, cfg_bar = fvjp(hg * lam)
+    # the grid (t_s, hg) is pinned by the grid-pass and carries no
+    # gradient by design (module docstring)
+    return (lam, jnp.zeros_like(t_s), jnp.zeros_like(hg), theta_bar,
+            cfg_bar)
+
+
+_implicit_stage.defvjp(_stage_fwd, _stage_bwd)
+
+
+def _sdirk_step(fns, y, t_prev, t_next, theta, cfg):
+    """One fixed-step SDIRK4 step from t_prev to t_next (no-op when the
+    slot is padding, t_next <= t_prev)."""
+    h = t_next - t_prev
+    live = h > 0
+    h_eff = jnp.where(live, h, 0.0)
+    h_safe = jnp.where(live, h, 1.0)
+    ks = []
+    for i, a_row in enumerate(_A):
+        base = y
+        for j in range(i):
+            base = base + h_eff * a_row[j] * ks[j]
+        t_s = t_prev + _C[i] * h_eff
+        z = _implicit_stage(fns, base, t_s, h_eff * _GAMMA, theta, cfg)
+        # k = f(t_s, z) at convergence, recovered without a second RHS
+        # eval; exactly 0 on padded slots (z == base there)
+        ks.append((z - base) / (h_safe * _GAMMA))
+    return y + h_eff * sum(b * k for b, k in zip(_B, ks))
+
+
+def _fixed_grid_solve(fns, y0, t_prev, t_next, theta, cfg, segments):
+    """Scan the fixed grid in ``segments`` checkpointed chunks; returns
+    (ys (N, n) states at the knots, y_final)."""
+    N = t_prev.shape[0]
+    if N % segments:
+        raise ValueError(f"grid size {N} not divisible by "
+                         f"segments={segments}")
+    tp = t_prev.reshape(segments, -1)
+    tn = t_next.reshape(segments, -1)
+
+    @jax.checkpoint
+    def segment(y, seg):
+        tps, tns = seg
+
+        def step(yc, knots):
+            y2 = _sdirk_step(fns, yc, knots[0], knots[1], theta, cfg)
+            return y2, y2
+
+        return lax.scan(step, y, (tps, tns))
+
+    y_final, ys = lax.scan(segment, y0, (tp, tn))
+    return ys.reshape(N, -1), y_final
+
+
+def final_species_qoi(index):
+    """QoI builder: final-state component ``y(t1)[index]`` (a species mass
+    density, or a coverage for indices past n_gas)."""
+
+    def qoi(tk, ys, y_final):
+        return y_final[index]
+
+    return qoi
+
+
+def ignition_delay_qoi(marker, frac=0.5):
+    """QoI builder: ignition delay as the interpolated first crossing of
+    the marker species below ``frac`` x its first-grid-point value (the
+    fuel-consumption marker of ``parallel.ignition_observer``; the
+    crossing *index* is piecewise-constant in theta and stop-gradiented —
+    gradients flow through the bracketing values)."""
+
+    def qoi(tk, ys, y_final):
+        m = ys[:, marker]
+        thr = frac * m[0]
+        below = m < thr
+        j = lax.stop_gradient(jnp.maximum(jnp.argmax(below), 1))
+        m_hi, m_lo = m[j - 1], m[j]
+        t_hi, t_lo = tk[j - 1], tk[j]
+        denom = m_hi - m_lo
+        w = jnp.clip(jnp.where(denom != 0, (m_hi - thr) / denom, 1.0),
+                     0.0, 1.0)
+        # NaN where the marker never crossed (same contract as
+        # parallel.ignition_observer) — a silent tau == last-knot value
+        # would also carry a silently-zero gradient (clipped w)
+        return jnp.where(jnp.any(below), t_hi + w * (t_lo - t_hi),
+                         jnp.nan)
+
+    return qoi
+
+
+def solve_adjoint(rhs_theta, qoi_fn, y0, t0, t1, theta, cfg, *,
+                  jac_theta=None, rtol=1e-6, atol=1e-10, grid_size=256,
+                  segments=8, grid_refine=2, max_steps=100_000,
+                  jac_window=1, linsolve="auto", dt0=None):
+    """Gradient of a scalar QoI with respect to theta, adjoint-style.
+
+    ``rhs_theta(t, y, theta, cfg)`` / optional ``jac_theta(t, y, theta,
+    cfg)`` are the theta-parameterized RHS/Jacobian
+    (``params.make_rhs_theta``); ``qoi_fn(tk, ys, y_final) -> scalar``
+    consumes the knot times, the (grid_size, n) knot states and the final
+    state (builders: :func:`final_species_qoi`,
+    :func:`ignition_delay_qoi`).
+
+    Returns ``(qoi, grad, aux)``: ``grad`` is a theta-shaped pytree, and
+    ``aux`` carries the grid-pass SolveResult fields a caller should
+    check — ``status`` and ``truncated`` (True when the adaptive pass
+    accepted more steps than ``grid_size``; enlarge ``grid_size``, the
+    re-solve grid silently loses resolution otherwise).
+
+    ``grid_refine=r`` subdivides every adaptive step into r equal
+    SDIRK4 substeps in the re-solve (local error / r^5 at ~r x stage
+    cost): the pinned grid was sized for the BDF pass's error, not
+    SDIRK4's, and one refinement level keeps the re-solve's
+    discretization error comfortably below the grid-pass tolerance.
+
+    Pure lax control flow end to end: jit it, vmap it over lanes, shard
+    the vmapped batch — no host callbacks anywhere.
+    """
+    linsolve = _resolve_linsolve(linsolve)
+    theta0 = jax.tree.map(lax.stop_gradient, theta)
+
+    def rhs0(t, y, cfg):
+        return rhs_theta(t, y, theta0, cfg)
+
+    jac0 = None
+    if jac_theta is not None:
+        def jac0(t, y, cfg):
+            return jac_theta(t, y, theta0, cfg)
+
+    prim = bdf.solve(rhs0, jnp.asarray(y0), t0, t1, cfg, rtol=rtol,
+                     atol=atol, max_steps=max_steps, n_save=grid_size,
+                     jac=jac0, jac_window=jac_window, linsolve=linsolve,
+                     dt0=dt0)
+    t1 = jnp.asarray(t1, dtype=prim.ts.dtype)
+    tk = jnp.minimum(lax.stop_gradient(prim.ts), t1)  # inf pads -> t1
+    t_prev = jnp.concatenate(
+        [jnp.reshape(jnp.asarray(t0, dtype=tk.dtype), (1,)), tk[:-1]])
+    t_next = tk
+    if grid_refine > 1:
+        # equal subdivision of every slot; padded (zero-width) slots
+        # subdivide into zero-width slots — still exact no-ops
+        r = int(grid_refine)
+        w = (jnp.arange(r, dtype=tk.dtype) / r)[None, :]
+        h = (t_next - t_prev)[:, None]
+        starts = t_prev[:, None] + h * w                       # (N, r)
+        ends = jnp.concatenate([starts[:, 1:], t_next[:, None]], axis=1)
+        t_prev, t_next = starts.reshape(-1), ends.reshape(-1)
+
+    if jac_theta is not None:
+        jacf = jac_theta
+    else:
+        def jacf(t, z, th, cf):
+            return jax.jacfwd(lambda zz: rhs_theta(t, zz, th, cf))(z)
+
+    fns = (rhs_theta, jacf, linsolve)
+
+    def qoi_of(theta_):
+        ys, y_final = _fixed_grid_solve(fns, jnp.asarray(y0), t_prev,
+                                        t_next, theta_, cfg, segments)
+        return qoi_fn(t_next, ys, y_final)
+
+    qoi, grad = jax.value_and_grad(qoi_of)(theta)
+    aux = {"status": prim.status, "t": prim.t, "y": prim.y,
+           "n_accepted": prim.n_accepted, "n_rejected": prim.n_rejected,
+           "truncated": prim.n_accepted > grid_size, "ts": tk}
+    return qoi, grad, aux

@@ -1,0 +1,90 @@
+"""Forward parameter sensitivities: CVODES-style staggered tangents.
+
+Solves the tangent ODE
+
+    dS_p/dt = J(t, y) S_p + df/dtheta_p,      S_p(t0) = dy0/dtheta_p
+
+alongside the state, one row per scalar parameter, inside the SAME
+variable-order BDF step loop as the plain solve (``solver.bdf.solve``'s
+``tangent=`` hook): the tangents share the state's step grid, order and
+difference-history transforms, and every sensitivity linear solve reuses
+the step's already-built Newton iteration matrix — no second Jacobian
+build, no separate integration.  Everything is ``lax`` control flow, so a
+forward-sensitivity solve jits, vmaps over ensemble lanes and shards over
+the mesh exactly like the plain solve (the property a naive
+``jax.jacfwd`` over the whole solver loses: it multiplies the while-loop
+carry by P *and* re-runs Newton per tangent, and is the memory-hostile
+path the ISSUE retires).
+
+Cost: P extra RHS-jvp evaluations plus P triangular solves per accepted
+step — linear in #params, like CVODES ``CVodeSensInit``.  For gradients
+of a *scalar* QoI with many parameters, use :mod:`.adjoint` instead
+(docs/sensitivity.md has the decision table).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..solver import bdf
+from . import params as P
+
+
+def make_fdot(rhs_theta, theta, cfg):
+    """Sensitivity-RHS factory: ``fdot(t, y, S) -> (P, n)`` with rows
+    J(t, y) S_p + df/dtheta_p, evaluated as one jvp per tangent row
+    (vmapped) — exact to roundoff, never materializes J, and costs about
+    one RHS evaluation per row.
+
+    ``rhs_theta(t, y, theta, cfg)`` is the theta-parameterized RHS
+    (``params.make_rhs_theta``); ``theta`` is the dict pytree the tangent
+    rows are ordered against (``params.flatten`` order, i.e.
+    ``params.names``).
+    """
+    theta_flat, unflatten = P.flatten(theta)
+    nP = theta_flat.shape[0]
+    eyeP = jnp.eye(nP, dtype=theta_flat.dtype)
+
+    def fdot(t, y, S):
+        def one(s_row, e_row):
+            _, dy = jax.jvp(
+                lambda yy, tf: rhs_theta(t, yy, unflatten(tf), cfg),
+                (y, theta_flat), (s_row, e_row))
+            return dy
+
+        return jax.vmap(one)(S, eyeP)
+
+    return fdot
+
+
+def solve_forward(rhs_theta, y0, t0, t1, theta, cfg, *, rtol=1e-6,
+                  atol=1e-10, max_steps=100_000, n_save=0, dt0=None,
+                  jac=None, jac_window=1, linsolve="auto", sens_iters=2,
+                  sens_errcon=False, observer=None, observer_init=None,
+                  S0=None, step_audit=False):
+    """Integrate state + forward sensitivities in one BDF solve.
+
+    Returns the plain :class:`~..solver.sdirk.SolveResult` with
+    ``tangents`` filled: a (P, n) block S = dy(t_end)/dtheta whose row
+    order is ``params.flatten``/``params.names`` order of ``theta``.
+
+    ``jac`` is the analytic state Jacobian at the *given* theta (build it
+    from ``params.apply(mech, theta, spec)`` — api.py does); ``S0``
+    overrides the zero initial tangents when y0 depends on theta.
+    Remaining knobs mirror ``bdf.solve``.
+    """
+    theta_flat, _ = P.flatten(theta)
+    nP = theta_flat.shape[0]
+    y0 = jnp.asarray(y0)
+    if S0 is None:
+        S0 = jnp.zeros((nP, y0.shape[0]), dtype=y0.dtype)
+    fdot = make_fdot(rhs_theta, theta, cfg)
+
+    def rhs(t, y, cfg):
+        return rhs_theta(t, y, theta, cfg)
+
+    return bdf.solve(
+        rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
+        n_save=n_save, dt0=dt0, jac=jac, jac_window=jac_window,
+        linsolve=linsolve, observer=observer, observer_init=observer_init,
+        tangent=(fdot, S0), sens_iters=sens_iters,
+        sens_errcon=sens_errcon, step_audit=step_audit)

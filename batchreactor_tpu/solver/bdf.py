@@ -91,6 +91,12 @@ def _masked_row_sum(D, weights, order, lo=0):
     return w @ D.reshape(_ROWS, -1)
 
 
+def _change_DS(DS, order, factor):
+    """:func:`_change_D` over a (ROWS, P, n) tangent history: the transform
+    acts on the row axis only, so the (P, n) tail flattens through."""
+    return _change_D(DS.reshape(_ROWS, -1), order, factor).reshape(DS.shape)
+
+
 def solve(
     rhs,
     y0,
@@ -113,6 +119,10 @@ def solve(
     solver_state=None,
     jac_window=1,
     freeze_precond=False,
+    tangent=None,
+    sens_iters=2,
+    sens_errcon=False,
+    step_audit=False,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` with BDF(1..5).
 
@@ -144,6 +154,38 @@ def solve(
     (quasi-Newton: convergence rate degrades, displacement test gates), so
     accuracy is untouched at tau level; per-attempt cost drops by one
     (B, n, n) inverse construction.
+
+    ``tangent=(fdot, S0)`` activates CVODES-style staggered forward
+    sensitivities (sensitivity/forward.py): a (P, n) tangent block
+    S = dy/dtheta rides the solve in its own backward-difference history,
+    stepped with the state — same predictor, same order, same h.  After
+    each state Newton converges, the sensitivity corrector
+    ``(I - cJ) d_S = c (J S_pred + df/dtheta) - psi_S`` is solved with
+    the attempt's ALREADY-BUILT iteration-matrix solver (no second
+    Jacobian build — CVODES's staggered-corrector economy), iterated
+    ``sens_iters`` fixed sweeps to absorb iteration-matrix staleness
+    (jac_window / freeze_precond).  ``fdot(t, y, S) -> (P, n)`` supplies
+    the exact sensitivity RHS rows J(t,y) S_p + df/dtheta_p (one jvp per
+    row, forward.make_fdot); ``S0`` is the (P, n) initial tangent block
+    (zeros unless y0 depends on theta).  By default tangent error is NOT
+    added to the step controller (CVODES errconS=False analog): the
+    state grid is unchanged, so a plain solve and its tangent-carrying
+    twin accept the same steps; ``sens_errcon=True`` joins the tangent
+    local error into the controller (errconS=True).  Either way tangent
+    ACCURACY rides the step grid and degrades faster than the state's
+    as rtol loosens (growing sensitivity modes amplify accumulated
+    truncation — local control cannot see that); run sensitivity studies
+    at rtol <= 1e-8 for ~1e-3 tangent accuracy (docs/sensitivity.md).
+    Incompatible with ``solver_state`` resume.  Results land in
+    ``SolveResult.tangents``.
+
+    ``step_audit=True`` additionally surfaces the last Newton iteration
+    matrix M = I - cJ (``SolveResult.it_matrix``; factor it with
+    ``linalg.make_solve_m`` — the factorization *form* is a linsolve-mode
+    detail, f32 inverse on TPU vs LU on CPU) and a 64-slot int8 ring of
+    recent attempt outcomes keyed by attempt count mod 64
+    (``SolveResult.accept_ring``, 1 = accepted) — PERF.md-style step-
+    pattern debugging without re-tracing.
     """
     y0 = jnp.asarray(y0)
     n = y0.shape[0]
@@ -172,6 +214,13 @@ def solve(
         raise ValueError(
             "freeze_precond requires jac_window > 1 (with a window of 1 "
             "the preconditioner is rebuilt with J anyway)")
+    if tangent is not None and solver_state is not None:
+        raise ValueError(
+            "tangent propagation cannot resume from solver_state: the "
+            "tangent difference history is not part of the segmented "
+            "carry — run forward-sensitivity solves monolithically")
+    if sens_iters < 1:
+        raise ValueError(f"sens_iters must be >= 1, got {sens_iters}")
 
     f = functools.partial(rhs, cfg=cfg)
     if jac is None:
@@ -212,6 +261,15 @@ def solve(
         order_init = jnp.where(cold, 1, order_prev).astype(jnp.int32)
         h_init = jnp.where(cold, h_init, h_prev)
         nequal_init = jnp.where(cold, 0, nequal_prev).astype(jnp.int32)
+
+    if tangent is not None:
+        fdot, S0 = tangent
+        S0 = jnp.asarray(S0, dtype=y0.dtype)
+        if S0.ndim != 2 or S0.shape[1] != n:
+            raise ValueError(f"tangent S0 must be (P, {n}), got {S0.shape}")
+        # tangent history mirrors the state's: DS[0] = S, DS[1] = h * dS/dt
+        DS_init = jnp.zeros((_ROWS,) + S0.shape, dtype=y0.dtype)
+        DS_init = DS_init.at[0].set(S0).at[1].set(h_init * fdot(t0, y0, S0))
 
     n_save_buf = max(n_save, 1)
     ts_buf = jnp.full((n_save_buf,), jnp.inf, dtype=y0.dtype)
@@ -269,7 +327,13 @@ def solve(
         affects the quasi-Newton convergence RATE, which the displacement
         test gates (same argument as the inv32* preconditioners)."""
         (t, D, order, h, n_equal, status, n_acc, n_rej, ts, ys, n_saved,
-         obs) = carry
+         obs) = carry[:12]
+        k = 12
+        if tangent is not None:
+            DS = carry[k]
+            k += 1
+        if step_audit:
+            ring, M_last = carry[k], carry[k + 1]
         running = status == RUNNING
         # zero-span guard: a lane already at t1 (parked segmented re-entry,
         # or t0 == t1 callers) succeeds immediately, touching nothing — its
@@ -283,6 +347,11 @@ def solve(
                                 (t1 - t) / h, 1.0)
         factor_clip = jnp.maximum(factor_clip, 1e-14)
         D = jnp.where(factor_clip < 1.0, _change_D(D, order, factor_clip), D)
+        if tangent is not None:
+            # the tangent history shares the state's step grid: every
+            # rescale of D applies identically to DS
+            DS = jnp.where(factor_clip < 1.0,
+                           _change_DS(DS, order, factor_clip), DS)
         h = h * factor_clip
         n_equal = jnp.where(factor_clip < 1.0, 0, n_equal)
 
@@ -303,13 +372,43 @@ def solve(
             # c == c0, and the quasi-Newton fixed point is preconditioner-
             # independent so only the convergence rate feels the drift
             solve0, c0 = pre
+            M = eye - c0 * J if step_audit else None
             cj_fac = 2.0 / (1.0 + c / c0)
 
             def solve_m(b):
                 return solve0(b) * cj_fac
         d, conv = newton(solve_m, t_new, y_pred, psi, c, scale)
 
+        if tangent is not None:
+            # staggered sensitivity corrector: solve
+            #   (I - cJ) d_S = c (J S_new + df/dtheta) - psi_S
+            # per tangent row with the attempt's ALREADY-FACTORED solver —
+            # the equation is linear in d_S, so with an exact M one sweep
+            # is exact; extra sweeps are fixed-point refinement against
+            # iteration-matrix staleness (jac_window / freeze_precond /
+            # f32-preconditioner modes)
+            S_pred = _masked_row_sum(DS, jnp.ones((_ROWS,), y0.dtype),
+                                     order).reshape(DS.shape[1:])
+            psi_S = (_masked_row_sum(DS, gamma_tab, order, lo=1)
+                     / gam).reshape(DS.shape[1:])
+            y_cand = y_pred + d
+            dS = jnp.zeros_like(S_pred)
+            for _ in range(sens_iters):  # static unroll
+                FS = fdot(t_new, y_cand, S_pred + dS)
+                dS = dS + jax.vmap(solve_m)(c * FS - psi_S - dS)
+
         err = _scaled_norm(errc_tab[order] * d, y_pred, rtol, atol)
+        if tangent is not None and sens_errcon:
+            # CVODES errconS=True analog: the tangent local error joins
+            # the step controller, so h shrinks where the sensitivity
+            # demands it.  Tangent components are scaled against the
+            # LARGEST tangent row magnitude (not atol): tangents start at
+            # exactly 0 and have no natural atol floor — a per-component
+            # absolute test would crush h at startup for nothing.
+            s_floor = 1e-8 * jnp.max(jnp.abs(S_pred) + jnp.abs(dS)) + atol
+            err_S = _scaled_norm(errc_tab[order] * dS, S_pred, rtol,
+                                 s_floor)
+            err = jnp.maximum(err, err_S)
         accept = conv & (err <= 1.0) & jnp.isfinite(err) & running & ~already
 
         # ---- rejected: shrink h (newton failure: halve; error: PI-free
@@ -331,6 +430,17 @@ def solve(
         take = (kidx >= ridx) & (kidx <= (order + 1)) & (ridx <= order)
         D_summed = jnp.where(take, 1.0, 0.0) @ D_acc
         D_acc = jnp.where(ridx <= order, D_summed, D_acc)
+
+        if tangent is not None:
+            # identical difference update for the tangent history (flat
+            # (ROWS, P*n) view; ridx/kidx/take masks are row-axis only)
+            DSf = DS.reshape(_ROWS, -1)
+            dSf = dS.reshape(-1)
+            DSq1 = jnp.take(DSf, order + 1, axis=0)
+            DS_acc = jnp.where(ridx == order + 2, (dSf - DSq1)[None, :], DSf)
+            DS_acc = jnp.where(ridx == order + 1, dSf[None, :], DS_acc)
+            DS_acc = jnp.where(ridx <= order,
+                               jnp.where(take, 1.0, 0.0) @ DS_acc, DS_acc)
 
         y_new = D_acc[0]
         n_equal_acc = n_equal + 1
@@ -366,6 +476,11 @@ def solve(
         D_base = jnp.where(accept, D_acc, D)
         D_new = jnp.where(factor != 1.0,
                           _change_D(D_base, order_new, factor), D_base)
+        if tangent is not None:
+            DS_base = jnp.where(accept, DS_acc, DSf)
+            DS_new = jnp.where(factor != 1.0,
+                               _change_D(DS_base, order_new, factor),
+                               DS_base)
         h_new = h * factor
         n_equal_new = jnp.where(accept & ~sel, n_equal_acc, 0)
 
@@ -378,6 +493,8 @@ def solve(
         # and the segmented driver's resume state)
         hold = ~running | already
         D_new = jnp.where(hold, D, D_new)
+        if tangent is not None:
+            DS_new = jnp.where(hold, DSf, DS_new).reshape(DS.shape)
         h_new = jnp.where(hold, h, h_new)
         order_new = jnp.where(hold, order, order_new)
         n_equal_new = jnp.where(hold, n_equal, n_equal_new)
@@ -405,8 +522,18 @@ def solve(
         ).astype(jnp.int32)
         status2 = jnp.where(running, status2, status)
         newton_failed = running & ~already & ~conv
-        return (t_out, D_new, order_new, h_new, n_equal_new, status2,
-                n_acc2, n_rej2, ts2, ys2, n_saved2, obs), newton_failed
+        out = (t_out, D_new, order_new, h_new, n_equal_new, status2,
+               n_acc2, n_rej2, ts2, ys2, n_saved2, obs)
+        if tangent is not None:
+            out = out + (DS_new,)
+        if step_audit:
+            live = running & ~already
+            slot = (n_acc + n_rej) % ring.shape[0]
+            ring2 = ring.at[slot].set(
+                jnp.where(live, accept.astype(ring.dtype), ring[slot]))
+            M_last2 = jnp.where(live, M, M_last)
+            out = out + (ring2, M_last2)
+        return out, newton_failed
 
     def cond(carry):
         return carry[5] == RUNNING
@@ -467,12 +594,26 @@ def solve(
     init = (t0, D_init, order_init, h_init, nequal_init,
             jnp.asarray(RUNNING, dtype=jnp.int32), zero, zero,
             ts_buf, ys_buf, zero, obs0)
+    if tangent is not None:
+        init = init + (DS_init,)
+    if step_audit:
+        init = init + (jnp.full((64,), -1, dtype=jnp.int8),
+                       jnp.zeros((n, n), dtype=y0.dtype))
+    final = lax.while_loop(cond, body, init)
     (t, D, order, h, n_equal, status, n_acc, n_rej, ts, ys, n_saved,
-     obs) = lax.while_loop(cond, body, init)
+     obs) = final[:12]
+    k = 12
+    tangents = None
+    if tangent is not None:
+        tangents = final[k][0]  # DS row 0 is S = dy/dtheta, (P, n)
+        k += 1
+    ring_out, M_out = (final[k], final[k + 1]) if step_audit else (None,
+                                                                   None)
     return SolveResult(
         t=t, y=D[0], status=status, n_accepted=n_acc, n_rejected=n_rej,
         ts=ts, ys=ys, n_saved=n_saved, h=h,
         observed=obs if observer is not None else None,
         err_prev=jnp.asarray(1.0, dtype=y0.dtype),
         solver_state=(D, order, h, n_equal),
+        tangents=tangents, it_matrix=M_out, accept_ring=ring_out,
     )

@@ -67,6 +67,12 @@ class SolveResult:
     err_prev: jnp.ndarray = None  # PI controller memory (segmented resume)
     solver_state: object = None  # opaque multistep carry (solver/bdf.py);
     #                              None for the single-step SDIRK
+    tangents: jnp.ndarray = None  # (P, n) forward sensitivities dy/dtheta
+    #                               (bdf.solve tangent= hook; else None)
+    it_matrix: jnp.ndarray = None  # (n, n) last Newton iteration matrix
+    #                                M = I - c J (bdf step_audit=True)
+    accept_ring: jnp.ndarray = None  # (64,) int8 ring of recent attempt
+    #                                  outcomes, 1=accept (step_audit=True)
 
 
 def _scaled_norm(e, y, rtol, atol):

@@ -27,10 +27,15 @@ OUT = REPO / "docs" / "api.md"
 SECTIONS = [
     ("Top-level API", "batchreactor_tpu",
      ["batch_reactor", "batch_reactor_sweep", "Chemistry",
-      "SensitivityProblem", "compile_gaschemistry", "compile_mech",
-      "create_thermo", "input_data"]),
+      "SensitivityProblem", "SensitivitySolution", "compile_gaschemistry",
+      "compile_mech", "create_thermo", "input_data"]),
+    ("Parameter sensitivities", "batchreactor_tpu.sensitivity",
+     ["select", "extract", "apply", "names", "ParamSpec", "make_fdot",
+      "solve_forward", "solve_adjoint", "final_species_qoi",
+      "ignition_delay_qoi", "normalized_sensitivities", "top_k"]),
     ("Ensemble & distributed sweeps", "batchreactor_tpu.parallel",
-     ["ensemble_solve", "ensemble_solve_segmented", "checkpointed_sweep",
+     ["ensemble_solve", "ensemble_solve_forward",
+      "ensemble_solve_segmented", "checkpointed_sweep",
       "temperature_sweep", "make_mesh", "pad_batch", "condition_grid",
       "premixed_mole_fracs", "sweep_solution_vectors", "ignition_observer",
       "ignition_delay", "sweep_report", "save_result", "load_result"]),

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Top-k reaction sensitivity ranking for a batch-reactor input file.
+
+The CLI face of the sensitivity subsystem (docs/sensitivity.md): solve
+the run described by a reference-format ``batch.xml``, differentiate a
+scalar QoI with respect to the selected mechanism parameters, and print
+the normalized coefficients d ln(QoI)/d ln(A_i) ranked by magnitude.
+
+  python scripts/sens_rank.py INPUT.xml LIB_DIR --qoi H2O
+  python scripts/sens_rank.py INPUT.xml LIB_DIR --qoi ignition:OH \\
+      --mode adjoint -k 15
+  python scripts/sens_rank.py INPUT.xml LIB_DIR --qoi H2O \\
+      --reactions '*H2O2*' --surf
+
+``--mode adjoint`` (default) costs one backward pass regardless of how
+many reactions are ranked; ``--mode forward`` propagates one tangent row
+per parameter (exact same answers, linear-in-P cost) — see the decision
+table in docs/sensitivity.md.
+"""
+
+import argparse
+import os
+import sys
+
+# runnable from a source checkout without an install, like scripts/brlint.py
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="sens_rank",
+        description="rank reactions by normalized QoI sensitivity "
+                    "(d ln QoI / d ln A)")
+    p.add_argument("input_xml", help="reference-format batch.xml")
+    p.add_argument("lib_dir", help="mechanism library directory")
+    p.add_argument("--qoi", required=True,
+                   help="species name (final mass-density QoI) or "
+                        "'ignition:MARKER[:FRAC]' (adjoint only)")
+    p.add_argument("--mode", choices=("adjoint", "forward"),
+                   default="adjoint")
+    p.add_argument("--gas", action="store_true", default=True,
+                   help="gas-phase chemistry (default)")
+    p.add_argument("--no-gas", dest="gas", action="store_false")
+    p.add_argument("--surf", action="store_true",
+                   help="surface chemistry (combine with --gas for "
+                        "coupled)")
+    p.add_argument("--fields", default="log_A",
+                   help="comma-separated theta fields (default log_A; "
+                        "ranking normalizes log_A only)")
+    p.add_argument("--reactions", default=None,
+                   help="reaction selection glob (default: all)")
+    p.add_argument("-k", type=int, default=10, help="rows to print")
+    p.add_argument("--rtol", type=float, default=1e-6)
+    p.add_argument("--atol", type=float, default=1e-10)
+    p.add_argument("--sens-grid", type=int, default=512,
+                   help="adjoint fixed re-solve grid size")
+    return p
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+
+    # host-first import discipline (scripts/brlint.py): pin CPU unless the
+    # operator asked for an accelerator — ranking a fixture mechanism must
+    # not hang on a wedged tunneled TPU
+    os.environ.setdefault("BR_PLATFORM", os.environ.get("JAX_PLATFORMS",
+                                                        "cpu"))
+    import batchreactor_tpu as br
+    from batchreactor_tpu.sensitivity import rank
+
+    qoi = args.qoi
+    if qoi.lower().startswith("ignition:"):
+        parts = qoi.split(":")
+        qoi = ("ignition", parts[1]) if len(parts) == 2 else (
+            "ignition", parts[1], float(parts[2]))
+    fields = tuple(f.strip() for f in args.fields.split(",") if f.strip())
+    sens_params = {"fields": fields}
+    if args.reactions is not None:
+        sens_params["reactions"] = args.reactions
+
+    sol = br.batch_reactor(
+        args.input_xml, args.lib_dir, gaschem=args.gas,
+        surfchem=args.surf, sens=args.mode, sens_qoi=qoi,
+        sens_params=sens_params, sens_grid=args.sens_grid,
+        rtol=args.rtol, atol=args.atol, verbose=False)
+    if sol.status != "Success":
+        print(f"sens_rank: solve ended with {sol.status}", file=sys.stderr)
+        return 1
+    if getattr(sol, "truncated", False):
+        print("sens_rank: adjoint grid overflowed — the ranking below is "
+              "for a shortened horizon; re-run with a larger --sens-grid",
+              file=sys.stderr)
+        return 1
+    if sol.qoi_grad is None or "log_A" not in sol.qoi_grad:
+        print("sens_rank: no log_A gradient to rank (include log_A in "
+              "--fields)", file=sys.stderr)
+        return 2
+    coeffs = rank.normalized_sensitivities(sol.qoi, sol.qoi_grad["log_A"])
+    qoi_name = args.qoi if isinstance(args.qoi, str) else "tau_ign"
+    print(f"QoI = {float(sol.qoi):.6e}  "
+          f"({sol.spec.n_reactions} reactions ranked, mode={args.mode})")
+    print(rank.format_ranking(rank.top_k(coeffs, sol.spec.equations,
+                                         k=args.k), qoi_name=qoi_name))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

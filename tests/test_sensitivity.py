@@ -1,0 +1,392 @@
+"""Sensitivity subsystem tests (ISSUE 2 acceptance gates).
+
+Covers: params select/extract/apply round-trips; staggered forward
+tangents vs central finite differences on the vendored h2o2 fixture
+(tol-tiered); adjoint-vs-forward gradient consistency on a scalar QoI;
+the vmapped 8-lane forward-sensitivity sweep; the ``sens=`` kwarg
+surface of ``batch_reactor`` (validation, legacy-hook theta, solved
+forward/adjoint returns); the unknown-status-code fallback; and the
+``scripts/sens_rank.py`` CLI.
+
+Everything runs on the CPU backend (conftest pins it) against
+tests/fixtures — no reference checkout needed.
+"""
+
+import functools
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import batchreactor_tpu as br
+from batchreactor_tpu.models.gas import compile_gaschemistry
+from batchreactor_tpu.models.thermo import create_thermo
+from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+from batchreactor_tpu.sensitivity import adjoint, forward, params, rank
+from batchreactor_tpu.solver import bdf
+from batchreactor_tpu.solver.sdirk import SUCCESS
+from batchreactor_tpu.utils.composition import density, mole_to_mass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shared fixture mechanism state (module-scoped: parsed once)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def h2o2(fixtures_dir):
+    gm = compile_gaschemistry(os.path.join(fixtures_dir, "h2o2.dat"))
+    th = create_thermo(list(gm.species), os.path.join(fixtures_dir,
+                                                      "therm.dat"))
+    sp = list(gm.species)
+    x = np.zeros(len(sp))
+    x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = 0.3, 0.2, 0.5
+    x = jnp.asarray(x, dtype=jnp.float64)
+    y0 = density(x, th.molwt, 1100.0, 1e5) * mole_to_mass(x, th.molwt)
+    cfg = {"T": jnp.asarray(1100.0, dtype=jnp.float64),
+           "Asv": jnp.asarray(1.0, dtype=jnp.float64)}
+    return gm, th, sp, y0, cfg
+
+
+@pytest.fixture(scope="module")
+def h2o2_theta(h2o2):
+    """3-reaction log_A selection + theta-parameterized RHS/Jacobian —
+    small P keeps the forward tangent block (and FD loop) cheap."""
+    gm, th, sp, y0, cfg = h2o2
+    spec = params.select(gm, fields=("log_A",), reactions=(1, 2, 4))
+    theta = params.extract(gm, spec)
+    rhs_theta = params.make_rhs_theta(gm, spec,
+                                      lambda m: make_gas_rhs(m, th))
+
+    def jac_theta(t, y, theta, cfg):
+        return make_gas_jac(params.apply(gm, theta, spec), th)(t, y, cfg)
+
+    return spec, theta, rhs_theta, jac_theta
+
+
+# ---------------------------------------------------------------------------
+# params: the theta layer
+# ---------------------------------------------------------------------------
+def test_select_extract_apply_roundtrip(h2o2):
+    gm, *_ = h2o2
+    spec = params.select(gm, fields=("log_A", "Ea"))
+    theta = params.extract(gm, spec)
+    assert theta["log_A"].shape == (gm.n_reactions,)
+    gm2 = params.apply(gm, theta, spec)
+    # unperturbed splice is the identity
+    np.testing.assert_array_equal(np.asarray(gm2.log_A),
+                                  np.asarray(gm.log_A))
+    # perturbation lands on exactly the selected rows
+    spec3 = params.select(gm, reactions=(2, 5))
+    th3 = params.extract(gm, spec3)
+    gm3 = params.apply(gm, {"log_A": th3["log_A"] + 0.1}, spec3)
+    delta = np.asarray(gm3.log_A) - np.asarray(gm.log_A)
+    expect = np.zeros(gm.n_reactions)
+    expect[[2, 5]] = 0.1
+    np.testing.assert_allclose(delta, expect, atol=1e-14)
+    # names align with flatten order
+    flat, unflat = params.flatten(theta)
+    assert flat.shape == (2 * gm.n_reactions,)
+    assert len(params.names(spec)) == 2 * gm.n_reactions
+    np.testing.assert_array_equal(np.asarray(unflat(flat)["Ea"]),
+                                  np.asarray(theta["Ea"]))
+
+
+def test_select_glob_and_errors(h2o2):
+    gm, *_ = h2o2
+    spec = params.select(gm, reactions="*H2O2*")
+    assert spec.n_reactions > 0
+    assert all("H2O2" in e for e in spec.equations)
+    with pytest.raises(ValueError, match="matches nothing"):
+        params.select(gm, reactions="*XENON*")
+    with pytest.raises(ValueError, match="unknown gas field"):
+        params.select(gm, fields=("nu_f",))
+    with pytest.raises(IndexError):
+        params.select(gm, reactions=(0, 10_000))
+
+
+# ---------------------------------------------------------------------------
+# forward: analytic oracle + mechanism FD golden (tol-tiered)
+# ---------------------------------------------------------------------------
+def test_forward_tangents_analytic_decay():
+    """dy/dt = -k y: S = dy(t)/dk = -t e^{-kt}, exact oracle."""
+
+    def rhs_theta(t, y, theta, cfg):
+        return -theta["k"][0] * y
+
+    theta = {"k": jnp.asarray([1.3])}
+    r = forward.solve_forward(rhs_theta, jnp.asarray([1.0]), 0.0, 1.0,
+                              theta, None, rtol=1e-10, atol=1e-14)
+    assert int(r.status) == SUCCESS
+    np.testing.assert_allclose(float(r.tangents[0, 0]), -np.exp(-1.3),
+                               rtol=1e-7)
+    # jac_window staleness must not move tangents beyond tolerance noise
+    r4 = forward.solve_forward(rhs_theta, jnp.asarray([1.0]), 0.0, 1.0,
+                               theta, None, rtol=1e-10, atol=1e-14,
+                               jac_window=4)
+    np.testing.assert_allclose(np.asarray(r4.tangents),
+                               np.asarray(r.tangents), rtol=1e-6)
+
+
+def test_forward_matches_central_fd_h2o2(h2o2, h2o2_theta):
+    """Acceptance gate: staggered forward tangents vs central finite
+    differences on the fixture mechanism, tol-tiered — the loose tier
+    checks the production tolerance tracks, the tight tier checks the
+    1e-3 contract."""
+    gm, th, sp, y0, cfg = h2o2
+    spec, theta, rhs_theta, jac_theta = h2o2_theta
+    t1 = 3e-5
+
+    # FD baseline: theta enters traced, so all 6 perturbed solves share
+    # ONE compiled executable
+    @jax.jit
+    def final_at(th_flat):
+        th_ = {"log_A": th_flat}
+        return bdf.solve(
+            lambda t, y, cfg: rhs_theta(t, y, th_, cfg), y0, 0.0, t1, cfg,
+            rtol=1e-10, atol=1e-14,
+            jac=lambda t, y, cfg: jac_theta(t, y, th_, cfg)).y
+
+    base = theta["log_A"]
+    eps = 1e-4
+    fd = np.stack([
+        (np.asarray(final_at(base.at[i].add(eps)))
+         - np.asarray(final_at(base.at[i].add(-eps)))) / (2 * eps)
+        for i in range(base.shape[0])])
+
+    def jac_fixed(t, y, cfg):
+        return jac_theta(t, y, theta, cfg)
+
+    # tol tiers: the production tolerance documents the (expected,
+    # CVODES-like) faster degradation of non-error-controlled tangents;
+    # the tight tier pins the 1e-3 acceptance contract
+    for rtol, tol in ((1e-6, 0.25), (1e-8, 1.5e-3)):
+        r = forward.solve_forward(rhs_theta, y0, 0.0, t1, theta, cfg,
+                                  rtol=rtol, atol=rtol * 1e-4,
+                                  jac=jac_fixed)
+        assert int(r.status) == SUCCESS
+        S = np.asarray(r.tangents)
+        scale = np.max(np.abs(fd), axis=1, keepdims=True)
+        np.testing.assert_allclose(S / scale, fd / scale, atol=tol)
+
+
+def test_adjoint_analytic_decay_and_nan_when_never_crossed():
+    """Adjoint on the decay oracle: final-state gradient matches the
+    closed form, and a never-crossing ignition marker yields NaN tau
+    with a zero (constant-branch) gradient — never a silently-plausible
+    clipped-interpolation value."""
+
+    def rhs_theta(t, y, theta, cfg):
+        return -theta["k"][0] * y
+
+    theta = {"k": jnp.asarray([1.3])}
+    y0 = jnp.asarray([1.0])
+    qoi, grad, aux = adjoint.solve_adjoint(
+        rhs_theta, adjoint.final_species_qoi(0), y0, 0.0, 1.0, theta,
+        None, rtol=1e-9, atol=1e-13, grid_size=64, segments=4)
+    assert int(aux["status"]) == SUCCESS
+    np.testing.assert_allclose(float(qoi), np.exp(-1.3), rtol=1e-7)
+    np.testing.assert_allclose(float(grad["k"][0]), -np.exp(-1.3),
+                               rtol=1e-6)
+    # decaying y never drops below half within t=1e-3 -> NaN tau, 0 grad
+    qoi2, grad2, _ = adjoint.solve_adjoint(
+        rhs_theta, adjoint.ignition_delay_qoi(0), y0, 0.0, 1e-3, theta,
+        None, rtol=1e-6, atol=1e-10, grid_size=32, segments=4)
+    assert np.isnan(float(qoi2))
+    np.testing.assert_array_equal(np.asarray(grad2["k"]), np.zeros(1))
+
+
+# ---------------------------------------------------------------------------
+# sweep: vmapped 8-lane forward-sensitivity smoke (JAX_PLATFORMS=cpu)
+# ---------------------------------------------------------------------------
+def test_forward_sensitivity_sweep_8_lanes(h2o2):
+    gm, th, sp, y0, cfg = h2o2
+    from batchreactor_tpu.parallel import ensemble_solve_forward
+
+    spec = params.select(gm, reactions=(1, 2))
+    theta = params.extract(gm, spec)
+    rhs_theta = params.make_rhs_theta(gm, spec,
+                                      lambda m: make_gas_rhs(m, th))
+
+    def jac_fixed(t, y, cfg):
+        return make_gas_jac(params.apply(gm, theta, spec), th)(t, y, cfg)
+
+    B = 8
+    T = jnp.linspace(1050.0, 1200.0, B)
+    y0s = jnp.broadcast_to(y0, (B,) + y0.shape)
+    cfgs = {"T": T, "Asv": jnp.ones((B,))}
+    res = ensemble_solve_forward(rhs_theta, y0s, 0.0, 1e-5, theta, cfgs,
+                                 rtol=1e-6, atol=1e-10, jac=jac_fixed)
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    S = np.asarray(res.tangents)
+    assert S.shape == (B, 2, len(sp))
+    assert np.all(np.isfinite(S))
+    # hotter lanes react further: the tangent magnitudes must actually
+    # vary across lanes (a broadcast bug would repeat lane 0)
+    mags = np.max(np.abs(S), axis=(1, 2))
+    assert len(np.unique(mags)) == B
+
+
+# ---------------------------------------------------------------------------
+# api surface: sens= normalization, legacy hook, solved modes, status fix
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def h2o2_xml(tmp_path):
+    (tmp_path / "batch.xml").write_text("""<?xml version="1.0"?>
+<batch>
+  <gas_mech>h2o2.dat</gas_mech>
+  <molefractions>H2=0.3,O2=0.2,N2=0.5</molefractions>
+  <T>1100.0</T> <p>1e5</p> <time>3e-5</time>
+</batch>""")
+    return str(tmp_path / "batch.xml")
+
+
+def test_sens_kwarg_validation(h2o2_xml, fixtures_dir):
+    with pytest.raises(ValueError, match="sens must be"):
+        br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                         sens="bogus")
+    with pytest.raises(ValueError, match="sens must be"):
+        br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True, sens=1)
+    # adjoint without a QoI is loud
+    with pytest.raises(ValueError, match="scalar QoI"):
+        br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                         sens="adjoint", verbose=False)
+    # sensitivity solves are jax-backend / BDF only
+    with pytest.raises(ValueError, match="jax backend"):
+        br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                         sens="forward", backend="cpu", verbose=False)
+    with pytest.raises(ValueError, match="BDF"):
+        br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                         sens="forward", method="sdirk", verbose=False)
+    # forward cannot do trajectory QoIs
+    with pytest.raises(ValueError, match="adjoint"):
+        br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                         sens="forward", sens_qoi=("ignition", "H2"),
+                         verbose=False)
+    # an explicit segmented= would be silently ignored — loud instead
+    with pytest.raises(ValueError, match="monolithically"):
+        br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                         sens="forward", segmented=True, verbose=False)
+
+
+def test_sens_rejected_on_programmatic_form(fixtures_dir):
+    gm = compile_gaschemistry(os.path.join(fixtures_dir, "h2o2.dat"))
+    th = create_thermo(list(gm.species), os.path.join(fixtures_dir,
+                                                      "therm.dat"))
+    with pytest.raises(ValueError, match="file-driven"):
+        br.batch_reactor({"H2": 0.3, "O2": 0.2, "N2": 0.5}, 1100.0, 1e5,
+                         1e-5, chem=br.Chemistry(gaschem=True),
+                         thermo_obj=th, md=gm, sens=True)
+
+
+def test_legacy_hook_carries_theta(h2o2_xml, fixtures_dir):
+    prob = br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                            sens=True)
+    assert isinstance(prob, br.SensitivityProblem)
+    assert prob.spec is not None and prob.theta is not None
+    assert prob.theta["log_A"].shape == (prob.spec.n_reactions,)
+    # the hook composes with sensitivity.params: a perturbed-theta rhs
+    # evaluates and differs from the base rhs
+    gm = compile_gaschemistry(os.path.join(fixtures_dir, "h2o2.dat"))
+    gm2 = params.apply(gm, {"log_A": prob.theta["log_A"] + 0.2},
+                       prob.spec)
+    assert not np.allclose(np.asarray(gm2.log_A), np.asarray(gm.log_A))
+
+
+def test_api_forward_and_adjoint(h2o2_xml, fixtures_dir):
+    """Acceptance gate: batch_reactor(sens="forward") and
+    (sens="adjoint") both solve, and the two differentiation routes —
+    staggered tangents through the adaptive BDF loop vs IFT-vjp backward
+    pass over the pinned grid — agree on the QoI gradient to the 1e-3
+    contract (small 2-parameter selection keeps it fast; the full-theta
+    FD gate is test_forward_matches_central_fd_h2o2)."""
+    sel = {"reactions": (1, 2)}
+    fwd_sol = br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                               sens="forward", sens_params=sel,
+                               sens_qoi="H2O", rtol=1e-8, atol=1e-12,
+                               verbose=False)
+    assert isinstance(fwd_sol, br.SensitivitySolution)
+    assert fwd_sol.status == "Success"
+    assert fwd_sol.tangents.shape == (2, 9)
+    assert fwd_sol.names == ("log_A[OH+H2=H2O+H]", "log_A[H+O2=OH+O]")
+    # 125 rounds up to the adjoint's segment multiple internally — any
+    # sens_grid value is a valid capacity
+    adj_sol = br.batch_reactor(h2o2_xml, fixtures_dir, gaschem=True,
+                               sens="adjoint", sens_params=sel,
+                               sens_qoi="H2O", rtol=1e-8, atol=1e-12,
+                               sens_grid=125, verbose=False)
+    assert adj_sol.status == "Success"
+    assert adj_sol.truncated is False
+    np.testing.assert_allclose(adj_sol.qoi, fwd_sol.qoi, rtol=2e-3)
+    gf = np.asarray(fwd_sol.qoi_grad["log_A"])
+    ga = np.asarray(adj_sol.qoi_grad["log_A"])
+    scale = np.max(np.abs(gf))
+    np.testing.assert_allclose(ga / scale, gf / scale, atol=1e-3)
+    # normalized ranking runs on the result
+    coeffs = rank.normalized_sensitivities(adj_sol.qoi, ga)
+    ranking = rank.top_k(coeffs, adj_sol.spec.equations, k=2)
+    assert len(ranking) == 2 and ranking[0][0] == 1
+
+
+def test_status_fallback_unknown_code():
+    """Regression (ISSUE 2 satellite): an unknown/future solver code must
+    degrade to "Failure(<code>)", never KeyError."""
+    from batchreactor_tpu.api import _STATUS, _status_str
+
+    assert _status_str(1) == "Success"
+    assert _status_str(2) == "MaxIters"
+    assert _status_str(3) == "DtLessThanMin"
+    assert _status_str(99) == "Failure(99)"
+    assert _status_str(np.int32(-7)) == "Failure(-7)"
+    assert 99 not in _STATUS
+
+
+# ---------------------------------------------------------------------------
+# solver hooks: step audit surface
+# ---------------------------------------------------------------------------
+def test_step_audit_surfaces_ring_and_matrix():
+    def rhs(t, y, cfg):
+        return -y
+
+    r = bdf.solve(rhs, jnp.asarray([1.0, 2.0]), 0.0, 1.0, None,
+                  rtol=1e-6, atol=1e-12, step_audit=True)
+    ring = np.asarray(r.accept_ring)
+    n_attempts = int(r.n_accepted) + int(r.n_rejected)
+    assert ring.shape == (64,)
+    # every used slot is 0/1; unused slots keep the -1 sentinel
+    used = ring[ring >= 0]
+    assert used.size == min(n_attempts, 64)
+    assert used.sum() <= int(r.n_accepted)
+    M = np.asarray(r.it_matrix)
+    assert M.shape == (2, 2) and np.all(np.isfinite(M))
+    # M = I - cJ with J = -I here: symmetric with M[0,0] > 1
+    assert M[0, 0] > 1.0 and abs(M[0, 1]) < 1e-12
+    # default solves pay none of this: fields stay None
+    r0 = bdf.solve(rhs, jnp.asarray([1.0, 2.0]), 0.0, 1.0, None,
+                   rtol=1e-6, atol=1e-12)
+    assert r0.accept_ring is None and r0.it_matrix is None
+    assert r0.tangents is None
+
+
+# ---------------------------------------------------------------------------
+# CLI: scripts/sens_rank.py (fast: 3-reaction selection)
+# ---------------------------------------------------------------------------
+def test_sens_rank_cli(h2o2_xml, fixtures_dir):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "sens_rank.py"),
+         h2o2_xml, fixtures_dir, "--qoi", "H2O", "--mode", "forward",
+         "--reactions", "*H2O2*", "-k", "3"],
+        capture_output=True, text=True, timeout=280,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    assert "QoI =" in out.stdout
+    assert "dln(H2O)/dlnA" in out.stdout
+    # 3 ranked rows, all naming H2O2 reactions
+    rows = [ln for ln in out.stdout.splitlines()
+            if ln.strip() and ln.split()[0].isdigit()]
+    assert len(rows) == 3
+    assert all("H2O2" in r for r in rows)
