@@ -46,6 +46,7 @@ from .api import (  # noqa: E402
 )
 from .io.config import InputData, input_data  # noqa: E402
 from . import sensitivity  # noqa: E402
+from . import obs  # noqa: E402
 
 __all__ = [
     "ThermoTable",
@@ -62,6 +63,7 @@ __all__ = [
     "InputData",
     "input_data",
     "sensitivity",
+    "obs",
 ]
 
 __version__ = "0.1.0"

@@ -173,13 +173,20 @@ def run_audit(fixtures_dir=None):
 
     # both solvers' step programs, traced exactly as api._solve compiles
     # them (the while_loop body IS the step program; sub-jaxpr descent
-    # covers it).  Gas mode, bounded steps: trace cost only.
+    # covers it) — plain AND telemetry-instrumented (stats=True, the
+    # counter block obs/ rides on `telemetry=`): the counters must be
+    # masked adds only, never host callbacks or in-loop device staging.
+    # Gas mode, bounded steps: trace cost only.
     tag_rhs, rhs, jac, y0, cfg = modes[0]
-    for sname, solver in (("bdf-step", bdf.solve), ("sdirk-step",
-                                                    sdirk.solve)):
-        def run(y0_, solver=solver):
+    for sname, solver, skw in (
+            ("bdf-step", bdf.solve, {}),
+            ("sdirk-step", sdirk.solve, {}),
+            ("bdf-step-stats", bdf.solve, {"stats": True}),
+            ("sdirk-step-stats", sdirk.solve, {"stats": True})):
+        def run(y0_, solver=solver, skw=skw):
             return solver(rhs, y0_, 0.0, 1e-7, cfg, rtol=1e-6,
-                          atol=1e-10, max_steps=3, n_save=0, jac=jac).y
+                          atol=1e-10, max_steps=3, n_save=0, jac=jac,
+                          **skw).y
 
         jaxpr = jax.make_jaxpr(run)(y0)
         findings.extend(_audit_jaxpr(sname, jaxpr, check_dtype=False))

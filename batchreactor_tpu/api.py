@@ -31,6 +31,7 @@ checkpointed adjoint gradients of a scalar QoI — via the
 :mod:`~batchreactor_tpu.sensitivity` subsystem (docs/sensitivity.md).
 """
 
+import contextlib
 import dataclasses
 import functools
 import os
@@ -217,10 +218,10 @@ def _segmented_builder(mode, udf, kc_compat, asv_quirk):
 @functools.partial(
     jax.jit,
     static_argnames=("mode", "udf", "kc_compat", "asv_quirk", "n_save",
-                     "max_steps", "method", "jac_window"))
+                     "max_steps", "method", "jac_window", "stats"))
 def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
            n_save, max_steps, kc_compat, asv_quirk, method="bdf",
-           jac_window=1):
+           jac_window=1, stats=False):
     """Jitted solve, cache-keyed on the chemistry *mode* rather than a
     per-call rhs closure: mechanism tensor bundles enter as traced pytree
     operands, so repeated calls with any same-shaped mechanism (the
@@ -235,7 +236,7 @@ def _solve(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
     return solver(
         rhs, y0, t0, t1, cfg,
         rtol=rtol, atol=atol, n_save=n_save, max_steps=max_steps, jac=jac,
-        jac_window=jac_window,
+        jac_window=jac_window, stats=stats,
     )
 
 
@@ -273,10 +274,13 @@ def _solve_native(mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol, atol,
 def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
                atol, n_save, max_steps, kc_compat, asv_quirk,
                segmented=None, progress=None, method="bdf",
-               jac_window=None):
+               jac_window=None, stats=False, recorder=None, watch=None):
     """Dispatch one solve to the requested backend and normalize the result:
-    returns (status_str, t_end, y_end, ts, ys, truncated, n_acc, n_rej)
-    with ts/ys the saved trajectory *including* the initial row.
+    returns (status_str, t_end, y_end, ts, ys, truncated, n_acc, n_rej,
+    stats) with ts/ys the saved trajectory *including* the initial row and
+    ``stats`` the solver's device counter block (None unless ``stats=True``
+    on the jax backend — the native runtime manages its own counters and
+    exposes only accepted/rejected).
 
     ``segmented=None`` auto-selects: accelerators run the solve as bounded
     device launches (segments) with the trajectory drained to host between
@@ -301,7 +305,7 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
             ts = np.concatenate([ts, [res.t]])
             ys = np.concatenate([ys, res.y[None, :]])
         return (res.status, res.t, res.y, ts, ys, truncated,
-                res.n_accepted, res.n_rejected)
+                res.n_accepted, res.n_rejected, None)
     if backend != "jax":
         raise ValueError(f"unknown backend {backend!r}; use 'jax' or 'cpu'")
     jac_window = resolve_jac_window(jac_window, method)
@@ -324,7 +328,8 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
             max_segments=max(1, -(-int(max_steps) // seg_steps)),
             max_attempts=int(max_steps),
             rhs_bundle=(gm, sm, thermo), progress=progress, method=method,
-            jac_window=jac_window)
+            jac_window=jac_window, stats=stats, recorder=recorder,
+            watch=watch)
         res = jax.tree.map(
             lambda x: x[0] if hasattr(x, "ndim") and x.ndim >= 1 else x,
             resb)
@@ -332,11 +337,11 @@ def _run_solve(backend, mode, udf, gm, sm, thermo, y0, t0, t1, cfg, rtol,
         res = _solve(mode, udf, gm, sm, thermo, y0,
                      jnp.asarray(t0), jnp.asarray(t1), cfg,
                      rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-                     method=method, jac_window=jac_window)
+                     method=method, jac_window=jac_window, stats=stats)
     ts, ys, truncated = trim_trajectory(float(t0), y0, res)
     return (_status_str(res.status), float(res.t),
             np.asarray(res.y), ts, ys, truncated, int(res.n_accepted),
-            int(res.n_rejected))
+            int(res.n_rejected), res.stats)
 
 
 def _mode(chem):
@@ -367,13 +372,18 @@ def _default_theta(gm, sm):
 def _sensitivity_run(sens, mode, id_, y0, cfg, surf_species, *,
                      sens_params, sens_qoi, sens_grid, rtol, atol,
                      max_steps, kc_compat, asv_quirk, method, jac_window,
-                     backend, segmented, verbose):
+                     backend, segmented, verbose, telemetry=False,
+                     recorder=None):
     """Solve WITH sensitivities (``sens="forward"|"adjoint"``) — the
     CVODES capability the legacy hook only gestures at.  Returns a
-    :class:`SensitivitySolution`.  ``y0``/``cfg``/``surf_species`` come
+    :class:`SensitivitySolution` — or, with ``telemetry=True``, the
+    triple ``(solution, solver_stats, watch)`` the file-driven caller
+    folds into its obs report.  ``y0``/``cfg``/``surf_species`` come
     from the caller (:func:`_file_driven_run`) so the sensitivity path
     can never diverge from the plain solve's state construction."""
     import sys
+
+    from .obs import CompileWatch
 
     from .sensitivity import adjoint as adj_mod
     from .sensitivity import forward as fwd_mod
@@ -469,6 +479,7 @@ def _sensitivity_run(sens, mode, id_, y0, cfg, surf_species, *,
                 f"sens_qoi must be a species name or ('ignition', marker"
                 f"[, frac]); got {sens_qoi!r}")
 
+    watch = CompileWatch(recorder=recorder, default_label=f"sens-{sens}")
     if sens == "forward":
         def jac_fixed(t, y, cfg):
             return jac_theta(t, y, theta, cfg)
@@ -477,10 +488,12 @@ def _sensitivity_run(sens, mode, id_, y0, cfg, surf_species, *,
         # (CVODES errconS=True) — a few extra accepted steps buy ~2x
         # tighter tangents, the right default for an entry point whose
         # caller never sees the controller
-        res = fwd_mod.solve_forward(
-            rhs_theta, y0, 0.0, id_.tf, theta, cfg, rtol=rtol, atol=atol,
-            max_steps=max_steps, jac=jac_fixed, jac_window=jac_window,
-            sens_errcon=True)
+        with (watch if telemetry else contextlib.nullcontext()):
+            res = fwd_mod.solve_forward(
+                rhs_theta, y0, 0.0, id_.tf, theta, cfg, rtol=rtol,
+                atol=atol, max_steps=max_steps, jac=jac_fixed,
+                jac_window=jac_window, sens_errcon=True, stats=telemetry,
+                recorder=recorder if telemetry else None)
         S = res.tangents
         qoi = qoi_grad = None
         if qoi_idx is not None:
@@ -488,13 +501,14 @@ def _sensitivity_run(sens, mode, id_, y0, cfg, surf_species, *,
             qoi = float(res.y[qoi_idx])
             _, unflat = sp_mod.flatten(theta)
             qoi_grad = unflat(S[:, qoi_idx])
-        return SensitivitySolution(
+        sol = SensitivitySolution(
             status=_status_str(res.status), t=float(res.t),
             y=np.asarray(res.y), species=id_.species,
             surface_species=surf_species, spec=spec, theta=theta,
             names=names, tangents=np.asarray(S), qoi=qoi,
             qoi_grad=qoi_grad, n_accepted=int(res.n_accepted),
             n_rejected=int(res.n_rejected))
+        return (sol, res.stats, watch) if telemetry else sol
 
     # ---- adjoint -----------------------------------------------------------
     if qoi_fn is None:
@@ -506,10 +520,12 @@ def _sensitivity_run(sens, mode, id_, y0, cfg, surf_species, *,
     # segment count so any sens_grid value works (the buffer size is a
     # capacity, not a semantic)
     sens_grid = max(8, -(-int(sens_grid) // 8) * 8)
-    qoi, grad, aux = adj_mod.solve_adjoint(
-        rhs_theta, qoi_fn, y0, 0.0, id_.tf, theta, cfg,
-        jac_theta=jac_theta, rtol=rtol, atol=atol, grid_size=sens_grid,
-        segments=8, max_steps=max_steps, jac_window=jac_window)
+    with (watch if telemetry else contextlib.nullcontext()):
+        qoi, grad, aux = adj_mod.solve_adjoint(
+            rhs_theta, qoi_fn, y0, 0.0, id_.tf, theta, cfg,
+            jac_theta=jac_theta, rtol=rtol, atol=atol, grid_size=sens_grid,
+            segments=8, max_steps=max_steps, jac_window=jac_window,
+            stats=telemetry, recorder=recorder if telemetry else None)
     truncated = bool(aux["truncated"])
     if truncated:
         # unconditional (not verbose-gated): a truncated grid means the
@@ -519,28 +535,32 @@ def _sensitivity_run(sens, mode, id_, y0, cfg, surf_species, *,
               f"accepted {int(aux['n_accepted'])} steps > sens_grid="
               f"{sens_grid}); the fixed-grid re-solve lost resolution — "
               f"raise sens_grid", file=sys.stderr)
-    return SensitivitySolution(
+    sol = SensitivitySolution(
         status=_status_str(aux["status"]), t=float(aux["t"]),
         y=np.asarray(aux["y"]), species=id_.species,
         surface_species=surf_species, spec=spec, theta=theta, names=names,
         qoi=float(qoi), qoi_grad=grad,
         n_accepted=int(aux["n_accepted"]),
         n_rejected=int(aux["n_rejected"]), truncated=truncated)
+    return (sol, aux["stats"], watch) if telemetry else sol
 
 
 def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
                      max_steps, kc_compat, asv_quirk, verbose, backend,
                      segmented=None, method="bdf", jac_window=None,
-                     sens_params=None, sens_qoi=None, sens_grid=512):
+                     sens_params=None, sens_qoi=None, sens_grid=512,
+                     telemetry=False):
     """Core driver: parse XML -> build RHS -> solve -> write profiles
     (reference :152-217).  ``sens`` arrives normalized (None / "hook" /
-    "forward" / "adjoint", :func:`_normalize_sens`)."""
+    "forward" / "adjoint", :func:`_normalize_sens`).  ``telemetry=True``
+    returns ``(result, report)`` with the ``obs`` report (spans, solver
+    counters, compile/retrace counts — docs/observability.md)."""
     import sys
 
-    from .utils.profiling import Phases
+    from .obs import CompileWatch, Recorder, build_report
 
-    ph = Phases()
-    with ph("parse"):
+    rec = Recorder()
+    with rec.span("parse", input=os.path.basename(input_file)):
         id_ = input_data(input_file, lib_dir, chem)
     mode = _mode(chem)
     surf_species = id_.smd.species if chem.surfchem else None
@@ -549,25 +569,41 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
            "Asv": jnp.asarray(id_.Asv, dtype=jnp.float64)}
     y0 = get_solution_vector(id_.mole_fracs, id_.thermo.molwt, id_.T, id_.p,
                              ini_covg=covg0)
+
+    def _meta():
+        return {"entry": "batch_reactor", "mode": mode, "backend": backend,
+                "method": method, "input": os.path.basename(input_file)}
+
     if sens in ("forward", "adjoint"):
         # solve AND return sensitivities (sensitivity/ subsystem — the
         # CVODES-parity path); no profile files, like the legacy hook
-        return _sensitivity_run(
+        sol = _sensitivity_run(
             sens, mode, id_, y0, cfg, surf_species,
             sens_params=sens_params, sens_qoi=sens_qoi,
             sens_grid=sens_grid, rtol=rtol, atol=atol, max_steps=max_steps,
             kc_compat=kc_compat, asv_quirk=asv_quirk, method=method,
             jac_window=jac_window, backend=backend, segmented=segmented,
-            verbose=verbose)
+            verbose=verbose, telemetry=telemetry, recorder=rec)
+        if telemetry:
+            sol, stats, watch = sol
+            return sol, build_report(recorder=rec, solver_stats=stats,
+                                     watch=watch,
+                                     meta={**_meta(), "sens": sens})
+        return sol
     if sens == "hook":
         rhs = _make_rhs(mode, chem.udf, id_.gmd, id_.smd, id_.thermo,
                         kc_compat, asv_quirk)
         spec, theta = _default_theta(id_.gmd, id_.smd)
-        return SensitivityProblem(
+        prob = SensitivityProblem(
             rhs=rhs, y0=y0, cfg=cfg, t_span=(0.0, id_.tf),
             species=id_.species, surface_species=surf_species,
             theta=theta, spec=spec,
         )
+        if telemetry:
+            # nothing solved: the report carries the parse span only
+            return prob, build_report(recorder=rec,
+                                      meta={**_meta(), "sens": "hook"})
+        return prob
 
     # the reference prints every accepted time to the terminal during the
     # solve (@printf("%4e\n",t), :401; sample docs/src/index.md:136-155);
@@ -582,12 +618,19 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
                 print(f"{tv:4e}")  # C %4e: width 4, default 6-digit precision
             n_live += len(p.get("drained_ts", ()))
 
-    with ph("solve"):
-        status, t_end, _, ts, ys, truncated, n_acc, n_rej = _run_solve(
-            backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
-            0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat,
-            asv_quirk, segmented=segmented, progress=prog, method=method,
-            jac_window=jac_window)
+    # the CompileWatch is active only for telemetry runs (its listener
+    # install is global-but-lazy; the watch itself costs nothing when off)
+    watch = CompileWatch(recorder=rec, default_label="solve")
+    with (watch if telemetry else contextlib.nullcontext()):
+        with rec.span("solve"):
+            (status, t_end, _, ts, ys, truncated, n_acc, n_rej,
+             run_stats) = _run_solve(
+                backend, mode, chem.udf, id_.gmd, id_.smd, id_.thermo, y0,
+                0.0, id_.tf, cfg, rtol, atol, n_save, max_steps, kc_compat,
+                asv_quirk, segmented=segmented, progress=prog,
+                method=method, jac_window=jac_window, stats=telemetry,
+                recorder=rec if telemetry else None,
+                watch=watch if telemetry else None)
     if verbose and n_live == 0:
         # ts[0] is the initial row, not an accepted step; a truncated run
         # appends a final-state bridge row that is not an accepted step
@@ -600,7 +643,7 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
               f"profile files skip the overflow but end at the true final "
               f"state", file=sys.stderr)
     out_dir = os.path.dirname(os.path.abspath(input_file))
-    with ph("write"):
+    with rec.span("write"):
         write_profiles(out_dir, id_.species, ts, ys, id_.T,
                        np.asarray(id_.thermo.molwt),
                        surface_species=surf_species)
@@ -609,22 +652,29 @@ def _file_driven_run(input_file, lib_dir, chem, sens, *, rtol, atol, n_save,
               f"({n_acc} accepted / {n_rej} rejected steps)")
         # phase breakdown to stderr (SURVEY.md §5 tracing plan); the solve
         # span includes compile on a cold cache — rerun to see it cached
-        print("phases:\n" + ph.pretty(), file=sys.stderr)
+        print("phases:\n" + rec.pretty(), file=sys.stderr)
+    if telemetry:
+        return status, build_report(recorder=rec, solver_stats=run_stats,
+                                    watch=watch, meta=_meta())
     return status
 
 
 def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
                       rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
                       backend, segmented=None, method="bdf",
-                      jac_window=None):
+                      jac_window=None, telemetry=False):
     """Dict-in/dict-out API (reference :86-147): no files; returns
-    ``(accepted_times, {species: final mole fraction})``.
+    ``(accepted_times, {species: final mole fraction})`` — or, with
+    ``telemetry=True``, ``(accepted_times, fractions, report)``.
 
     Species layout follows ``thermo_obj.species`` (the reference uses dict
     key order for the surface path and mechanism order for the gas path,
     :103,:118-119 — both equal the order the caller built ``thermo_obj``
     with).  Missing species zero-fill (:92-100).
     """
+    from .obs import CompileWatch, Recorder, build_report
+
+    rec = Recorder() if telemetry else None
     species = thermo_obj.species
     comp_text = ",".join(f"{k}={v}" for k, v in inlet_comp.items())
     mole_fracs = parse_composition_text(comp_text, species)
@@ -646,10 +696,15 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
                              ini_covg=covg0)
     cfg = {"T": jnp.asarray(T, dtype=jnp.float64),
            "Asv": jnp.asarray(Asv, dtype=jnp.float64)}
-    status, t_end, y_end, ts, _, _, _, _ = _run_solve(
-        backend, mode, None, gm, sm, thermo_obj, y0, 0.0, float(time), cfg,
-        rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
-        segmented=segmented, method=method, jac_window=jac_window)
+    watch = CompileWatch(recorder=rec, default_label="solve")
+    with (watch if telemetry else contextlib.nullcontext()), \
+            (rec.span("solve") if telemetry else contextlib.nullcontext()):
+        status, t_end, y_end, ts, _, _, _, _, run_stats = _run_solve(
+            backend, mode, None, gm, sm, thermo_obj, y0, 0.0, float(time),
+            cfg, rtol, atol, n_save, max_steps, kc_compat, asv_quirk,
+            segmented=segmented, method=method, jac_window=jac_window,
+            stats=telemetry, recorder=rec,
+            watch=watch if telemetry else None)
     if status != "Success":
         # fail loudly: a partial-integration composition is worse than an
         # error for reactor-network callers
@@ -662,7 +717,14 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
     ng = len(species)
     moles = y_end[:ng] / np.asarray(thermo_obj.molwt)
     x_end = moles / moles.sum()
-    return ts, dict(zip(species, x_end.tolist()))
+    x_out = dict(zip(species, x_end.tolist()))
+    if telemetry:
+        report = build_report(
+            recorder=rec, solver_stats=run_stats, watch=watch,
+            meta={"entry": "batch_reactor", "mode": mode,
+                  "backend": backend, "method": method})
+        return ts, x_out, report
+    return ts, x_out
 
 
 # (rhs, jac, observer, observer_init) closures per (mechanism, settings):
@@ -711,7 +773,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         max_steps=200_000, segment_steps=0, kc_compat=False,
                         asv_quirk=True, ignition_marker=None,
                         ignition_mode="half", method="bdf", jac_window=None,
-                        analytic_jac=True):
+                        analytic_jac=True, telemetry=False):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -750,6 +812,13 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     closed form but wraps it in ``jax.checkpoint`` (numerically identical,
     different XLA program structure).  Both are measurement/escape knobs
     for the coupled analytic-J TPU-backend compile-time wall (PERF.md).
+
+    ``telemetry=True`` adds ``out["telemetry"]``: the structured ``obs``
+    report (docs/observability.md) with prepare/solve spans, PER-LANE
+    device solver counters (vmap batches the int32 counter block — the
+    report carries both totals and the per-lane arrays), and
+    compile/retrace counts; segmented runs flag any post-first-segment
+    compile as a retrace event.  Render with ``scripts/obs_report.py``.
     """
     from .parallel import (ensemble_solve, ensemble_solve_segmented,
                            sweep_report)
@@ -892,15 +961,27 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                 "this sweep runs on CPU devices; for f64-exact CPU rates "
                 "set BR_EXP32=0 before importing batchreactor_tpu",
                 RuntimeWarning, stacklevel=2)
+    from .obs import CompileWatch, Recorder, build_report
+
+    rec = Recorder() if telemetry else None
+    watch = CompileWatch(recorder=rec, default_label="sweep")
     common = dict(mesh=mesh, rtol=rtol, atol=atol, jac=jac,
                   observer=observer, observer_init=obs0, method=method,
-                  jac_window=jac_window)
-    if segment_steps > 0:
-        res = ensemble_solve_segmented(rhs, y0s, 0.0, float(time), cfgs,
-                                       segment_steps=segment_steps, **common)
-    else:
-        res = ensemble_solve(rhs, y0s, 0.0, float(time), cfgs,
-                             max_steps=max_steps, **common)
+                  jac_window=jac_window, stats=telemetry)
+    with (watch if telemetry else contextlib.nullcontext()), \
+            (rec.span("solve", lanes=B)
+             if telemetry else contextlib.nullcontext()):
+        if segment_steps > 0:
+            res = ensemble_solve_segmented(rhs, y0s, 0.0, float(time), cfgs,
+                                           segment_steps=segment_steps,
+                                           recorder=rec,
+                                           watch=watch if telemetry
+                                           else None, **common)
+        else:
+            res = ensemble_solve(rhs, y0s, 0.0, float(time), cfgs,
+                                 max_steps=max_steps, **common)
+        if telemetry:
+            jax.block_until_ready(res.y)
     res = unpad_result(res, B)
     cfgs = {k: v[:B] for k, v in cfgs.items()}
 
@@ -917,6 +998,12 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         out["covg"] = np.asarray(res.y)[:, ng:]
     if ignition_marker is not None:
         out["tau"] = np.asarray(res.observed["tau"])
+    if telemetry:
+        out["telemetry"] = build_report(
+            recorder=rec, solver_stats=res.stats, watch=watch,
+            meta={"entry": "batch_reactor_sweep", "mode": mode,
+                  "method": method, "lanes": B,
+                  "segmented": bool(segment_steps > 0)})
     return out
 
 
@@ -926,7 +1013,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
                   kc_compat=False, asv_quirk=True, verbose=True,
                   backend="jax", segmented=None, method="bdf",
                   jac_window=None, sens_params=None, sens_qoi=None,
-                  sens_grid=512):
+                  sens_grid=512, telemetry=False):
     """Simulate an isothermal constant-volume batch reactor (three forms).
 
     Form 1 — file-driven:   ``batch_reactor(input_file, lib_dir,
@@ -979,6 +1066,16 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
     ``("ignition", marker[, frac])`` (adjoint only); ``sens_grid`` sizes
     the adjoint's fixed re-solve grid.  Sensitivity runs are jax-backend,
     BDF, monolithic (no segmentation), and write no profile files.
+
+    ``telemetry=True`` (docs/observability.md) additionally returns the
+    structured ``obs`` report — phase spans, device-side solver counters
+    (``stats=True`` threaded through the solve), and compile/retrace
+    counts: file-driven forms return ``(result, report)``, the
+    programmatic form ``(times, fractions, report)``.  Render or diff it
+    with ``scripts/obs_report.py``; export with ``obs.to_jsonl`` /
+    ``obs.to_prometheus``.  With ``telemetry=False`` (default) the traced
+    solver programs and every return shape are exactly the pre-telemetry
+    ones.
     """
     sens = _normalize_sens(sens)
     if args and isinstance(args[0], dict):
@@ -1000,7 +1097,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             thermo_obj=thermo_obj, md=md, rtol=rtol, atol=atol,
             n_save=n_save, max_steps=max_steps, kc_compat=kc_compat,
             asv_quirk=asv_quirk, backend=backend, segmented=segmented,
-            method=method, jac_window=jac_window)
+            method=method, jac_window=jac_window, telemetry=telemetry)
 
     if len(args) == 3 and callable(args[2]):
         chem = Chemistry(False, False, True, args[2])
@@ -1010,7 +1107,7 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             asv_quirk=asv_quirk, verbose=verbose, backend=backend,
             segmented=segmented, method=method, jac_window=jac_window,
             sens_params=sens_params, sens_qoi=sens_qoi,
-            sens_grid=sens_grid)
+            sens_grid=sens_grid, telemetry=telemetry)
 
     if len(args) == 2:
         if chem is None:
@@ -1021,6 +1118,6 @@ def batch_reactor(*args, sens=False, surfchem=False, gaschem=False,
             asv_quirk=asv_quirk, verbose=verbose, backend=backend,
             segmented=segmented, method=method, jac_window=jac_window,
             sens_params=sens_params, sens_qoi=sens_qoi,
-            sens_grid=sens_grid)
+            sens_grid=sens_grid, telemetry=telemetry)
 
     raise TypeError(f"unrecognized batch_reactor argument pattern: {args!r}")

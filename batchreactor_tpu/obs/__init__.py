@@ -1,0 +1,53 @@
+"""Structured runtime telemetry (the observability subsystem).
+
+The reference's only observability is a per-step ``@printf`` of the time
+(/root/reference/src/BatchReactor.jl:401, SURVEY.md §5).  This package is
+the production-grade replacement — one uniform, machine-parseable surface
+for every question the ad-hoc fragments it supersedes answered separately:
+
+* **where did the wall-clock go** — :class:`~.recorder.Recorder`, nested
+  host-side spans with monotonic timestamps and per-span attributes
+  (parse / lower / compile / transfer / solve / write), emitted by
+  ``api.batch_reactor``, the segmented sweep driver, the checkpointed
+  sweep, and the sensitivity passes.  ``utils.profiling.Phases`` is now a
+  thin deprecated shim over it.
+* **what did the solver do** — device-side int32 counter blocks riding the
+  BDF/SDIRK ``lax.while_loop`` carry (``stats=True``): accepted/rejected
+  steps, Newton iterations, Jacobian builds, iteration-matrix
+  factorizations, error-test vs convergence-test rejections, and the BDF
+  order histogram — vmap-batched, so a sweep gets per-lane counters for
+  free (:mod:`.counters` documents the exact semantics).
+* **did we recompile** — :class:`~.retrace.CompileWatch` hooks
+  ``jax.monitoring`` and counts traces/compiles per program label,
+  flagging unexpected recompilation (the runtime complement to brlint's
+  static pass).
+* **machine-readable exports** — :mod:`.export` writes the assembled
+  report (:func:`~.report.build_report`) as JSON-Lines or a
+  Prometheus-style text exposition; ``scripts/obs_report.py`` renders and
+  diffs reports.
+
+Everything here is zero-overhead-when-off: ``telemetry=False`` (the
+default) traces the exact same step programs as before the subsystem
+existed, and no import in this package touches a device.
+"""
+
+from .recorder import Recorder, null_span
+from .retrace import CompileWatch
+from .report import build_report, render, diff, stats_totals
+from .export import (to_jsonl, from_jsonl, to_prometheus, write_jsonl,
+                     read_jsonl)
+
+__all__ = [
+    "Recorder",
+    "null_span",
+    "CompileWatch",
+    "build_report",
+    "render",
+    "diff",
+    "stats_totals",
+    "to_jsonl",
+    "from_jsonl",
+    "to_prometheus",
+    "write_jsonl",
+    "read_jsonl",
+]

@@ -1,0 +1,119 @@
+"""Device-side solver-counter semantics and host-side reductions.
+
+The counter *collection* lives inside the solvers (``solver/bdf.py`` and
+``solver/sdirk.py``, ``stats=True``): an int32 block threaded through the
+``lax.while_loop`` carry, updated with masked adds — no host callbacks, no
+``device_put``, nothing the brlint tier-B jaxpr audit would flag — and
+surfaced as the ``SolveResult.stats`` dict pytree.  Under ``vmap`` every
+leaf gains the batch axis, so a sweep gets per-lane counters for free.
+This module owns the *meaning* of each key and the host-side reductions
+(totals, per-lane views, segmented accumulation).
+
+Keys (CVODE's ``CVodeGetNumSteps``-family counters, per lane):
+
+``n_accepted`` / ``n_rejected``
+    accepted / rejected step attempts (aliases of the SolveResult fields,
+    repeated here so an exported stats block is self-contained).
+``newton_iters``
+    total Newton iterations across all step attempts (BDF: corrector
+    iterations; SDIRK: summed over the 5 stage solves of each attempt).
+``jac_builds``
+    Jacobian evaluations (``jac_window=K`` amortizes: one build serves up
+    to K attempts, so ``jac_builds <= attempts`` with K > 1).
+``factorizations``
+    Newton iteration-matrix constructions M = I - cJ (+ solver setup);
+    under ``freeze_precond`` one per window instead of one per attempt.
+``err_rejects`` / ``conv_rejects``
+    rejected attempts split by cause: error test failed with a converged
+    corrector vs Newton convergence failure (incl. non-finite iterates).
+    ``err_rejects + conv_rejects == n_rejected`` exactly.
+``order_hist``  (BDF only)
+    (MAXORD+1,) int32 histogram of *accepted* steps by the order they
+    were taken at; slot 0 is structurally unused (orders run 1..5), and
+    ``order_hist.sum() == n_accepted`` exactly.
+``accept_ring`` / ``it_matrix``  (``step_audit=True`` only)
+    the 64-slot attempt-outcome ring and last iteration matrix — folded
+    into ``stats`` from the legacy top-level fields, which now alias
+    these same arrays.
+
+Counters are gated per lane on *liveness* (a lane parked by termination
+or segmented re-entry stops counting even though the masked device
+program keeps executing its lanes), so they report algorithmic work, not
+SIMD occupancy.
+"""
+
+import numpy as np
+
+#: counter keys common to both solvers (beyond the SolveResult aliases)
+COMMON_KEYS = ("newton_iters", "jac_builds", "factorizations",
+               "err_rejects", "conv_rejects")
+#: additional BDF-only key
+BDF_KEYS = ("order_hist",)
+#: step_audit payloads folded into stats (not counters; excluded from sums)
+AUDIT_KEYS = ("accept_ring", "it_matrix")
+
+
+def masked_add(acc, seg, live):
+    """``acc + seg`` where ``live`` (a (B,) bool mask), 0 elsewhere —
+    broadcasting the mask over trailing axes (the order histogram is
+    (B, MAXORD+1)).  The segmented sweep driver uses this so a lane only
+    accumulates counters from segments it was still running in."""
+    acc = np.asarray(acc)
+    seg = np.asarray(seg)
+    mask = np.asarray(live)
+    mask = mask.reshape(mask.shape + (1,) * (seg.ndim - mask.ndim))
+    return acc + np.where(mask, seg, 0)
+
+
+def accumulate(total, seg_stats, live):
+    """Fold one segment's stats dict into the running ``total`` (None on
+    the first segment), masking by per-lane liveness.  Audit payloads
+    (ring / iteration matrix) are *replaced*, not summed — the latest
+    live segment wins, matching the ring's most-recent-attempts meaning."""
+    if total is None:
+        total = {}
+        for k, v in seg_stats.items():
+            if k in AUDIT_KEYS:
+                total[k] = np.asarray(v)
+            else:
+                total[k] = masked_add(np.zeros_like(np.asarray(v)), v, live)
+        return total
+    out = dict(total)
+    for k, v in seg_stats.items():
+        if k in AUDIT_KEYS:
+            mask = np.asarray(live)
+            mask = mask.reshape(mask.shape + (1,) * (np.asarray(v).ndim
+                                                     - mask.ndim))
+            out[k] = np.where(mask, np.asarray(v), total[k])
+        else:
+            out[k] = masked_add(total[k], v, live)
+    return out
+
+
+def totals(stats):
+    """Reduce a (possibly vmap-batched) stats dict to python totals:
+    scalar counters sum over every axis; ``order_hist`` sums over the
+    batch axis only (stays a per-order list); audit payloads are
+    dropped (they are samples, not counters)."""
+    if stats is None:
+        return None
+    out = {}
+    for k, v in stats.items():
+        if k in AUDIT_KEYS:
+            continue
+        a = np.asarray(v)
+        if k == "order_hist":
+            hist = a.reshape(-1, a.shape[-1]).sum(axis=0)
+            out[k] = [int(x) for x in hist]
+        else:
+            out[k] = int(a.sum())
+    return out
+
+
+def per_lane(stats):
+    """Per-lane numpy view of a batched stats dict (audit payloads
+    dropped); ``None`` passes through."""
+    if stats is None:
+        return None
+    return {k: np.asarray(v) for k, v in stats.items()
+            if k not in AUDIT_KEYS}

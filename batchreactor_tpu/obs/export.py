@@ -1,0 +1,166 @@
+"""Machine-readable report exports: JSON-Lines and Prometheus text.
+
+Two formats, two consumers:
+
+* **JSONL** (:func:`to_jsonl` / :func:`from_jsonl`) — one self-describing
+  JSON object per line, ``kind``-tagged (``meta`` / ``span`` / ``event``
+  / ``counter`` / ``solver_stats`` / ``compile``), streaming-friendly and
+  exactly round-trippable back into the report dict.  This is the CI
+  artifact format and what ``scripts/obs_report.py --json`` emits.
+* **Prometheus text exposition** (:func:`to_prometheus`) — the
+  scrape-compatible gauge/counter rendering for wiring a long-running
+  sweep service into standard dashboards.  Metric names are prefixed
+  ``br_``; label values are escaped per the exposition format.
+"""
+
+import json
+
+from .report import SCHEMA
+
+
+# --------------------------------------------------------------------------
+# JSONL
+# --------------------------------------------------------------------------
+def to_jsonl(report):
+    """Serialize a report dict (``report.build_report``) to JSON-Lines."""
+    lines = [json.dumps({"kind": "meta", "schema": report.get("schema",
+                                                              SCHEMA),
+                         "meta": report.get("meta") or {}},
+                        sort_keys=True)]
+    for s in report.get("spans") or []:
+        lines.append(json.dumps({"kind": "span", **s}, sort_keys=True))
+    for e in report.get("events") or []:
+        lines.append(json.dumps({"kind": "event", **e}, sort_keys=True))
+    for k, v in sorted((report.get("counters") or {}).items()):
+        lines.append(json.dumps({"kind": "counter", "name": k, "value": v},
+                                sort_keys=True))
+    if report.get("solver_stats") is not None:
+        lines.append(json.dumps({"kind": "solver_stats",
+                                 **report["solver_stats"]}, sort_keys=True))
+    if report.get("compile") is not None:
+        lines.append(json.dumps({"kind": "compile", **report["compile"]},
+                                sort_keys=True))
+    return "\n".join(lines) + "\n"
+
+
+def from_jsonl(text):
+    """Inverse of :func:`to_jsonl`: rebuild the report dict."""
+    report = {"schema": SCHEMA, "meta": {}, "spans": [], "events": [],
+              "counters": {}, "solver_stats": None, "compile": None}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        kind = rec.pop("kind")
+        if kind == "meta":
+            report["schema"] = rec.get("schema", SCHEMA)
+            report["meta"] = rec.get("meta", {})
+        elif kind == "span":
+            report["spans"].append(rec)
+        elif kind == "event":
+            report["events"].append(rec)
+        elif kind == "counter":
+            report["counters"][rec["name"]] = rec["value"]
+        elif kind == "solver_stats":
+            report["solver_stats"] = rec
+        elif kind == "compile":
+            report["compile"] = rec
+        else:
+            raise ValueError(f"unknown JSONL record kind {kind!r}")
+    return report
+
+
+def write_jsonl(path, report):
+    """Write the JSONL export to ``path`` (atomic enough for CI: one
+    write call)."""
+    with open(path, "w") as f:
+        f.write(to_jsonl(report))
+
+
+def read_jsonl(path):
+    """Load a report previously written by :func:`write_jsonl`."""
+    with open(path) as f:
+        return from_jsonl(f.read())
+
+
+# --------------------------------------------------------------------------
+# Prometheus text exposition
+# --------------------------------------------------------------------------
+def _esc(value):
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _metric(lines, name, mtype, help_, samples):
+    """Append one metric family; ``samples`` is [(labels_dict, value)]."""
+    if not samples:
+        return
+    lines.append(f"# HELP {name} {help_}")
+    lines.append(f"# TYPE {name} {mtype}")
+    for labels, value in samples:
+        lab = ""
+        if labels:
+            inner = ",".join(f'{k}="{_esc(v)}"'
+                             for k, v in sorted(labels.items()))
+            lab = "{" + inner + "}"
+        lines.append(f"{name}{lab} {value}")
+
+
+def to_prometheus(report):
+    """Render the report as a Prometheus text exposition (format 0.0.4)."""
+    lines = []
+    # spans aggregate by name (a scrape wants totals, not the tree)
+    agg = {}
+    for s in report.get("spans") or []:
+        if s.get("dur") is not None:
+            a = agg.setdefault(s["name"], [0.0, 0])
+            a[0] += s["dur"]
+            a[1] += 1
+    _metric(lines, "br_span_seconds_total", "counter",
+            "Total wall-clock seconds per span name.",
+            [({"span": k}, v[0]) for k, v in sorted(agg.items())])
+    _metric(lines, "br_span_calls_total", "counter",
+            "Number of completed spans per span name.",
+            [({"span": k}, v[1]) for k, v in sorted(agg.items())])
+    _metric(lines, "br_counter_total", "counter",
+            "Recorder counters.",
+            [({"name": k}, v) for k, v in
+             sorted((report.get("counters") or {}).items())])
+
+    totals = (report.get("solver_stats") or {}).get("totals") or {}
+    steps = []
+    if "n_accepted" in totals:
+        steps.append(({"outcome": "accepted"}, totals["n_accepted"]))
+    if "n_rejected" in totals:
+        steps.append(({"outcome": "rejected"}, totals["n_rejected"]))
+    _metric(lines, "br_solver_steps_total", "counter",
+            "Solver step attempts by outcome.", steps)
+    _metric(lines, "br_solver_work_total", "counter",
+            "Solver work counters (Newton iterations, Jacobian builds, "
+            "iteration-matrix factorizations, rejection causes).",
+            [({"kind": k}, totals[k]) for k in
+             ("newton_iters", "jac_builds", "factorizations",
+              "err_rejects", "conv_rejects") if k in totals])
+    if "order_hist" in totals:
+        _metric(lines, "br_solver_order_steps_total", "counter",
+                "Accepted BDF steps by method order.",
+                [({"order": str(q)}, n)
+                 for q, n in enumerate(totals["order_hist"]) if q >= 1])
+
+    comp = report.get("compile") or {}
+    if comp.get("available"):
+        _metric(lines, "br_compiles_total", "counter",
+                "XLA backend compiles per program label.",
+                [({"label": k}, v["compiles"])
+                 for k, v in sorted((comp.get("by_label") or {}).items())])
+        _metric(lines, "br_retraces_total", "counter",
+                "Unexpected recompiles (compiles past the first) per "
+                "program label.",
+                [({"label": k}, v["retraces"])
+                 for k, v in sorted((comp.get("by_label") or {}).items())])
+        _metric(lines, "br_compile_seconds_total", "counter",
+                "XLA backend compile seconds per program label.",
+                [({"label": k}, v["compile_s"])
+                 for k, v in sorted((comp.get("by_label") or {}).items())])
+    return "\n".join(lines) + ("\n" if lines else "")

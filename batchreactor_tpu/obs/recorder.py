@@ -1,0 +1,140 @@
+"""Host-side span tracing: the :class:`Recorder`.
+
+A Recorder collects three record kinds:
+
+* **spans** — nested wall-clock intervals with a name, slash-joined path
+  (``solve/segment``), depth, wall-clock ``start`` (unix seconds),
+  monotonic ``dur`` (``time.perf_counter`` difference), and free-form
+  JSON-able attributes.  Spans nest per *thread* (the checkpoint writer's
+  background save thread records its spans at root depth, interleaved by
+  start time), and timings are host wall-clock: callers timing device
+  work pass ``block=<arrays>`` so ``jax.block_until_ready`` runs inside
+  the span — exactly the contract ``utils.profiling.Phases`` had.
+* **events** — zero-duration points (a retrace warning, a chunk load).
+* **counters** — monotonically accumulated named floats (bytes written,
+  segments launched).
+
+The Recorder never imports jax at module scope and is safe to create on
+hosts with no usable accelerator; ``block=`` imports jax lazily.  All
+appends are lock-guarded so worker threads (checkpoint saves, compile
+listeners) can emit concurrently with the main thread.
+"""
+
+import contextlib
+import threading
+import time
+
+
+@contextlib.contextmanager
+def null_span(*_args, **_kwargs):
+    """Stand-in for ``Recorder.span`` when no recorder is wired: yields a
+    throwaway dict so call sites can unconditionally read ``span["dur"]``
+    (it stays ``None``)."""
+    yield {"name": None, "dur": None, "attrs": {}}
+
+
+def span_or_null(recorder, name, block=None, **attrs):
+    """``recorder.span(...)`` when a recorder is present, else
+    :func:`null_span` — the one-liner every optionally-instrumented call
+    site uses instead of an if/else."""
+    if recorder is None:
+        return null_span()
+    return recorder.span(name, block=block, **attrs)
+
+
+class Recorder:
+    """Collects nested spans, point events, and counters (module doc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._seq = 0
+        self.spans = []     # append order = start order (per the lock)
+        self.events = []
+        self.counters = {}
+
+    # ---- spans ------------------------------------------------------------
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name, block=None, **attrs):
+        """Context manager recording one span; yields the (mutable) span
+        record so callers can read ``span["dur"]`` after the block or add
+        attributes from inside it.  ``block=<pytree>`` runs
+        ``jax.block_until_ready`` on it before the clock stops, so device
+        work launched inside the span is charged to it."""
+        stack = self._stack()
+        path = "/".join([s["name"] for s in stack] + [name])
+        rec = {"name": name, "path": path, "depth": len(stack),
+               "start": time.time(), "dur": None, "attrs": dict(attrs)}
+        with self._lock:
+            rec["seq"] = self._seq
+            self._seq += 1
+            self.spans.append(rec)
+        stack.append(rec)
+        t0 = time.perf_counter()
+        try:
+            yield rec
+        finally:
+            if block is not None:
+                import jax
+
+                jax.block_until_ready(block)
+            rec["dur"] = time.perf_counter() - t0
+            stack.pop()
+
+    # ---- events & counters ------------------------------------------------
+    def event(self, name, **attrs):
+        """Record a point event (e.g. ``retrace``, ``chunk_loaded``)."""
+        with self._lock:
+            self.events.append({"name": name, "time": time.time(),
+                                "attrs": dict(attrs)})
+
+    def counter(self, name, value=1):
+        """Accumulate ``value`` onto the named counter."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + value
+
+    # ---- views ------------------------------------------------------------
+    def by_name(self):
+        """Aggregate spans by *name* -> ``{"total_s", "count"}`` (the
+        Phases-compatible view: repeated spans accumulate)."""
+        agg = {}
+        with self._lock:
+            spans = list(self.spans)
+        for s in spans:
+            if s["dur"] is None:
+                continue
+            a = agg.setdefault(s["name"], {"total_s": 0.0, "count": 0})
+            a["total_s"] += s["dur"]
+            a["count"] += 1
+        return agg
+
+    def summary(self):
+        """``{name: total_seconds}`` over completed spans."""
+        return {k: v["total_s"] for k, v in self.by_name().items()}
+
+    def pretty(self):
+        """Phases-style per-name breakdown, largest first, with call
+        counts."""
+        agg = self.by_name()
+        total = sum(v["total_s"] for v in agg.values()) or 1.0
+        lines = [
+            f"{name:>12s}: {v['total_s']:8.3f}s  "
+            f"({100.0 * v['total_s'] / total:5.1f}%)  x{v['count']}"
+            for name, v in sorted(agg.items(),
+                                  key=lambda kv: -kv[1]["total_s"])
+        ]
+        return "\n".join(lines)
+
+    def snapshot(self):
+        """Copies of (spans, events, counters) safe to serialize while
+        other threads keep recording."""
+        with self._lock:
+            return ([dict(s) for s in self.spans],
+                    [dict(e) for e in self.events],
+                    dict(self.counters))

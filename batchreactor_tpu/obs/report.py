@@ -1,0 +1,203 @@
+"""Assemble, render, and diff telemetry reports.
+
+A *report* is a plain JSON-able dict (schema ``br-obs-v1``) combining the
+three telemetry sources — Recorder spans/events/counters, device-side
+solver stats, and CompileWatch compile/retrace counts — into the one
+artifact ``scripts/obs_report.py`` renders, ``obs.export`` serializes,
+and future perf PRs cite instead of ad-hoc probe scripts (PERF.md).
+
+Report layout::
+
+    {"schema": "br-obs-v1",
+     "meta":     {...free-form: label, backend, workload...},
+     "spans":    [{name, path, depth, start, dur, attrs, seq}, ...],
+     "events":   [{name, time, attrs}, ...],
+     "counters": {name: number},
+     "solver_stats": {"totals": {...}, "per_lane": {key: [...]}} | None,
+     "compile": {"available", "compiles", "traces", "retraces",
+                 "compile_s", "by_label": {...}} | None}
+"""
+
+import numpy as np
+
+from . import counters as C
+
+SCHEMA = "br-obs-v1"
+
+
+def stats_totals(stats):
+    """Alias of :func:`obs.counters.totals` re-exported at package level
+    (the reduction most callers want)."""
+    return C.totals(stats)
+
+
+def _jsonable(v):
+    """Coerce numpy scalars/arrays (and nested containers) to plain
+    python so the report round-trips through json exactly."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, np.ndarray):
+        return _jsonable(v.tolist())
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if hasattr(v, "item") and not isinstance(v, (int, float, str, bool,
+                                                 type(None))):
+        # 0-d jax arrays and friends
+        try:
+            return _jsonable(v.item())
+        except (TypeError, ValueError):
+            return repr(v)
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return v
+    return repr(v)
+
+
+def build_report(recorder=None, solver_stats=None, watch=None, meta=None):
+    """Assemble the report dict from whichever sources the caller has.
+
+    ``solver_stats`` is a ``SolveResult.stats`` dict (scalar per-lane or
+    vmap-batched); per-lane arrays are included only when batched (a
+    single-condition solve's totals ARE its per-lane view)."""
+    spans, events, ctrs = ([], [], {})
+    if recorder is not None:
+        spans, events, ctrs = recorder.snapshot()
+    stats_block = None
+    if solver_stats is not None:
+        totals = C.totals(solver_stats)
+        stats_block = {"totals": totals}
+        lanes = C.per_lane(solver_stats)
+        if lanes and any(np.asarray(v).ndim >= 1 and k != "order_hist"
+                         for k, v in lanes.items()):
+            first = next(iter(lanes.values()))
+            if np.asarray(first).ndim >= 1:
+                stats_block["per_lane"] = {k: np.asarray(v).tolist()
+                                           for k, v in lanes.items()}
+    return _jsonable({
+        "schema": SCHEMA,
+        "meta": dict(meta or {}),
+        "spans": spans,
+        "events": events,
+        "counters": ctrs,
+        "solver_stats": stats_block,
+        "compile": watch.summary() if watch is not None else None,
+    })
+
+
+# --------------------------------------------------------------------------
+# rendering
+# --------------------------------------------------------------------------
+def _fmt_dur(d):
+    return "   ...  " if d is None else f"{d:8.3f}s"
+
+
+def render(report):
+    """Human-readable multi-line rendering: span tree (indented by
+    nesting depth, start order), counters, solver-stat totals with the
+    order histogram, compile/retrace summary, and any events."""
+    lines = []
+    meta = report.get("meta") or {}
+    head = " ".join(f"{k}={v}" for k, v in sorted(meta.items()))
+    lines.append(f"obs report [{report.get('schema', '?')}]"
+                 + (f"  {head}" if head else ""))
+
+    spans = sorted(report.get("spans") or [], key=lambda s: s.get("seq", 0))
+    if spans:
+        lines.append("spans:")
+        for s in spans:
+            attrs = s.get("attrs") or {}
+            extra = ("  " + " ".join(f"{k}={v}" for k, v in
+                                     sorted(attrs.items()))) if attrs else ""
+            lines.append(f"  {_fmt_dur(s.get('dur'))}  "
+                         f"{'  ' * s.get('depth', 0)}{s['name']}{extra}")
+
+    ctrs = report.get("counters") or {}
+    if ctrs:
+        lines.append("counters:")
+        for k in sorted(ctrs):
+            lines.append(f"  {k}: {ctrs[k]}")
+
+    st = (report.get("solver_stats") or {}).get("totals")
+    if st:
+        lines.append("solver:")
+        for k in ("n_accepted", "n_rejected", "newton_iters", "jac_builds",
+                  "factorizations", "err_rejects", "conv_rejects"):
+            if k in st:
+                lines.append(f"  {k}: {st[k]}")
+        if "order_hist" in st:
+            hist = st["order_hist"]
+            lines.append("  order_hist: "
+                         + " ".join(f"{q}:{n}" for q, n in
+                                    enumerate(hist) if q >= 1))
+        per_lane = (report.get("solver_stats") or {}).get("per_lane")
+        if per_lane:
+            b = len(next(iter(per_lane.values())))
+            lines.append(f"  (per-lane stats for {b} lanes in the report)")
+
+    comp = report.get("compile")
+    if comp is not None:
+        if not comp.get("available", True):
+            lines.append("compile: unavailable (no jax.monitoring)")
+        else:
+            lines.append(f"compile: {comp['compiles']} compiles "
+                         f"({comp['compile_s']:.2f}s), {comp['traces']} "
+                         f"traces, {comp['retraces']} retraces")
+            for label, v in sorted((comp.get("by_label") or {}).items()):
+                lines.append(f"  {label}: compiles={v['compiles']} "
+                             f"traces={v['traces']} "
+                             f"retraces={v['retraces']}")
+
+    events = report.get("events") or []
+    if events:
+        lines.append("events:")
+        for e in events:
+            attrs = e.get("attrs") or {}
+            extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+            lines.append(f"  {e['name']}" + (f"  {extra}" if extra else ""))
+    return "\n".join(lines)
+
+
+def diff(a, b):
+    """Compare two reports (baseline ``a`` -> candidate ``b``): per-name
+    span totals, solver-stat totals, and compile counts, with absolute
+    and relative deltas — the tool future perf PRs cite for before/after
+    numbers."""
+
+    def span_totals(rep):
+        agg = {}
+        for s in rep.get("spans") or []:
+            if s.get("dur") is not None:
+                agg[s["name"]] = agg.get(s["name"], 0.0) + s["dur"]
+        return agg
+
+    lines = ["obs diff (a -> b)"]
+    sa, sb = span_totals(a), span_totals(b)
+    for name in sorted(set(sa) | set(sb)):
+        va, vb = sa.get(name), sb.get(name)
+        if va is None or vb is None:
+            lines.append(f"  span {name}: "
+                         f"{'-' if va is None else f'{va:.3f}s'} -> "
+                         f"{'-' if vb is None else f'{vb:.3f}s'}")
+        else:
+            pct = 100.0 * (vb - va) / va if va else float("inf")
+            lines.append(f"  span {name}: {va:.3f}s -> {vb:.3f}s "
+                         f"({pct:+.1f}%)")
+
+    ta = (a.get("solver_stats") or {}).get("totals") or {}
+    tb = (b.get("solver_stats") or {}).get("totals") or {}
+    for k in sorted(set(ta) | set(tb)):
+        va, vb = ta.get(k), tb.get(k)
+        if va != vb:
+            lines.append(f"  solver {k}: {va} -> {vb}")
+    ca, cb = a.get("compile") or {}, b.get("compile") or {}
+    for k in ("compiles", "retraces"):
+        if ca.get(k) != cb.get(k):
+            lines.append(f"  compile {k}: {ca.get(k)} -> {cb.get(k)}")
+    if len(lines) == 1:
+        lines.append("  (no differences in spans / solver stats / compiles)")
+    return "\n".join(lines)

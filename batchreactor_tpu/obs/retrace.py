@@ -1,0 +1,194 @@
+"""Compile & retrace detection: the runtime complement to brlint.
+
+brlint's static pass (``analysis/``) predicts recompilation hazards from
+source and jaxprs; this module *measures* them.  A :class:`CompileWatch`
+hooks ``jax.monitoring`` — the events the runtime itself emits around
+jaxpr tracing (``/jax/core/compile/jaxpr_trace_duration``) and XLA
+backend compilation (``/jax/core/compile/backend_compile_duration``),
+plus the persistent-compilation-cache hit/miss events — and attributes
+them to host-side *program labels* (``watch.region("sweep-segment")``),
+so a report can answer "how many times did the sweep program compile,
+and was any compile unexpected?".
+
+A **retrace** is counted when a *single-program* label (a region entered
+with ``single_program=True`` — one jitted callable relaunched many
+times) sees more than one compile inside a watch window: the program was
+rebuilt for inputs the first build should have covered — exactly the
+hazard class brlint's BR003/BR004 rules flag statically.  Plain labels
+only count (a cold ``batch_reactor`` legitimately compiles several
+distinct helper programs under its one ``solve`` label).  The segmented
+sweep driver marks its per-segment launches single-program, so any
+compile after the first segment surfaces as a retrace event on the wired
+Recorder.
+
+``jax.monitoring`` listeners are process-global and not individually
+removable, so ONE dispatching listener pair is installed lazily on first
+use and fans out to the currently-entered watches (a lock-guarded list);
+a watch outside its ``with`` block costs nothing.  On jax builds without
+``jax.monitoring`` the watch degrades to counting nothing — reports then
+show ``compile: unavailable`` rather than lying with zeros.
+"""
+
+import threading
+
+#: jax.monitoring event names (jax._src.dispatch / compilation_cache)
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_LOCK = threading.Lock()
+_WATCHES = []
+_INSTALLED = False
+
+
+def _dispatch_event(event, **_kw):
+    with _LOCK:
+        watches = list(_WATCHES)
+    for w in watches:
+        w._on_event(event)
+
+
+def _dispatch_duration(event, duration, **_kw):
+    with _LOCK:
+        watches = list(_WATCHES)
+    for w in watches:
+        w._on_duration(event, duration)
+
+
+def _install():
+    """Register the process-global dispatchers once; returns False when
+    jax.monitoring is unavailable (the watch then records nothing)."""
+    global _INSTALLED
+    with _LOCK:
+        if _INSTALLED:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:
+            return False
+        monitoring.register_event_listener(_dispatch_event)
+        monitoring.register_event_duration_secs_listener(_dispatch_duration)
+        _INSTALLED = True
+        return True
+
+
+class CompileWatch:
+    """Counts traces / XLA compiles / cache hits per program label while
+    entered (module doc).
+
+    >>> watch = CompileWatch(recorder=rec)
+    >>> with watch, watch.region("sweep-segment"):
+    ...     res = jitted(...)
+    >>> watch.summary()["compiles"]
+    """
+
+    def __init__(self, recorder=None, default_label="program"):
+        self.recorder = recorder
+        self.default_label = default_label
+        self.by_label = {}
+        self.available = None   # unknown until __enter__
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+
+    # ---- label regions ----------------------------------------------------
+    def _label(self):
+        stack = getattr(self._tls, "labels", None)
+        return stack[-1] if stack else (self.default_label, False)
+
+    def region(self, label, single_program=False):
+        """Context manager: attribute compile events on this thread to
+        ``label`` while active (nests; innermost wins).
+        ``single_program=True`` declares that the region relaunches ONE
+        jitted program, arming retrace detection for the label: every
+        compile past the label's first is then flagged."""
+        watch = self
+
+        class _Region:
+            def __enter__(self):
+                stack = getattr(watch._tls, "labels", None)
+                if stack is None:
+                    stack = watch._tls.labels = []
+                stack.append((label, single_program))
+                return self
+
+            def __exit__(self, *exc):
+                watch._tls.labels.pop()
+                return False
+
+        return _Region()
+
+    # ---- lifecycle --------------------------------------------------------
+    def __enter__(self):
+        self.available = _install()
+        if self.available:
+            with _LOCK:
+                _WATCHES.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        if self.available:
+            with _LOCK:
+                if self in _WATCHES:
+                    _WATCHES.remove(self)
+        return False
+
+    # ---- listener callbacks (any thread) ----------------------------------
+    def _entry(self):
+        label, single = self._label()
+        with self._lock:
+            e = self.by_label.setdefault(
+                label, {"traces": 0, "compiles": 0, "compile_s": 0.0,
+                        "cache_hits": 0, "cache_misses": 0, "retraces": 0,
+                        "single_program": single})
+            # any region arming the label keeps it armed (a label is
+            # single-program by declaration, not by majority vote)
+            e["single_program"] = e["single_program"] or single
+            return e
+
+    def _on_event(self, event):
+        if event == CACHE_HIT_EVENT:
+            e = self._entry()
+            with self._lock:
+                e["cache_hits"] += 1
+        elif event == CACHE_MISS_EVENT:
+            e = self._entry()
+            with self._lock:
+                e["cache_misses"] += 1
+
+    def _on_duration(self, event, duration):
+        if event == TRACE_EVENT:
+            e = self._entry()
+            with self._lock:
+                e["traces"] += 1
+        elif event == BACKEND_COMPILE_EVENT:
+            e = self._entry()
+            with self._lock:
+                e["compiles"] += 1
+                e["compile_s"] += float(duration)
+                retrace = e["single_program"] and e["compiles"] > 1
+                if retrace:
+                    e["retraces"] += 1
+            if retrace and self.recorder is not None:
+                self.recorder.event(
+                    "retrace", label=self._label()[0],
+                    compiles=e["compiles"], duration_s=float(duration))
+
+    # ---- views ------------------------------------------------------------
+    def summary(self):
+        """``{"available", "compiles", "traces", "retraces", "compile_s",
+        "by_label"}`` totals over the watch window."""
+        with self._lock:
+            by_label = {k: dict(v) for k, v in self.by_label.items()}
+        return {
+            "available": bool(self.available),
+            "compiles": sum(v["compiles"] for v in by_label.values()),
+            "traces": sum(v["traces"] for v in by_label.values()),
+            "retraces": sum(v["retraces"] for v in by_label.values()),
+            "compile_s": sum(v["compile_s"] for v in by_label.values()),
+            "by_label": by_label,
+        }
+
+    @property
+    def retraces(self):
+        return sum(v["retraces"] for v in self.by_label.values())

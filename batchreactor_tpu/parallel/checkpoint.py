@@ -16,12 +16,12 @@ import concurrent.futures as _futures
 import hashlib
 import json
 import os
-import time as _time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.recorder import Recorder
 from ..solver.sdirk import SolveResult
 from .sweep import ensemble_solve
 
@@ -49,12 +49,20 @@ def _obs_dict(res):
 
 
 def save_result(path, res, cfgs=None):
-    """Write a (possibly batched) SolveResult [+ conditions] to one .npz."""
+    """Write a (possibly batched) SolveResult [+ conditions] to one .npz.
+
+    The telemetry counter block (``stats=True`` in ``solve_kw`` —
+    obs/counters.py) persists under ``stat_*`` keys, so resumed chunks
+    keep their counters and a checkpointed sweep's concatenated result
+    reports them like an unchunked one."""
     payload = {f: np.asarray(getattr(res, f)) for f in _FIELDS}
     obs = _obs_dict(res)
     if obs is not None:
         for k, v in obs.items():
             payload[f"obs_{k}"] = np.asarray(v)
+    if res.stats is not None:
+        for k, v in res.stats.items():
+            payload[f"stat_{k}"] = np.asarray(v)
     if cfgs:
         for k, v in cfgs.items():
             payload[f"cfg_{k}"] = np.asarray(v)
@@ -67,8 +75,10 @@ def load_result(path):
     """Inverse of :func:`save_result` -> (SolveResult, cfgs dict)."""
     with np.load(path) as z:
         obs = {k[4:]: jnp.asarray(z[k]) for k in z.files if k.startswith("obs_")}
+        stats = {k[5:]: jnp.asarray(z[k]) for k in z.files
+                 if k.startswith("stat_")}
         res = SolveResult(**{f: jnp.asarray(z[f]) for f in _FIELDS},
-                          observed=obs or None)
+                          observed=obs or None, stats=stats or None)
         cfgs = {k[4:]: jnp.asarray(z[k]) for k in z.files if k.startswith("cfg_")}
     return res, cfgs
 
@@ -79,10 +89,14 @@ def _concat_results(parts):
         keys = parts[0].observed.keys()
         observed = {k: jnp.concatenate([p.observed[k] for p in parts], axis=0)
                     for k in keys}
+    stats = None
+    if parts and parts[0].stats is not None:
+        stats = {k: jnp.concatenate([p.stats[k] for p in parts], axis=0)
+                 for k in parts[0].stats}
     return SolveResult(**{
         f: jnp.concatenate([getattr(p, f) for p in parts], axis=0)
         for f in _FIELDS
-    }, observed=observed)
+    }, observed=observed, stats=stats)
 
 
 def _hash_callable(h, fn, depth=0):
@@ -168,7 +182,8 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
 
 
 def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
-                       lane_cost=None, chunk_log=None, **solve_kw):
+                       lane_cost=None, chunk_log=None, recorder=None,
+                       **solve_kw):
     """ensemble_solve with chunk-level checkpoint/resume.
 
     Splits the (B, ...) batch into ``chunk_size`` pieces; chunk i's result is
@@ -199,7 +214,22 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     ``ensemble_solve_segmented`` (bounded device launches — the safe mode
     on tunneled TPU runtimes); ``max_steps`` then maps onto the segmented
     path's exact per-lane attempt budget.
+
+    ``recorder`` (an ``obs.Recorder``) collects the per-chunk telemetry —
+    ``chunk_solve`` spans (with lane counts and attempt stats as
+    attributes), ``chunk_save`` spans from the background writer thread,
+    ``chunk_loaded`` events for resumed chunks, and (with
+    ``segment_steps > 0``) the segmented driver's per-segment spans and
+    retrace detection — so segmented-sweep save/solve timings land in
+    the same report as everything else (docs/observability.md).  When
+    omitted, a private recorder still drives the ``chunk_log`` lines
+    (unchanged), but segment-level telemetry stays off: a checkpointed
+    sweep is long-running by design, and per-segment spans nobody reads
+    would grow host memory for its whole life.  The recorder is
+    deliberately NOT part of the sweep fingerprint (it describes the
+    observer, not the sweep).
     """
+    rec = recorder if recorder is not None else Recorder()
     y0s = jnp.asarray(y0s)
     perm = inv_perm = None
     if lane_cost is not None:
@@ -263,10 +293,17 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
                     f"by the segmented sweep path (segment_steps > 0)")
             kw = {k: v for k, v in solve_kw.items() if k not in handled}
             ms = int(solve_kw.get("max_steps", 200_000))
+            # the CALLER's recorder, not the private rec: segment-level
+            # spans on a default max_steps sweep are ~200 per chunk, and
+            # recording them into a recorder nobody reads would grow host
+            # memory for the whole (long-running, by design) sweep — the
+            # private rec only drives the chunk_log chunk timings.  With
+            # recorder=None the segmented driver records nothing and arms
+            # no CompileWatch: segment telemetry is opt-in via recorder=.
             res = ensemble_solve_segmented(
                 rhs, y0c, t0, t1, cfgc, segment_steps=seg_steps,
                 max_segments=max(1, -(-ms // seg_steps)), max_attempts=ms,
-                **kw)
+                recorder=recorder, **kw)
         else:
             kw = {k: v for k, v in solve_kw.items() if k != "segment_steps"}
             res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **kw)
@@ -304,11 +341,14 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
 
     def _save_async(i, path, res, chunk_cfgs):
         def job():
-            t_c = _time.perf_counter()
-            save_result(path, res, chunk_cfgs)
+            # runs on the writer thread: the recorder records it as a
+            # root-level span interleaved with the main thread's
+            # chunk_solve spans (obs/recorder.py thread semantics)
+            with rec.span("chunk_save", chunk=i) as sp:
+                save_result(path, res, chunk_cfgs)
             if chunk_log is not None:
                 chunk_log(f"[ckpt] chunk {i} saved "
-                          f"({_time.perf_counter() - t_c:.2f}s, async)")
+                          f"({sp['dur']:.2f}s, async)")
         if pending:
             # peek-then-pop: if an interrupt lands while blocked here, the
             # future stays in ``pending`` so the unwind loop below can still
@@ -322,17 +362,22 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
             path = os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
             chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
             if os.path.exists(path):
-                res, _ = load_result(path)
+                with rec.span("chunk_load", chunk=i):
+                    res, _ = load_result(path)
+                rec.event("chunk_loaded", chunk=i, path=path)
                 if chunk_log is not None:
                     chunk_log(f"[ckpt] chunk {i} loaded from {path}")
             else:
-                t_c = _time.perf_counter()
-                res = _solve_chunk(y0s[lo:hi], chunk_cfgs)
-                jax.block_until_ready(res.y)
-                solve_s = _time.perf_counter() - t_c
+                with rec.span("chunk_solve", chunk=i,
+                              lanes=hi - lo) as sp:
+                    res = _solve_chunk(y0s[lo:hi], chunk_cfgs)
+                    jax.block_until_ready(res.y)
+                att = (np.asarray(res.n_accepted)
+                       + np.asarray(res.n_rejected))
+                sp["attrs"]["attempts_mean"] = float(att.mean())
+                sp["attrs"]["attempts_max"] = int(att.max())
+                solve_s = sp["dur"]
                 if chunk_log is not None:
-                    att = (np.asarray(res.n_accepted)
-                           + np.asarray(res.n_rejected))
                     chunk_log(
                         f"[ckpt] chunk {i} ({hi - lo} lanes): solve "
                         f"{solve_s:.2f}s ({(hi - lo) / solve_s:.1f} cond/s), "

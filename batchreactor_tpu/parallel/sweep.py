@@ -16,6 +16,7 @@ mask out).  Per-lane ``status`` arrays are the failure-detection surface
 poisoning its neighbours.
 """
 
+import contextlib
 import functools
 
 import jax
@@ -23,6 +24,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import counters as obs_counters
+from ..obs.recorder import span_or_null
+from ..obs.retrace import CompileWatch
 from ..solver import bdf, sdirk
 
 _SOLVERS = {"sdirk": sdirk.solve, "bdf": bdf.solve}
@@ -69,7 +73,8 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    rtol=1e-6, atol=1e-10, max_steps=200_000, n_save=0,
                    dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
                    observer=None, observer_init=None, jac_window=1,
-                   newton_tol=0.03, method="bdf", freeze_precond=False):
+                   newton_tol=0.03, method="bdf", freeze_precond=False,
+                   stats=False):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
@@ -82,6 +87,11 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     across calls — build them once, sweep many times.  A freshly constructed
     closure per call (e.g. ``ignition_observer(...)`` inside a loop) forces
     a full recompile every call, minutes at GRI scale on TPU.
+
+    ``stats=True`` turns on the solvers' device-side counter block
+    (``SolveResult.stats``, key semantics ``obs/counters.py``) — under
+    vmap every counter is per lane, so the sweep's step/Newton/rejection
+    histograms come back batched for free.
     """
     _check_method(method, newton_tol)
     if freeze_precond and method != "bdf":
@@ -89,7 +99,8 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
             f"freeze_precond is a bdf-only knob; method={method!r}")
     jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
                             dt_min_factor, linsolve, jac, observer,
-                            jac_window, newton_tol, method, freeze_precond)
+                            jac_window, newton_tol, method, freeze_precond,
+                            stats)
     t0 = jnp.asarray(t0, dtype=y0s.dtype)
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     obs0 = observer_init if observer is not None else 0.0
@@ -120,7 +131,8 @@ def _check_method(method, newton_tol):
 @functools.lru_cache(maxsize=64)
 def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
                    linsolve, jac=None, observer=None, jac_window=1,
-                   newton_tol=0.03, method="bdf", freeze_precond=False):
+                   newton_tol=0.03, method="bdf", freeze_precond=False,
+                   stats=False):
     """One compiled batched solve per (rhs, solver-settings) combination.
 
     Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
@@ -139,7 +151,8 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
             rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
             n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
             linsolve=linsolve, jac=jac, observer=observer,
-            observer_init=obs0 if observer is not None else None, **kw)
+            observer_init=obs0 if observer is not None else None,
+            stats=stats, **kw)
 
     return jax.jit(jax.vmap(one, in_axes=(0, None, None, 0, None)))
 
@@ -147,7 +160,8 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
 def ensemble_solve_forward(rhs_theta, y0s, t0, t1, theta, cfgs, *,
                            mesh=None, axis="batch", rtol=1e-6, atol=1e-10,
                            max_steps=200_000, jac=None, jac_window=1,
-                           linsolve="auto", sens_iters=2, S0=None):
+                           linsolve="auto", sens_iters=2, S0=None,
+                           stats=False):
     """Forward-sensitivity ensemble sweep: one theta, per-lane conditions.
 
     The sensitivity-aware twin of :func:`ensemble_solve` — each lane
@@ -166,7 +180,7 @@ def ensemble_solve_forward(rhs_theta, y0s, t0, t1, theta, cfgs, *,
     caching rules as :func:`ensemble_solve`.
     """
     jitted = _cached_vsolve_forward(rhs_theta, rtol, atol, max_steps, jac,
-                                    jac_window, linsolve, sens_iters)
+                                    jac_window, linsolve, sens_iters, stats)
     y0s = jnp.asarray(y0s)
     t0 = jnp.asarray(t0, dtype=y0s.dtype)
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
@@ -185,7 +199,7 @@ def ensemble_solve_forward(rhs_theta, y0s, t0, t1, theta, cfgs, *,
 
 @functools.lru_cache(maxsize=32)
 def _cached_vsolve_forward(rhs_theta, rtol, atol, max_steps, jac,
-                           jac_window, linsolve, sens_iters):
+                           jac_window, linsolve, sens_iters, stats=False):
     """One compiled batched forward-sensitivity solve per (rhs_theta,
     solver-settings) combination — same recompile economics as
     :func:`_cached_vsolve`; theta enters as a traced operand so perturbed
@@ -198,7 +212,7 @@ def _cached_vsolve_forward(rhs_theta, rtol, atol, max_steps, jac,
         return solve_forward(
             rhs_theta, y0, t0, t1, theta, cfg, rtol=rtol, atol=atol,
             max_steps=max_steps, jac=jac, jac_window=jac_window,
-            linsolve=linsolve, sens_iters=sens_iters, S0=S0)
+            linsolve=linsolve, sens_iters=sens_iters, S0=S0, stats=stats)
 
     return jax.jit(jax.vmap(one, in_axes=(0, None, None, None, 0, None)))
 
@@ -222,7 +236,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              linsolve="auto", jac=None, observer=None,
                              observer_init=None, dt_min_factor=1e-22,
                              n_save=0, rhs_bundle=None, jac_window=1,
-                             newton_tol=0.03, method="bdf"):
+                             newton_tol=0.03, method="bdf", stats=False,
+                             recorder=None, watch=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -262,6 +277,21 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     ``segment_steps - 1`` attempts past the budget; the monolithic path
     would have reported MaxIters.  The failing direction — the resource
     bound — is exact.)
+
+    Telemetry (``obs/``): ``stats=True`` turns on the solvers' per-lane
+    device counter block, accumulated host-side across segments exactly
+    like the step counts (a parked lane stops accumulating); ``recorder``
+    (an ``obs.Recorder``) gets one ``segment`` span per device launch.
+    Segment launches are attributed to an armed ``sweep-segment``
+    compile label: segments re-run ONE cached program, so any compile
+    past the first is flagged as a ``retrace`` (the runtime twin of
+    brlint's static hazard pass).  ``watch`` is the ``obs.CompileWatch``
+    to arm — pass the caller's already-entered watch so the retrace
+    counts land in its report (api.py does); with ``watch=None`` and a
+    recorder wired, a private watch is entered whose retraces surface as
+    recorder events only.  Host-side eager ops between segments
+    attribute to the unarmed ``sweep-host`` label of the private watch
+    (or the enclosing watch's own default), never to the armed one.
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
@@ -276,7 +306,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                                       None if rhs_bundle is not None else jac,
                                       observer, seg_save,
                                       rhs_bundle is not None, jac_window,
-                                      newton_tol, method)
+                                      newton_tol, method, stats)
     bundle_arg = rhs_bundle if rhs_bundle is not None else 0.0
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     t = jnp.full((B,), t0, dtype=y0s.dtype)
@@ -313,90 +343,111 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     final_t = np.full((B,), np.nan)
     n_acc = np.zeros((B,), dtype=np.int64)
     n_rej = np.zeros((B,), dtype=np.int64)
+    stats_acc = None
     if n_save:
         all_ts = np.full((B, int(n_save)), np.inf)
         all_ys = np.zeros((B, int(n_save)) + y0s.shape[1:])
         saved = np.zeros((B,), dtype=np.int64)
-    for seg in range(max_segments):
-        res = jitted(bundle_arg, y, t, t1, cfgs, h, e, obs, sstate)
-        # ONE host round-trip for every per-segment scalar vector the host
-        # loop reads: on tunneled accelerators each separate np.asarray is
-        # its own device->host RPC, and the per-segment chatter (not the
-        # solve) was a prime suspect for the northstar map-vs-rung gap
-        # (PERF.md round-4 addendum)
-        status, seg_acc, seg_rej, seg_t, seg_saved = jax.device_get(
-            (res.status, res.n_accepted, res.n_rejected, res.t,
-             res.n_saved))
-        # only lanes still live this segment contribute step counts: parked
-        # lanes re-enter as zero-span solves that burn one rejected attempt
-        running = final_status == int(sdirk.RUNNING)
-        n_acc += np.where(running, seg_acc, 0)
-        n_rej += np.where(running, seg_rej, 0)
-        if n_save:
-            # drain this segment's device buffer into the host trajectory —
-            # vectorized masked scatter, no per-lane Python loop, and the
-            # (B, seg_save, S) transfer is skipped entirely for segments
-            # that saved nothing (only the small n_saved vector moves)
-            seg_n = seg_saved
-            take = np.where(running, np.minimum(seg_n, int(n_save) - saved),
-                            0)
-            drained_ts = None
-            if take.max() > 0:
-                seg_ts, seg_ys = jax.device_get((res.ts, res.ys))
-                col = np.arange(seg_ts.shape[1])
-                src = col[None, :] < take[:, None]           # (B, seg_save)
-                b_idx, c_idx = np.nonzero(src)
-                dst = saved[b_idx] + c_idx
-                all_ts[b_idx, dst] = seg_ts[b_idx, c_idx]
-                all_ys[b_idx, dst] = seg_ys[b_idx, c_idx]
-                saved += take
-                drained_ts = seg_ts[b_idx, c_idx]  # lane-major, in-lane order
-        terminal = status != int(sdirk.MAX_STEPS_REACHED)
-        newly_terminal = running & terminal
-        final_status = np.where(newly_terminal, status, final_status)
-        # the reported t for a terminal lane is the t at the segment where it
-        # first terminated (for DT_UNDERFLOW that is the failure time, same
-        # as the unsegmented path reports) — not the t1 it gets parked at
-        final_t = np.where(newly_terminal, seg_t, final_t)
-        if max_attempts is not None:
-            # exact per-lane attempt budget (monolithic max_steps parity):
-            # park still-running lanes whose budget is spent as MaxSteps
-            exhausted = (final_status == int(sdirk.RUNNING)) & (
-                n_acc + n_rej >= int(max_attempts))
-            final_status = np.where(exhausted,
-                                    int(sdirk.MAX_STEPS_REACHED),
-                                    final_status)
-            final_t = np.where(exhausted, seg_t, final_t)
-        parked = jnp.asarray(final_status != int(sdirk.RUNNING))
-        t = jnp.where(parked, t1, res.t)
-        y = res.y
-        # lanes parked *before* this segment ran a zero-span solve whose
-        # res.h is NaN — keep their last live h (and PI memory); lanes that
-        # terminated this segment take res.h (their final adapted step size)
-        h = jnp.where(jnp.asarray(~running), h, res.h)
-        e = jnp.where(jnp.asarray(~running), e, res.err_prev)
-        if method == "bdf":
-            # the multistep history resumes across segments (the zero-span
-            # `already` guard holds parked lanes' carry unchanged)
-            sstate = res.solver_state
-        if observer is not None:
-            obs = res.observed
-        done = not bool(np.any(final_status == int(sdirk.RUNNING)))
-        if progress is not None:
-            payload = {"segment": seg, "lanes_done": int(
-                (final_status != int(sdirk.RUNNING)).sum()), "n_lanes": B,
-                "accepted_total": int(n_acc.sum())}
-            if n_save and drained_ts is not None:
-                # accepted times drained this segment (lane-major) — the
-                # live per-step terminal progress the file-driven API
-                # prints (reference /root/reference/src/BatchReactor.jl:401)
-                payload["drained_ts"] = drained_ts
-            progress(payload)
-        if done:
-            break
-    else:
-        final_status[final_status == int(sdirk.RUNNING)] = int(
-            sdirk.MAX_STEPS_REACHED)
+    # segments re-launch ONE cached program; any compile after segment 0
+    # is unexpected and surfaces as a retrace.  Use the caller's watch
+    # when given (its report then carries the armed label); otherwise
+    # enter a private one.  Its default label ("sweep-host") is distinct
+    # from the armed region label, so the host loop's own eager-op
+    # compiles between segments can never masquerade as retraces.
+    own_watch = None
+    if watch is None and recorder is not None:
+        own_watch = CompileWatch(recorder=recorder,
+                                 default_label="sweep-host")
+        watch = own_watch
+    with (own_watch if own_watch is not None else contextlib.nullcontext()):
+        for seg in range(max_segments):
+            region = (watch.region("sweep-segment", single_program=True)
+                      if watch is not None else contextlib.nullcontext())
+            with span_or_null(recorder, "segment", index=seg), region:
+                res = jitted(bundle_arg, y, t, t1, cfgs, h, e, obs, sstate)
+                # ONE host round-trip for every per-segment scalar vector
+                # the host loop reads: on tunneled accelerators each
+                # separate np.asarray is its own device->host RPC, and the
+                # per-segment chatter (not the solve) was a prime suspect
+                # for the northstar map-vs-rung gap (PERF.md round-4
+                # addendum)
+                status, seg_acc, seg_rej, seg_t, seg_saved = jax.device_get(
+                    (res.status, res.n_accepted, res.n_rejected, res.t,
+                     res.n_saved))
+            # only lanes still live this segment contribute step counts:
+            # parked lanes re-enter as zero-span solves that burn one
+            # rejected attempt
+            running = final_status == int(sdirk.RUNNING)
+            n_acc += np.where(running, seg_acc, 0)
+            n_rej += np.where(running, seg_rej, 0)
+            if stats:
+                stats_acc = obs_counters.accumulate(
+                    stats_acc, jax.device_get(res.stats), running)
+            if n_save:
+                # drain this segment's device buffer into the host trajectory —
+                # vectorized masked scatter, no per-lane Python loop, and the
+                # (B, seg_save, S) transfer is skipped entirely for segments
+                # that saved nothing (only the small n_saved vector moves)
+                seg_n = seg_saved
+                take = np.where(running, np.minimum(seg_n, int(n_save) - saved),
+                                0)
+                drained_ts = None
+                if take.max() > 0:
+                    seg_ts, seg_ys = jax.device_get((res.ts, res.ys))
+                    col = np.arange(seg_ts.shape[1])
+                    src = col[None, :] < take[:, None]           # (B, seg_save)
+                    b_idx, c_idx = np.nonzero(src)
+                    dst = saved[b_idx] + c_idx
+                    all_ts[b_idx, dst] = seg_ts[b_idx, c_idx]
+                    all_ys[b_idx, dst] = seg_ys[b_idx, c_idx]
+                    saved += take
+                    drained_ts = seg_ts[b_idx, c_idx]  # lane-major, in-lane order
+            terminal = status != int(sdirk.MAX_STEPS_REACHED)
+            newly_terminal = running & terminal
+            final_status = np.where(newly_terminal, status, final_status)
+            # the reported t for a terminal lane is the t at the segment where it
+            # first terminated (for DT_UNDERFLOW that is the failure time, same
+            # as the unsegmented path reports) — not the t1 it gets parked at
+            final_t = np.where(newly_terminal, seg_t, final_t)
+            if max_attempts is not None:
+                # exact per-lane attempt budget (monolithic max_steps parity):
+                # park still-running lanes whose budget is spent as MaxSteps
+                exhausted = (final_status == int(sdirk.RUNNING)) & (
+                    n_acc + n_rej >= int(max_attempts))
+                final_status = np.where(exhausted,
+                                        int(sdirk.MAX_STEPS_REACHED),
+                                        final_status)
+                final_t = np.where(exhausted, seg_t, final_t)
+            parked = jnp.asarray(final_status != int(sdirk.RUNNING))
+            t = jnp.where(parked, t1, res.t)
+            y = res.y
+            # lanes parked *before* this segment ran a zero-span solve whose
+            # res.h is NaN — keep their last live h (and PI memory); lanes that
+            # terminated this segment take res.h (their final adapted step size)
+            h = jnp.where(jnp.asarray(~running), h, res.h)
+            e = jnp.where(jnp.asarray(~running), e, res.err_prev)
+            if method == "bdf":
+                # the multistep history resumes across segments (the zero-span
+                # `already` guard holds parked lanes' carry unchanged)
+                sstate = res.solver_state
+            if observer is not None:
+                obs = res.observed
+            done = not bool(np.any(final_status == int(sdirk.RUNNING)))
+            if progress is not None:
+                payload = {"segment": seg, "lanes_done": int(
+                    (final_status != int(sdirk.RUNNING)).sum()), "n_lanes": B,
+                    "accepted_total": int(n_acc.sum())}
+                if n_save and drained_ts is not None:
+                    # accepted times drained this segment (lane-major) — the
+                    # live per-step terminal progress the file-driven API
+                    # prints (reference /root/reference/src/BatchReactor.jl:401)
+                    payload["drained_ts"] = drained_ts
+                progress(payload)
+            if done:
+                break
+        else:
+            final_status[final_status == int(sdirk.RUNNING)] = int(
+                sdirk.MAX_STEPS_REACHED)
     # lanes that never terminated (budget exhausted) report their current t
     final_t = np.where(np.isnan(final_t), seg_t, final_t)
 
@@ -411,14 +462,16 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         status=jnp.asarray(final_status),
         n_accepted=jnp.asarray(n_acc), n_rejected=jnp.asarray(n_rej),
         ts=ts_out, ys=ys_out, n_saved=n_saved_out, h=h,
-        observed=obs if observer is not None else None)
+        observed=obs if observer is not None else None,
+        stats=(None if stats_acc is None
+               else {k: jnp.asarray(v) for k, v in stats_acc.items()}))
 
 
 @functools.lru_cache(maxsize=64)
 def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
                              linsolve, jac, observer, n_save=0,
                              bundle_mode=False, jac_window=1,
-                             newton_tol=0.03, method="bdf"):
+                             newton_tol=0.03, method="bdf", stats=False):
     """Compiled per-segment batched solve: per-lane t0 and carried-in step
     size are traced operands (vmap axis 0), so every segment reuses one
     executable.  In ``bundle_mode`` the first operand is a mechanism-bundle
@@ -436,7 +489,7 @@ def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
             rhs_fn, y0, t0, t1, cfg, rtol=rtol, atol=atol,
             max_steps=segment_steps, n_save=n_save, dt0=h0, err0=e0,
             dt_min_factor=dt_min_factor, linsolve=linsolve, jac=jac_fn,
-            observer=observer,
+            observer=observer, stats=stats,
             observer_init=obs0 if observer is not None else None, **kw)
 
     return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0, 0)))

@@ -193,7 +193,8 @@ def ignition_delay_qoi(marker, frac=0.5):
 def solve_adjoint(rhs_theta, qoi_fn, y0, t0, t1, theta, cfg, *,
                   jac_theta=None, rtol=1e-6, atol=1e-10, grid_size=256,
                   segments=8, grid_refine=2, max_steps=100_000,
-                  jac_window=1, linsolve="auto", dt0=None):
+                  jac_window=1, linsolve="auto", dt0=None, stats=False,
+                  recorder=None):
     """Gradient of a scalar QoI with respect to theta, adjoint-style.
 
     ``rhs_theta(t, y, theta, cfg)`` / optional ``jac_theta(t, y, theta,
@@ -217,7 +218,15 @@ def solve_adjoint(rhs_theta, qoi_fn, y0, t0, t1, theta, cfg, *,
 
     Pure lax control flow end to end: jit it, vmap it over lanes, shard
     the vmapped batch — no host callbacks anywhere.
+
+    Telemetry: ``stats=True`` turns on the grid-pinning pass's device
+    counter block (returned in ``aux["stats"]``); ``recorder`` (an
+    ``obs.Recorder``) gets blocking ``adjoint_pin`` / ``adjoint_grad``
+    spans around the two passes — pass one only from eager callers (a
+    span inside a jitted wrapper would time tracing, not solving).
     """
+    from ..obs.recorder import span_or_null
+
     linsolve = _resolve_linsolve(linsolve)
     theta0 = jax.tree.map(lax.stop_gradient, theta)
 
@@ -229,10 +238,13 @@ def solve_adjoint(rhs_theta, qoi_fn, y0, t0, t1, theta, cfg, *,
         def jac0(t, y, cfg):
             return jac_theta(t, y, theta0, cfg)
 
-    prim = bdf.solve(rhs0, jnp.asarray(y0), t0, t1, cfg, rtol=rtol,
-                     atol=atol, max_steps=max_steps, n_save=grid_size,
-                     jac=jac0, jac_window=jac_window, linsolve=linsolve,
-                     dt0=dt0)
+    with span_or_null(recorder, "adjoint_pin", grid_size=int(grid_size)):
+        prim = bdf.solve(rhs0, jnp.asarray(y0), t0, t1, cfg, rtol=rtol,
+                         atol=atol, max_steps=max_steps, n_save=grid_size,
+                         jac=jac0, jac_window=jac_window, linsolve=linsolve,
+                         dt0=dt0, stats=stats)
+        if recorder is not None:
+            jax.block_until_ready(prim.y)
     t1 = jnp.asarray(t1, dtype=prim.ts.dtype)
     tk = jnp.minimum(lax.stop_gradient(prim.ts), t1)  # inf pads -> t1
     t_prev = jnp.concatenate(
@@ -261,8 +273,12 @@ def solve_adjoint(rhs_theta, qoi_fn, y0, t0, t1, theta, cfg, *,
                                         t_next, theta_, cfg, segments)
         return qoi_fn(t_next, ys, y_final)
 
-    qoi, grad = jax.value_and_grad(qoi_of)(theta)
+    with span_or_null(recorder, "adjoint_grad", segments=int(segments)):
+        qoi, grad = jax.value_and_grad(qoi_of)(theta)
+        if recorder is not None:
+            jax.block_until_ready(qoi)
     aux = {"status": prim.status, "t": prim.t, "y": prim.y,
            "n_accepted": prim.n_accepted, "n_rejected": prim.n_rejected,
-           "truncated": prim.n_accepted > grid_size, "ts": tk}
+           "truncated": prim.n_accepted > grid_size, "ts": tk,
+           "stats": prim.stats}
     return qoi, grad, aux

@@ -60,7 +60,7 @@ def solve_forward(rhs_theta, y0, t0, t1, theta, cfg, *, rtol=1e-6,
                   atol=1e-10, max_steps=100_000, n_save=0, dt0=None,
                   jac=None, jac_window=1, linsolve="auto", sens_iters=2,
                   sens_errcon=False, observer=None, observer_init=None,
-                  S0=None, step_audit=False):
+                  S0=None, step_audit=False, stats=False, recorder=None):
     """Integrate state + forward sensitivities in one BDF solve.
 
     Returns the plain :class:`~..solver.sdirk.SolveResult` with
@@ -70,8 +70,15 @@ def solve_forward(rhs_theta, y0, t0, t1, theta, cfg, *, rtol=1e-6,
     ``jac`` is the analytic state Jacobian at the *given* theta (build it
     from ``params.apply(mech, theta, spec)`` — api.py does); ``S0``
     overrides the zero initial tangents when y0 depends on theta.
-    Remaining knobs mirror ``bdf.solve``.
+    Remaining knobs mirror ``bdf.solve``, including the telemetry pair:
+    ``stats=True`` turns on the device counter block (the tangent-carrying
+    program counts exactly like the plain solve — obs/counters.py), and
+    ``recorder`` (an ``obs.Recorder``) gets a blocking ``sens_forward``
+    span around the solve.  Pass a recorder only from eager callers — a
+    span inside a jitted/vmapped wrapper would time tracing, not solving.
     """
+    from ..obs.recorder import span_or_null
+
     theta_flat, _ = P.flatten(theta)
     nP = theta_flat.shape[0]
     y0 = jnp.asarray(y0)
@@ -82,9 +89,15 @@ def solve_forward(rhs_theta, y0, t0, t1, theta, cfg, *, rtol=1e-6,
     def rhs(t, y, cfg):
         return rhs_theta(t, y, theta, cfg)
 
-    return bdf.solve(
-        rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
-        n_save=n_save, dt0=dt0, jac=jac, jac_window=jac_window,
-        linsolve=linsolve, observer=observer, observer_init=observer_init,
-        tangent=(fdot, S0), sens_iters=sens_iters,
-        sens_errcon=sens_errcon, step_audit=step_audit)
+    with span_or_null(recorder, "sens_forward", n_params=int(nP)) as sp:
+        res = bdf.solve(
+            rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
+            n_save=n_save, dt0=dt0, jac=jac, jac_window=jac_window,
+            linsolve=linsolve, observer=observer,
+            observer_init=observer_init, tangent=(fdot, S0),
+            sens_iters=sens_iters, sens_errcon=sens_errcon,
+            step_audit=step_audit, stats=stats)
+        if recorder is not None:
+            jax.block_until_ready(res.y)
+            sp["attrs"]["n_accepted"] = int(res.n_accepted)
+    return res

@@ -123,6 +123,7 @@ def solve(
     sens_iters=2,
     sens_errcon=False,
     step_audit=False,
+    stats=False,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` with BDF(1..5).
 
@@ -185,7 +186,19 @@ def solve(
     detail, f32 inverse on TPU vs LU on CPU) and a 64-slot int8 ring of
     recent attempt outcomes keyed by attempt count mod 64
     (``SolveResult.accept_ring``, 1 = accepted) — PERF.md-style step-
-    pattern debugging without re-tracing.
+    pattern debugging without re-tracing.  Both payloads also land under
+    ``SolveResult.stats`` (the telemetry surface, ``obs/``); the
+    top-level fields alias the same arrays.
+
+    ``stats=True`` threads a CVODE-style int32 counter block through the
+    while_loop carry — Newton iterations, Jacobian builds (amortized
+    under ``jac_window``), iteration-matrix factorizations (amortized
+    under ``freeze_precond``), error-test vs convergence-test
+    rejections, and the accepted-step order histogram — surfaced as the
+    ``SolveResult.stats`` dict (key semantics: ``obs/counters.py``;
+    vmap-batched per lane).  Counters are masked adds on values the loop
+    already computes: no host callbacks, no extra transfers, and with
+    ``stats=False`` (default) the traced step program is unchanged.
     """
     y0 = jnp.asarray(y0)
     n = y0.shape[0]
@@ -314,8 +327,10 @@ def solve(
                 jnp.asarray(0, dtype=jnp.int32),
                 jnp.asarray(-1.0, dtype=y0.dtype), jnp.asarray(False),
                 jnp.asarray(False))
-        d, _, _, _, conv, _ = lax.while_loop(cond, body, init)
-        return d, conv
+        # the iteration count is already loop carry; returning it adds
+        # nothing to the traced program when the caller drops it
+        d, _, n_it, _, conv, _ = lax.while_loop(cond, body, init)
+        return d, conv, n_it
 
     def step_once(carry, J_stale, pre=None):
         """One step attempt; ``J_stale=None`` evaluates a fresh Jacobian at
@@ -334,6 +349,9 @@ def solve(
             k += 1
         if step_audit:
             ring, M_last = carry[k], carry[k + 1]
+            k += 2
+        if stats:
+            st = carry[k]
         running = status == RUNNING
         # zero-span guard: a lane already at t1 (parked segmented re-entry,
         # or t0 == t1 callers) succeeds immediately, touching nothing — its
@@ -377,7 +395,7 @@ def solve(
 
             def solve_m(b):
                 return solve0(b) * cj_fac
-        d, conv = newton(solve_m, t_new, y_pred, psi, c, scale)
+        d, conv, n_newton = newton(solve_m, t_new, y_pred, psi, c, scale)
 
         if tangent is not None:
             # staggered sensitivity corrector: solve
@@ -533,10 +551,49 @@ def solve(
                 jnp.where(live, accept.astype(ring.dtype), ring[slot]))
             M_last2 = jnp.where(live, M, M_last)
             out = out + (ring2, M_last2)
+        if stats:
+            # masked adds on values this attempt already computed; the
+            # `live` gate makes counters report algorithmic work per lane,
+            # not the masked SIMD lanes an idling vmap sibling executes
+            live = running & ~already
+            rej = live & ~accept
+            st2 = {
+                "newton_iters": st["newton_iters"]
+                + jnp.where(live, n_newton, 0),
+                # J_stale/pre are trace-time statics: a fresh J (or M)
+                # built at THIS attempt counts here, window-open builds
+                # under jac_window>1/freeze_precond are counted in body()
+                "jac_builds": st["jac_builds"]
+                + (live.astype(jnp.int32) if J_stale is None else 0),
+                "factorizations": st["factorizations"]
+                + (live.astype(jnp.int32) if pre is None else 0),
+                "err_rejects": st["err_rejects"]
+                + (rej & conv).astype(jnp.int32),
+                "conv_rejects": st["conv_rejects"]
+                + (rej & ~conv).astype(jnp.int32),
+                "order_hist": st["order_hist"].at[order].add(
+                    accept.astype(jnp.int32)),
+            }
+            out = out + (st2,)
         return out, newton_failed
 
     def cond(carry):
         return carry[5] == RUNNING
+
+    # carry index of the stats block (after the optional tangent history
+    # and step-audit pair)
+    k_stats = 12 + (1 if tangent is not None else 0) + (2 if step_audit
+                                                        else 0)
+
+    def _count_window_open(carry):
+        """Window-open work: one J build (+ one factorization under
+        freeze_precond) per window, gated on the lane still running."""
+        st = carry[k_stats]
+        live = (carry[5] == RUNNING).astype(jnp.int32)
+        upd = {"jac_builds": st["jac_builds"] + live}
+        if freeze_precond:
+            upd["factorizations"] = st["factorizations"] + live
+        return carry[:k_stats] + ({**st, **upd},) + carry[k_stats + 1:]
 
     if jac_window == 1:
         def body(carry):
@@ -575,6 +632,8 @@ def solve(
                 pre = (solve0, c0)
             else:
                 pre = None
+            if stats:
+                carry = _count_window_open(carry)
 
             def win_cond(s):
                 i, nf, c = s
@@ -599,6 +658,11 @@ def solve(
     if step_audit:
         init = init + (jnp.full((64,), -1, dtype=jnp.int8),
                        jnp.zeros((n, n), dtype=y0.dtype))
+    if stats:
+        init = init + ({"newton_iters": zero, "jac_builds": zero,
+                        "factorizations": zero, "err_rejects": zero,
+                        "conv_rejects": zero,
+                        "order_hist": jnp.zeros((_M,), dtype=jnp.int32)},)
     final = lax.while_loop(cond, body, init)
     (t, D, order, h, n_equal, status, n_acc, n_rej, ts, ys, n_saved,
      obs) = final[:12]
@@ -607,8 +671,21 @@ def solve(
     if tangent is not None:
         tangents = final[k][0]  # DS row 0 is S = dy/dtheta, (P, n)
         k += 1
-    ring_out, M_out = (final[k], final[k + 1]) if step_audit else (None,
-                                                                   None)
+    ring_out = M_out = None
+    if step_audit:
+        ring_out, M_out = final[k], final[k + 1]
+        k += 2
+    stats_out = None
+    if stats:
+        # n_accepted/n_rejected repeated inside stats so an exported
+        # counter block is self-contained (obs/counters.py)
+        stats_out = {"n_accepted": n_acc, "n_rejected": n_rej, **final[k]}
+    if step_audit:
+        # the audit payloads live under stats too (the telemetry surface);
+        # the top-level SolveResult fields alias the same arrays
+        stats_out = dict(stats_out or {})
+        stats_out["accept_ring"] = ring_out
+        stats_out["it_matrix"] = M_out
     return SolveResult(
         t=t, y=D[0], status=status, n_accepted=n_acc, n_rejected=n_rej,
         ts=ts, ys=ys, n_saved=n_saved, h=h,
@@ -616,4 +693,5 @@ def solve(
         err_prev=jnp.asarray(1.0, dtype=y0.dtype),
         solver_state=(D, order, h, n_equal),
         tangents=tangents, it_matrix=M_out, accept_ring=ring_out,
+        stats=stats_out,
     )

@@ -70,9 +70,16 @@ class SolveResult:
     tangents: jnp.ndarray = None  # (P, n) forward sensitivities dy/dtheta
     #                               (bdf.solve tangent= hook; else None)
     it_matrix: jnp.ndarray = None  # (n, n) last Newton iteration matrix
-    #                                M = I - c J (bdf step_audit=True)
+    #                                M = I - c J (bdf step_audit=True);
+    #                                aliases stats["it_matrix"]
     accept_ring: jnp.ndarray = None  # (64,) int8 ring of recent attempt
-    #                                  outcomes, 1=accept (step_audit=True)
+    #                                  outcomes, 1=accept (step_audit=True);
+    #                                  aliases stats["accept_ring"]
+    stats: object = None    # device-side solver-counter dict (stats=True;
+    #                         key semantics: obs/counters.py) — vmap-batched
+    #                         per lane; None on default solves so the
+    #                         pytree structure is unchanged when telemetry
+    #                         is off
 
 
 def _scaled_norm(e, y, rtol, atol):
@@ -101,6 +108,7 @@ def solve(
     observer_init=None,
     err0=None,
     jac_window=1,
+    stats=False,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` from t0 to t1.
 
@@ -150,6 +158,15 @@ def solve(
     first-crossing times (ignition delay), integrals — which matters
     batched: a (B, n_save, S) buffer scatter rewrites O(B * n_save * S)
     per accepted step under vmap, while an observer fold touches O(B).
+
+    ``stats=True`` threads an int32 counter block through the while_loop
+    carry — Newton iterations (summed over the 5 stage solves), Jacobian
+    builds, iteration-matrix factorizations, and rejected attempts split
+    into error-test vs convergence failures — surfaced as the
+    ``SolveResult.stats`` dict (key semantics: ``obs/counters.py``).
+    Counters are masked adds on values the loop already computes: no host
+    callbacks, no extra device transfers, and with ``stats=False``
+    (default) the traced step program is unchanged.
     """
     y0 = jnp.asarray(y0)
     n = y0.shape[0]
@@ -215,23 +232,31 @@ def solve(
                 jnp.array(jnp.inf, dtype=y0.dtype),
                 jnp.array(False), jnp.array(False))
         z, it, dnorm, converged, diverged = lax.while_loop(cond, body, init)
-        return z, converged & jnp.isfinite(dnorm)
+        # ``it`` is already part of the loop carry, so returning it adds
+        # nothing to the traced program when the caller drops it
+        return z, converged & jnp.isfinite(dnorm), it
 
     def attempt_step(t, y, h, J):
-        """One SDIRK4 step attempt: returns (y_new, err, newton_ok)."""
+        """One SDIRK4 step attempt: returns (y_new, err, newton_ok,
+        n_newton) with ``n_newton`` the stage-summed Newton iterations."""
         M = eye - h * _GAMMA * J
         solve_m = make_solve_m(M, linsolve, y0.dtype)
 
         ks = []
         ok = jnp.array(True)
+        # only accumulated under stats: the adds would otherwise enter the
+        # traced program (jaxpr) even with the counters off
+        n_newton = jnp.array(0, dtype=jnp.int32) if stats else None
         z_pred = y
         for i, a_row in enumerate(_A):
             base = y
             for j in range(i):
                 base = base + h * a_row[j] * ks[j]
             t_stage = t + _C[i] * h
-            z, conv = newton_stage(solve_m, base, t_stage, h, z_pred, y)
+            z, conv, n_it = newton_stage(solve_m, base, t_stage, h, z_pred, y)
             ok = ok & conv
+            if stats:
+                n_newton = n_newton + n_it
             k_i = (z - base) / (h * _GAMMA)  # = f(t_stage, z) at convergence
             ks.append(k_i)
             z_pred = z  # next stage predictor
@@ -240,7 +265,7 @@ def solve(
         err_vec = h * sum(be * k for be, k in zip(_B_ERR, ks))
         err = _scaled_norm(err_vec, y, rtol, atol)
         ok = ok & jnp.all(jnp.isfinite(y_new)) & jnp.isfinite(err)
-        return y_new, err, ok
+        return y_new, err, ok, n_newton
 
     if (observer is None) != (observer_init is None):
         raise ValueError("observer and observer_init must be given together")
@@ -248,11 +273,11 @@ def solve(
                                                                 dtype=y0.dtype)
 
     def cond(carry):
-        t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved, obs = carry
-        return status == RUNNING
+        return carry[4] == RUNNING
 
     def step_once(carry, J):
-        t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved, obs = carry
+        (t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved,
+         obs) = carry[:11]
         # running gates every write below, so a terminated lane's carry is
         # untouched WITHOUT a whole-carry select — masking the (n_save, n)
         # trajectory buffers per attempt would reintroduce the O(n_save*n)
@@ -261,7 +286,7 @@ def solve(
         # it only bites inside a jac_window inner loop.
         running = status == RUNNING
         h_eff = jnp.minimum(h, t1 - t)
-        y_new, err, ok = attempt_step(t, y, h_eff, J)
+        y_new, err, ok, n_newton = attempt_step(t, y, h_eff, J)
         accept = ok & (err <= 1.0) & running
 
         # PI step-size controller (embedded order 3 -> exponent base 1/4)
@@ -311,18 +336,49 @@ def solve(
             ),
         ).astype(jnp.int32)
         status2 = jnp.where(running, status2, status)
-        return (t_new, y_out, h_next, err_prev_new, status2, n_acc2, n_rej2,
-                ts2, ys2, n_saved2, obs)
+        out = (t_new, y_out, h_next, err_prev_new, status2, n_acc2, n_rej2,
+               ts2, ys2, n_saved2, obs)
+        if stats:
+            # masked adds on values the attempt already computed; the
+            # `running` gate means counters report algorithmic work, not
+            # the masked SIMD lanes an idling vmap sibling still executes
+            st = carry[11]
+            rej = running & ~accept
+            out = out + ({
+                "newton_iters": st["newton_iters"]
+                + jnp.where(running, n_newton, 0),
+                "jac_builds": st["jac_builds"],   # counted at window open
+                "factorizations": st["factorizations"]
+                + running.astype(jnp.int32),
+                "err_rejects": st["err_rejects"]
+                + (rej & ok).astype(jnp.int32),
+                "conv_rejects": st["conv_rejects"]
+                + (rej & ~ok).astype(jnp.int32),
+            },)
+        return out
+
+    def _count_jac(carry):
+        # one J per body call (either window size); gate like step_once
+        st = carry[11]
+        live = carry[4] == RUNNING
+        st = {**st, "jac_builds": st["jac_builds"]
+              + live.astype(jnp.int32)}
+        return carry[:11] + (st,)
 
     if jac_window == 1:
         def body(carry):
-            return step_once(carry, jac(carry[0], carry[1]))
+            J = jac(carry[0], carry[1])
+            if stats:
+                carry = _count_jac(carry)
+            return step_once(carry, J)
     else:
         def body(carry):
             # one Jacobian serves the whole window; a lane that terminates
             # mid-window idles for the remainder (step_once's `running`
             # gate holds its carry — no whole-carry select)
             J = jac(carry[0], carry[1])
+            if stats:
+                carry = _count_jac(carry)
             return lax.fori_loop(0, jac_window,
                                  lambda _, c: step_once(c, J), carry)
 
@@ -338,11 +394,21 @@ def solve(
     init = (t0, y0, dt0, err_init,
             jnp.array(RUNNING, dtype=jnp.int32), zero, zero,
             ts_buf, ys_buf, zero, obs0)
+    if stats:
+        init = init + ({"newton_iters": zero, "jac_builds": zero,
+                        "factorizations": zero, "err_rejects": zero,
+                        "conv_rejects": zero},)
+    final = lax.while_loop(cond, body, init)
     (t, y, h, err_prev, status, n_acc, n_rej, ts, ys, n_saved,
-     obs) = lax.while_loop(cond, body, init)
+     obs) = final[:11]
+    stats_out = None
+    if stats:
+        # n_accepted/n_rejected repeated inside stats so an exported
+        # counter block is self-contained (obs/counters.py)
+        stats_out = {"n_accepted": n_acc, "n_rejected": n_rej, **final[11]}
     return SolveResult(
         t=t, y=y, status=status, n_accepted=n_acc, n_rejected=n_rej,
         ts=ts, ys=ys, n_saved=n_saved, h=h,
         observed=obs if observer is not None else None,
-        err_prev=err_prev,
+        err_prev=err_prev, stats=stats_out,
     )

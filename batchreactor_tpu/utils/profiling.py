@@ -1,22 +1,36 @@
 """Per-phase timers and device tracing (SURVEY.md §5: the reference's only
 observability is a per-step ``@printf`` of the time,
-/root/reference/src/BatchReactor.jl:401; the TPU-native plan is phase timers
-— parse / compile / transfer / solve — plus ``jax.profiler`` traces).
+/root/reference/src/BatchReactor.jl:401).
 
-``Phases`` collects named wall-clock spans; ``phase(...)`` is the context
-manager; ``device_trace(...)`` wraps ``jax.profiler.trace`` so a sweep can
-drop a TensorBoard-loadable trace directory without importing jax at every
-call site.  Timings are host wall-clock: callers that time device work
-should block (``jax.block_until_ready``) inside the span — ``phase`` does
-it for you when given a value to block on.
+.. deprecated::
+    ``Phases`` is now a thin backward-compatibility shim over
+    :class:`batchreactor_tpu.obs.recorder.Recorder` — the structured
+    telemetry subsystem (``obs/``, docs/observability.md) that supersedes
+    it with nested spans, attributes, machine-readable exports, and
+    compile/retrace detection.  New code should create a ``Recorder``
+    (or pass ``telemetry=True`` through the API) instead; ``Phases``
+    remains for the scripts and callers that only want the flat
+    name -> seconds view.
+
+``device_trace(...)`` is unchanged: it wraps ``jax.profiler.trace`` so a
+sweep can drop a TensorBoard-loadable trace directory without importing
+jax at every call site.  Timings are host wall-clock: callers that time
+device work should block (``jax.block_until_ready``) inside the span —
+both ``Phases`` and ``Recorder.span`` do it for you when given a value
+to block on (``block=...``).
 """
 
 import contextlib
-import time
 
 
 class Phases:
     """Accumulates named wall-clock spans; repeated names accumulate.
+
+    Deprecated shim over ``obs.recorder.Recorder`` (module docstring):
+    the recorder does the timing, this class only re-shapes its view to
+    the historical ``{name: seconds}`` dicts.  The underlying recorder is
+    reachable as ``.recorder`` so a caller can migrate incrementally
+    (e.g. export its spans with ``obs.export``).
 
     >>> ph = Phases()
     >>> with ph("parse"): mech = compile_gaschemistry(path)
@@ -24,34 +38,31 @@ class Phases:
     >>> ph.summary()   # {'parse': 0.12, 'solve': 3.4}
     """
 
-    def __init__(self):
-        self.spans = {}
-        self.counts = {}
+    def __init__(self, recorder=None):
+        from ..obs.recorder import Recorder
+
+        self.recorder = recorder if recorder is not None else Recorder()
 
     @contextlib.contextmanager
     def __call__(self, name, block=None):
-        t0 = time.perf_counter()
-        try:
+        with self.recorder.span(name, block=block):
             yield self
-        finally:
-            if block is not None:
-                import jax
 
-                jax.block_until_ready(block)
-            dt = time.perf_counter() - t0
-            self.spans[name] = self.spans.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+    @property
+    def spans(self):
+        return {k: v["total_s"] for k, v in self.recorder.by_name().items()}
+
+    @property
+    def counts(self):
+        return {k: v["count"] for k, v in self.recorder.by_name().items()}
 
     def summary(self):
         return dict(self.spans)
 
     def pretty(self):
-        total = sum(self.spans.values()) or 1.0
-        lines = [
-            f"{name:>12s}: {dt:8.3f}s  ({100.0 * dt / total:5.1f}%)"
-            for name, dt in sorted(self.spans.items(), key=lambda kv: -kv[1])
-        ]
-        return "\n".join(lines)
+        # the per-name call counts were always tracked; they now display
+        # (the recorder's own pretty() carries the same ``xN`` suffix)
+        return self.recorder.pretty()
 
 
 @contextlib.contextmanager

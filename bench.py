@@ -178,9 +178,19 @@ def rung_main():
     from batchreactor_tpu.solver.sdirk import SUCCESS
     from batchreactor_tpu.utils.composition import density, mole_to_mass
 
-    from batchreactor_tpu.utils.profiling import Phases, device_trace
+    from batchreactor_tpu.obs import (CompileWatch, Recorder, build_report,
+                                      write_jsonl)
+    from batchreactor_tpu.utils.profiling import device_trace
 
-    ph = Phases()
+    # the obs Recorder replaces the Phases timer (utils.profiling shim);
+    # BENCH_OBS=1 additionally turns on the device counter block and
+    # writes the full telemetry report to bench_obs.jsonl — diff rungs
+    # with scripts/obs_report.py.  Default stays counters-OFF so the
+    # headline metric's traced program is byte-identical to prior rounds.
+    obs_on = os.environ.get("BENCH_OBS") == "1"
+    rec = Recorder()
+    ph = rec.span  # same with-block call sites below
+    watch = CompileWatch(recorder=rec, default_label="bench-sweep")
     B = int(os.environ.get("BENCH_B", "64"))
     method = os.environ.get("BENCH_METHOD", "bdf")
     # jac_window=8 (BDF only): one analytic Jacobian serves 8 step attempts
@@ -220,6 +230,8 @@ def rung_main():
             linsolve=os.environ.get("BENCH_LINSOLVE", "auto"),
             method=method, **solver_kw,
             observer=obs, observer_init=obs0,
+            stats=obs_on, recorder=rec if obs_on else None,
+            watch=watch if obs_on else None,
             progress=lambda p: log(f"  segment {p['segment']}: "
                                    f"{p['lanes_done']}/{p['n_lanes']} lanes"))
 
@@ -237,11 +249,19 @@ def rung_main():
     trace_ctx = (device_trace(trace_dir) if trace_dir
                  else contextlib.nullcontext())
     t0 = time.perf_counter()
-    with trace_ctx, ph("solve"):
+    with trace_ctx, (watch if obs_on else contextlib.nullcontext()), \
+            ph("solve"):
         res = sweep()
         jax.block_until_ready(res.y)
     wall = time.perf_counter() - t0
-    log(f"[rung B={B}] phases:\n{ph.pretty()}")
+    log(f"[rung B={B}] phases:\n{rec.pretty()}")
+    if obs_on:
+        report = build_report(
+            recorder=rec, solver_stats=res.stats, watch=watch,
+            meta={"entry": "bench", "B": B, "method": method,
+                  "platform": jax.default_backend()})
+        write_jsonl(os.path.join(REPO, "bench_obs.jsonl"), report)
+        log(f"[rung B={B}] obs report -> bench_obs.jsonl")
     tau = np.asarray(res.observed["tau"])
     print(json.dumps({
         "B": B, "method": method, "wall_s": round(wall, 3),

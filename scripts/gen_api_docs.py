@@ -42,6 +42,10 @@ SECTIONS = [
     ("Multi-host (DCN) tier", "batchreactor_tpu.parallel.multihost",
      ["initialize", "global_mesh", "scatter_batch", "gather_batch",
       "ensemble_solve_multihost"]),
+    ("Observability", "batchreactor_tpu.obs",
+     ["Recorder", "CompileWatch", "build_report", "render", "diff",
+      "stats_totals", "to_jsonl", "from_jsonl", "to_prometheus",
+      "write_jsonl", "read_jsonl"]),
     ("Solvers", "batchreactor_tpu.solver.bdf", ["solve"]),
     ("Solvers (SDIRK)", "batchreactor_tpu.solver.sdirk", ["solve"]),
     ("Kinetics kernels", "batchreactor_tpu.ops.rhs",

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Render, export, and diff batchreactor_tpu telemetry reports.
+
+The one CLI future perf PRs cite instead of hand-run probe scripts
+(PERF.md): every number it prints comes off the structured ``obs`` report
+(docs/observability.md) — host spans, device-side solver counters, and
+compile/retrace counts.
+
+  # run a file-driven case with telemetry and render the report
+  python scripts/obs_report.py --run tests/fixtures/batch_h2o2.xml \\
+      --lib tests/fixtures --gaschem --out /tmp/h2o2.jsonl
+
+  # render a stored report
+  python scripts/obs_report.py /tmp/h2o2.jsonl
+
+  # machine-readable re-exports
+  python scripts/obs_report.py /tmp/h2o2.jsonl --json     # JSONL to stdout
+  python scripts/obs_report.py /tmp/h2o2.jsonl --prom     # Prometheus text
+
+  # before/after comparison (the perf-PR workflow)
+  python scripts/obs_report.py --diff baseline.jsonl candidate.jsonl
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render / export / diff obs telemetry reports")
+    ap.add_argument("report", nargs="?", help="stored report (.jsonl)")
+    ap.add_argument("--run", metavar="BATCH_XML",
+                    help="run a file-driven case with telemetry=True and "
+                         "report on it")
+    ap.add_argument("--lib", default=os.path.join(REPO, "tests", "fixtures"),
+                    help="mechanism library dir for --run (default: the "
+                         "vendored test fixtures)")
+    ap.add_argument("--gaschem", action="store_true",
+                    help="--run with gas chemistry")
+    ap.add_argument("--surfchem", action="store_true",
+                    help="--run with surface chemistry")
+    ap.add_argument("--out", help="also write the report as JSONL here "
+                                  "(the CI artifact)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the JSONL export instead of the rendering")
+    ap.add_argument("--prom", action="store_true",
+                    help="print the Prometheus text exposition instead")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="diff two stored reports (baseline -> candidate)")
+    args = ap.parse_args(argv)
+
+    from batchreactor_tpu import obs
+
+    if args.diff:
+        a, b = (obs.read_jsonl(p) for p in args.diff)
+        print(obs.diff(a, b))
+        return 0
+
+    if args.run:
+        import shutil
+        import tempfile
+
+        import batchreactor_tpu as br
+
+        if not (args.gaschem or args.surfchem):
+            args.gaschem = True  # the common fixture case
+        # profile files land next to the input XML; run from a scratch
+        # copy so --run never writes into the repo or a read-only tree
+        with tempfile.TemporaryDirectory() as tmp:
+            xml = os.path.join(tmp, os.path.basename(args.run))
+            shutil.copy(args.run, xml)
+            ret, report = br.batch_reactor(
+                xml, args.lib, gaschem=args.gaschem,
+                surfchem=args.surfchem, verbose=False, telemetry=True)
+        print(f"status: {ret}", file=sys.stderr)
+    elif args.report:
+        report = obs.read_jsonl(args.report)
+    else:
+        ap.error("give a stored report, --run, or --diff")
+
+    if args.out:
+        obs.write_jsonl(args.out, report)
+        print(f"wrote {args.out}", file=sys.stderr)
+    if args.json:
+        sys.stdout.write(obs.to_jsonl(report))
+    elif args.prom:
+        sys.stdout.write(obs.to_prometheus(report))
+    else:
+        print(obs.render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
