@@ -225,4 +225,31 @@ def run_audit(fixtures_dir=None):
     jaxpr = jax.make_jaxpr(run_sens_adjoint)(y0)
     findings.extend(_audit_jaxpr("sens-adjoint-grad", jaxpr,
                                  check_dtype=False))
+
+    # the pipelined segmented driver's traced segment program (parallel/
+    # sweep.py): the device-resident park/budget/accumulate control block
+    # and the on-device trajectory gather must meet the same purity
+    # contract as the solver step programs — no callbacks, no in-loop
+    # staging.  Plain AND stats-instrumented, with the saved-row gather
+    # active (seg_save > 0 exercises the compaction scatter).
+    import jax.numpy as jnp
+
+    from ..parallel import sweep as _sweep
+
+    y0b = jnp.stack([y0, y0])
+    cfgb = {k: jnp.broadcast_to(v, (2,)) for k, v in cfg.items()}
+    for sname, sstats in (("segment-pipelined-step", False),
+                          ("segment-pipelined-step-stats", True)):
+        seg_fn = _sweep._segment_fn(
+            rhs, 1e-6, 1e-10, 4, 1e-22, "auto", jac, None, 2, False, 1,
+            0.03, "bdf", sstats, True, 8, True)
+        carry0 = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
+                                            sstats, 8)
+
+        def run_seg(c, seg_fn=seg_fn):
+            return seg_fn(0.0, jnp.asarray(1e-7, dtype=jnp.float64), cfgb,
+                          jnp.asarray(64, dtype=jnp.int64), c)
+
+        jaxpr = jax.make_jaxpr(run_seg)(carry0)
+        findings.extend(_audit_jaxpr(sname, jaxpr, check_dtype=False))
     return findings

@@ -773,7 +773,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         max_steps=200_000, segment_steps=0, kc_compat=False,
                         asv_quirk=True, ignition_marker=None,
                         ignition_mode="half", method="bdf", jac_window=None,
-                        analytic_jac=True, telemetry=False):
+                        analytic_jac=True, telemetry=False, pipeline=None,
+                        poll_every=None):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -819,6 +820,15 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     report carries both totals and the per-lane arrays), and
     compile/retrace counts; segmented runs flag any post-first-segment
     compile as a retrace event.  Render with ``scripts/obs_report.py``.
+
+    ``pipeline``/``poll_every`` (segmented runs only — an explicit value
+    with ``segment_steps=0`` raises, same loudness convention as the
+    other path-specific knobs) select the segmented execution gear and
+    its termination-poll stride: the default pipelined driver keeps
+    park/budget bookkeeping on device, donates the relaunch carry, and
+    polls the status vector every ``poll_every`` segments — bit-exact
+    vs ``pipeline=False`` (the per-segment blocking host loop; see
+    docs/performance.md "Pipelined execution").
     """
     from .parallel import (ensemble_solve, ensemble_solve_segmented,
                            sweep_report)
@@ -827,6 +837,16 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
 
     if chem is None or thermo_obj is None:
         raise TypeError("batch_reactor_sweep needs chem= and thermo_obj=")
+    if segment_steps <= 0 and (pipeline is not None
+                               or poll_every is not None):
+        # loudness convention (cf. jac_window with backend='cpu'): these
+        # knobs shape the segmented driver only — silently ignoring them
+        # on the monolithic path would report a configuration that never
+        # ran.  Checked up front with the other argument validation, so
+        # the error fires before any mechanism parsing happens.
+        raise ValueError(
+            "pipeline/poll_every are segmented-path knobs; set "
+            "segment_steps > 0 or drop the arguments")
     if chem.userchem and (chem.gaschem or chem.surfchem):
         # the reference's du assembly is an exclusive 4-way branch
         # (/root/reference/src/BatchReactor.jl:362-373): user mode never
@@ -975,6 +995,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
             res = ensemble_solve_segmented(rhs, y0s, 0.0, float(time), cfgs,
                                            segment_steps=segment_steps,
                                            recorder=rec,
+                                           pipeline=pipeline,
+                                           poll_every=poll_every,
                                            watch=watch if telemetry
                                            else None, **common)
         else:

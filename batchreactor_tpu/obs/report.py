@@ -164,9 +164,10 @@ def render(report):
 
 def diff(a, b):
     """Compare two reports (baseline ``a`` -> candidate ``b``): per-name
-    span totals, solver-stat totals, and compile counts, with absolute
-    and relative deltas — the tool future perf PRs cite for before/after
-    numbers."""
+    span totals, recorder counters (e.g. the segmented drivers'
+    ``blocking_syncs``), solver-stat totals, and compile counts, with
+    absolute and relative deltas — the tool perf PRs cite for
+    before/after numbers."""
 
     def span_totals(rep):
         agg = {}
@@ -188,6 +189,19 @@ def diff(a, b):
             lines.append(f"  span {name}: {va:.3f}s -> {vb:.3f}s "
                          f"({pct:+.1f}%)")
 
+    def _fmt_ctr(v):
+        # float counters are accumulated wall-clock (e.g. poll_wait_s):
+        # format like span durations, not full-precision repr noise
+        if isinstance(v, float):
+            return f"{v:.3f}"
+        return str(v)
+
+    ka, kb = a.get("counters") or {}, b.get("counters") or {}
+    for k in sorted(set(ka) | set(kb)):
+        va, vb = ka.get(k), kb.get(k)
+        if va != vb:
+            lines.append(f"  counter {k}: {_fmt_ctr(va)} -> {_fmt_ctr(vb)}")
+
     ta = (a.get("solver_stats") or {}).get("totals") or {}
     tb = (b.get("solver_stats") or {}).get("totals") or {}
     for k in sorted(set(ta) | set(tb)):
@@ -199,5 +213,6 @@ def diff(a, b):
         if ca.get(k) != cb.get(k):
             lines.append(f"  compile {k}: {ca.get(k)} -> {cb.get(k)}")
     if len(lines) == 1:
-        lines.append("  (no differences in spans / solver stats / compiles)")
+        lines.append("  (no differences in spans / counters / solver "
+                     "stats / compiles)")
     return "\n".join(lines)

@@ -16,6 +16,7 @@ import concurrent.futures as _futures
 import hashlib
 import json
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -159,6 +160,13 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
             # too would make an explicit method="bdf" fingerprint differ
             # from the identical default-resolved configuration
             continue
+        if k in ("pipeline", "poll_every"):
+            # segmented execution-GEAR knobs, contractually bit-exact
+            # (parallel/sweep.py): they change how segments are driven,
+            # never the results, so a resume under a different gear — or a
+            # pre-gear checkpoint dir resumed after the knobs existed —
+            # must serve the same chunks, not raise a manifest mismatch
+            continue
         v = solve_kw[k]
         h.update(k.encode())
         if callable(v):
@@ -213,7 +221,12 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     ``segment_steps > 0`` in ``solve_kw`` runs each chunk through
     ``ensemble_solve_segmented`` (bounded device launches — the safe mode
     on tunneled TPU runtimes); ``max_steps`` then maps onto the segmented
-    path's exact per-lane attempt budget.
+    path's exact per-lane attempt budget.  The segmented driver's
+    ``pipeline``/``poll_every`` knobs pass straight through, so a
+    checkpointed chunk runs the pipelined gear by default — its
+    background drain thread coexists with this module's async save
+    worker (each chunk's drain completes before the chunk's save is
+    queued, because the drain joins inside ``ensemble_solve_segmented``).
 
     ``recorder`` (an ``obs.Recorder``) collects the per-chunk telemetry —
     ``chunk_solve`` spans (with lane counts and attempt stats as
@@ -229,7 +242,29 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     deliberately NOT part of the sweep fingerprint (it describes the
     observer, not the sweep).
     """
+    if int(solve_kw.get("segment_steps", 0) or 0) <= 0:
+        # up-front, like api.py: the gear knobs configure the segmented
+        # driver only, and the check must fire even when every chunk
+        # resumes from disk (None = library default passes through)
+        explicit = [k for k in ("pipeline", "poll_every")
+                    if solve_kw.get(k) is not None]
+        if explicit:
+            raise ValueError(
+                f"{'/'.join(explicit)} are segmented-path knobs; set "
+                f"segment_steps > 0 or drop the arguments")
     rec = recorder if recorder is not None else Recorder()
+    if chunk_log is not None:
+        # the writer thread emits its completion line concurrently with
+        # the main thread's per-chunk lines (and, under the pipelined
+        # segmented driver, with its drain-thread telemetry) — serialize
+        # in the library so every chunk_log callable is safe by default
+        # instead of each caller having to remember a lock
+        _log_lock = threading.Lock()
+        _raw_log = chunk_log
+
+        def chunk_log(msg):
+            with _log_lock:
+                _raw_log(msg)
     y0s = jnp.asarray(y0s)
     perm = inv_perm = None
     if lane_cost is not None:
@@ -305,7 +340,11 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
                 max_segments=max(1, -(-ms // seg_steps)), max_attempts=ms,
                 recorder=recorder, **kw)
         else:
-            kw = {k: v for k, v in solve_kw.items() if k != "segment_steps"}
+            # None-valued gear knobs (library-default pass-through, e.g.
+            # the northstar script) don't exist on the monolithic path —
+            # drop them; explicit values were rejected up front
+            kw = {k: v for k, v in solve_kw.items()
+                  if k not in ("segment_steps", "pipeline", "poll_every")}
             res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **kw)
         if pad:
             res = jax.tree.map(
@@ -320,9 +359,8 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     # bad observer pytree) surfaces within one chunk instead of after the
     # whole sweep, and a preemption can lose at most the single queued
     # save, preserving the module's resume guarantee.  The completion line
-    # is emitted from the worker thread, so ``chunk_log`` may be called
-    # concurrently with the main thread's per-chunk lines (fine for the
-    # stderr printers the scripts use; wrap with a lock if yours isn't).
+    # is emitted from the worker thread; ``chunk_log`` calls are
+    # serialized by the library lock above, so any callable is safe.
     executor = _futures.ThreadPoolExecutor(max_workers=1)
 
     # the future whose own exception became the primary (propagating) one —

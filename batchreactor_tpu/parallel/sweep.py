@@ -14,10 +14,28 @@ vmapped, so XLA runs lanes until the slowest finishes — fast-igniting lanes
 mask out).  Per-lane ``status`` arrays are the failure-detection surface
 (SURVEY.md §5): a diverged lane reports DT_UNDERFLOW/MAX_STEPS without
 poisoning its neighbours.
+
+The segmented driver ships in two interchangeable gears (bit-exact against
+each other, regression-tested):
+
+* **pipelined** (default) — the park/budget/accumulate bookkeeping lives
+  ON DEVICE in a small control block threaded through the traced segment
+  program's carry, so segment i+1 never data-depends on host work; the
+  host run-ahead dispatches segments back-to-back, polls the tiny status
+  vector every ``poll_every`` launches, drains trajectory rows on a
+  background thread via non-blocking transfers, and the relaunch donates
+  the carry buffers (no per-segment HBM copy of the BDF history).
+* **blocking** (``pipeline=False`` / ``BENCH_PIPELINE=0``) — the original
+  host loop: one blocking ``device_get`` barrier per segment with all
+  bookkeeping on host.  Kept as the reference semantics and the revert
+  lever (PERF.md).
 """
 
 import contextlib
 import functools
+import os
+import queue
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +48,34 @@ from ..obs.retrace import CompileWatch
 from ..solver import bdf, sdirk
 
 _SOLVERS = {"sdirk": sdirk.solve, "bdf": bdf.solve}
+
+
+def resolve_pipeline_defaults(pipeline=None, poll_every=None):
+    """THE resolution rule for the segmented execution-gear knobs
+    (``pipeline``, ``poll_every``): explicit values pass through, ``None``
+    resolves from the ``BENCH_PIPELINE`` / ``BENCH_POLL_EVERY`` env levers
+    (pipelined on, stride 4).  Exported so bench.py and the northstar
+    script record the gear a run ACTUALLY used instead of re-deriving the
+    default and silently drifting if it ever changes."""
+    if pipeline is None:
+        pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
+    if poll_every is None:
+        poll_every = int(os.environ.get("BENCH_POLL_EVERY", "4"))
+    return bool(pipeline), int(poll_every)
+
+
+def _host_fetch(x, recorder=None):
+    """THE main-thread blocking device->host transfer of the segmented
+    drivers.  Every synchronous fetch the host loop performs goes through
+    here so (a) the ``blocking_syncs`` counter lands in telemetry reports
+    (``scripts/obs_report.py --diff`` cites it as the pipelining evidence)
+    and (b) the tier-1 host-sync regression gate can monkeypatch one name
+    to count barriers.  The drainer thread's overlapped transfers do NOT
+    use this — they are the non-blocking path this counter exists to
+    contrast with."""
+    if recorder is not None:
+        recorder.counter("blocking_syncs")
+    return jax.device_get(x)
 
 
 def make_mesh(devices=None, axis="batch"):
@@ -237,7 +283,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              observer_init=None, dt_min_factor=1e-22,
                              n_save=0, rhs_bundle=None, jac_window=1,
                              newton_tol=0.03, method="bdf", stats=False,
-                             recorder=None, watch=None):
+                             recorder=None, watch=None, pipeline=None,
+                             poll_every=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -292,53 +339,77 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     recorder events only.  Host-side eager ops between segments
     attribute to the unarmed ``sweep-host`` label of the private watch
     (or the enclosing watch's own default), never to the armed one.
+
+    ``pipeline`` selects the execution gear (module docstring): ``True``
+    — the default; ``BENCH_PIPELINE=0`` flips the default off per the
+    lever convention — runs the software-pipelined driver: parking,
+    ``final_status``/``final_t`` latching, the exact ``max_attempts``
+    budget, and the accepted/rejected (+ ``stats``) accumulators live ON
+    DEVICE in a control block threaded through the traced segment
+    program's carry, the relaunch donates the carry buffers (no
+    per-segment HBM copy of the (B, MAXORD+3, S) BDF history), segments
+    are dispatched run-ahead with termination polled from a tiny status
+    vector every ``poll_every`` launches (default 4,
+    ``BENCH_POLL_EVERY`` overrides), and trajectory rows drain to host
+    on a background thread via non-blocking transfers, gathered
+    on-device first so only rows that exist move.  ``False`` runs the
+    original blocking per-segment host loop.  The two gears are
+    BIT-EXACT against each other: ``poll_every > 1`` delays — never
+    changes — termination detection, by at most ``poll_every - 1``
+    all-parked trailing segments that are no-ops for every carried
+    value (regression-tested across methods, budgets, and trajectory
+    modes; docs/performance.md "Pipelined execution").
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
+    pipeline, poll_every = resolve_pipeline_defaults(pipeline, poll_every)
+    if poll_every < 1:
+        raise ValueError(f"poll_every must be >= 1, got {poll_every}")
     y0s = jnp.asarray(y0s)
     B = y0s.shape[0]
     # a segment can accept at most segment_steps rows, so this buffer never
     # drops a row the host still has capacity for
     seg_save = min(int(n_save), int(segment_steps)) if n_save else 0
     _check_method(method, newton_tol)
+    bundle_arg = rhs_bundle if rhs_bundle is not None else 0.0
+    t1 = jnp.asarray(t1, dtype=y0s.dtype)
+    carry = _init_segment_carry(y0s, t0, method, observer, observer_init,
+                                stats, n_save)
+    if mesh is not None:
+        spec = NamedSharding(mesh, P(axis))
+        carry = jax.tree.map(lambda x: jax.device_put(x, spec), carry)
+        cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
+    y, t, h, e, obs, sstate, _ctrl = carry
+    # segments re-launch ONE cached program; any compile after segment 0
+    # is unexpected and surfaces as a retrace (see the watch comment below)
+    own_watch = None
+    if watch is None and recorder is not None:
+        own_watch = CompileWatch(recorder=recorder,
+                                 default_label="sweep-host")
+        watch = own_watch
+
+    if pipeline:
+        with (own_watch if own_watch is not None
+              else contextlib.nullcontext()):
+            return _run_segmented_pipelined(
+                rhs, y0s, t1, cfgs, carry, bundle_arg,
+                segment_steps=segment_steps, max_segments=max_segments,
+                max_attempts=max_attempts, poll_every=poll_every,
+                compact=mesh is None, rtol=rtol, atol=atol,
+                linsolve=linsolve,
+                jac=None if rhs_bundle is not None else jac,
+                observer=observer, dt_min_factor=dt_min_factor,
+                n_save=n_save, seg_save=seg_save,
+                bundle_mode=rhs_bundle is not None, jac_window=jac_window,
+                newton_tol=newton_tol, method=method, stats=stats,
+                recorder=recorder, watch=watch, progress=progress)
+
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
                                       dt_min_factor, linsolve,
                                       None if rhs_bundle is not None else jac,
                                       observer, seg_save,
                                       rhs_bundle is not None, jac_window,
                                       newton_tol, method, stats)
-    bundle_arg = rhs_bundle if rhs_bundle is not None else 0.0
-    t1 = jnp.asarray(t1, dtype=y0s.dtype)
-    t = jnp.full((B,), t0, dtype=y0s.dtype)
-    h = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: heuristic first step
-    e = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: fresh PI controller
-    y = y0s
-    if observer is not None:
-        obs = jax.tree.map(
-            lambda x: jnp.broadcast_to(jnp.asarray(x),
-                                       (B,) + jnp.shape(jnp.asarray(x))),
-            observer_init)
-    else:
-        obs = jnp.zeros((B,))
-    if method == "bdf":
-        # all-zero difference history = per-lane cold start (bdf.solve)
-        sstate = (jnp.zeros((B, bdf.MAXORD + 3) + y0s.shape[1:],
-                            dtype=y0s.dtype),
-                  jnp.ones((B,), dtype=jnp.int32),
-                  jnp.full((B,), -1.0, dtype=y0s.dtype),
-                  jnp.zeros((B,), dtype=jnp.int32))
-    else:
-        sstate = jnp.zeros((B,), dtype=y0s.dtype)  # unused dummy
-    if mesh is not None:
-        spec = NamedSharding(mesh, P(axis))
-        y = jax.device_put(y, spec)
-        t = jax.device_put(t, spec)
-        h = jax.device_put(h, spec)
-        e = jax.device_put(e, spec)
-        cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
-        obs = jax.tree.map(lambda x: jax.device_put(x, spec), obs)
-        sstate = jax.tree.map(lambda x: jax.device_put(x, spec), sstate)
-
     final_status = np.full((B,), int(sdirk.RUNNING), dtype=np.int32)
     final_t = np.full((B,), np.nan)
     n_acc = np.zeros((B,), dtype=np.int64)
@@ -348,17 +419,11 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         all_ts = np.full((B, int(n_save)), np.inf)
         all_ys = np.zeros((B, int(n_save)) + y0s.shape[1:])
         saved = np.zeros((B,), dtype=np.int64)
-    # segments re-launch ONE cached program; any compile after segment 0
-    # is unexpected and surfaces as a retrace.  Use the caller's watch
-    # when given (its report then carries the armed label); otherwise
-    # enter a private one.  Its default label ("sweep-host") is distinct
-    # from the armed region label, so the host loop's own eager-op
-    # compiles between segments can never masquerade as retraces.
-    own_watch = None
-    if watch is None and recorder is not None:
-        own_watch = CompileWatch(recorder=recorder,
-                                 default_label="sweep-host")
-        watch = own_watch
+    # Use the caller's watch when given (its report then carries the armed
+    # label); otherwise the private one entered here.  Its default label
+    # ("sweep-host") is distinct from the armed region label, so the host
+    # loop's own eager-op compiles between segments can never masquerade
+    # as retraces.
     with (own_watch if own_watch is not None else contextlib.nullcontext()):
         for seg in range(max_segments):
             region = (watch.region("sweep-segment", single_program=True)
@@ -371,9 +436,9 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 # per-segment chatter (not the solve) was a prime suspect
                 # for the northstar map-vs-rung gap (PERF.md round-4
                 # addendum)
-                status, seg_acc, seg_rej, seg_t, seg_saved = jax.device_get(
+                status, seg_acc, seg_rej, seg_t, seg_saved = _host_fetch(
                     (res.status, res.n_accepted, res.n_rejected, res.t,
-                     res.n_saved))
+                     res.n_saved), recorder)
             # only lanes still live this segment contribute step counts:
             # parked lanes re-enter as zero-span solves that burn one
             # rejected attempt
@@ -382,7 +447,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
             n_rej += np.where(running, seg_rej, 0)
             if stats:
                 stats_acc = obs_counters.accumulate(
-                    stats_acc, jax.device_get(res.stats), running)
+                    stats_acc, _host_fetch(res.stats, recorder), running)
             if n_save:
                 # drain this segment's device buffer into the host trajectory —
                 # vectorized masked scatter, no per-lane Python loop, and the
@@ -393,7 +458,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                                 0)
                 drained_ts = None
                 if take.max() > 0:
-                    seg_ts, seg_ys = jax.device_get((res.ts, res.ys))
+                    seg_ts, seg_ys = _host_fetch((res.ts, res.ys), recorder)
                     col = np.arange(seg_ts.shape[1])
                     src = col[None, :] < take[:, None]           # (B, seg_save)
                     b_idx, c_idx = np.nonzero(src)
@@ -467,15 +532,12 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                else {k: jnp.asarray(v) for k, v in stats_acc.items()}))
 
 
-@functools.lru_cache(maxsize=64)
-def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
-                             linsolve, jac, observer, n_save=0,
-                             bundle_mode=False, jac_window=1,
-                             newton_tol=0.03, method="bdf", stats=False):
-    """Compiled per-segment batched solve: per-lane t0 and carried-in step
-    size are traced operands (vmap axis 0), so every segment reuses one
-    executable.  In ``bundle_mode`` the first operand is a mechanism-bundle
-    pytree (broadcast, not vmapped) and ``rhs`` is a builder."""
+def _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
+                      linsolve, jac, observer, n_save, bundle_mode,
+                      jac_window, newton_tol, method, stats):
+    """Per-lane segment solve shared by the blocking and pipelined traced
+    programs — keeping it single-sourced is what makes the two drivers'
+    step sequences identical by construction."""
 
     def one(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate):
         if bundle_mode:
@@ -492,7 +554,447 @@ def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
             observer=observer, stats=stats,
             observer_init=obs0 if observer is not None else None, **kw)
 
+    return one
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
+                             linsolve, jac, observer, n_save=0,
+                             bundle_mode=False, jac_window=1,
+                             newton_tol=0.03, method="bdf", stats=False):
+    """Compiled per-segment batched solve (the BLOCKING driver's program):
+    per-lane t0 and carried-in step size are traced operands (vmap axis 0),
+    so every segment reuses one executable.  In ``bundle_mode`` the first
+    operand is a mechanism-bundle pytree (broadcast, not vmapped) and
+    ``rhs`` is a builder."""
+    one = _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
+                            linsolve, jac, observer, n_save, bundle_mode,
+                            jac_window, newton_tol, method, stats)
     return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0, 0)))
+
+
+def _stats_keys():
+    """The uniform (B,) int32 counter keys of the solvers' ``stats=True``
+    block (obs/counters.py); BDF's (B, MAXORD+1) ``order_hist`` is shaped
+    differently and allocated at its one use site instead."""
+    return ("n_accepted", "n_rejected") + obs_counters.COMMON_KEYS
+
+
+def _madd(acc, seg, live):
+    """Device twin of ``obs.counters.masked_add``: ``acc + seg`` where the
+    per-lane ``live`` mask holds (broadcast over trailing axes, e.g. the
+    (B, MAXORD+1) order histogram)."""
+    m = live.reshape(live.shape + (1,) * (seg.ndim - live.ndim))
+    return acc + jnp.where(m, seg, 0)
+
+
+def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
+                        n_save):
+    """Initial per-segment carry shared by both segmented drivers:
+    ``(y, t, h, e, obs, sstate, ctrl)``.  ``ctrl`` is the pipelined
+    driver's device-resident control block — the park/budget/accumulate
+    state the blocking driver keeps in host numpy arrays — and is simply
+    unused by the blocking path (a few (B,) allocations)."""
+    B = y0s.shape[0]
+    t = jnp.full((B,), t0, dtype=y0s.dtype)
+    h = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: heuristic first step
+    e = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: fresh PI controller
+    if observer is not None:
+        obs = jax.tree.map(
+            lambda x: jnp.broadcast_to(jnp.asarray(x),
+                                       (B,) + jnp.shape(jnp.asarray(x))),
+            observer_init)
+    else:
+        obs = jnp.zeros((B,))
+    if method == "bdf":
+        # all-zero difference history = per-lane cold start (bdf.solve)
+        sstate = (jnp.zeros((B, bdf.MAXORD + 3) + y0s.shape[1:],
+                            dtype=y0s.dtype),
+                  jnp.ones((B,), dtype=jnp.int32),
+                  jnp.full((B,), -1.0, dtype=y0s.dtype),
+                  jnp.zeros((B,), dtype=jnp.int32))
+    else:
+        sstate = jnp.zeros((B,), dtype=y0s.dtype)  # unused dummy
+    ctrl = {"final_status": jnp.full((B,), int(sdirk.RUNNING),
+                                     dtype=jnp.int32),
+            "final_t": jnp.full((B,), jnp.nan, dtype=y0s.dtype),
+            "n_acc": jnp.zeros((B,), dtype=jnp.int64),
+            "n_rej": jnp.zeros((B,), dtype=jnp.int64)}
+    if n_save:
+        ctrl["saved"] = jnp.zeros((B,), dtype=jnp.int64)
+    if stats:
+        # one DISTINCT buffer per counter: the pipelined relaunch donates
+        # the whole carry, and XLA rejects the same buffer donated twice
+        st = {k: jnp.zeros((B,), dtype=jnp.int32)
+              for k in _stats_keys()}
+        if method == "bdf":
+            st["order_hist"] = jnp.zeros((B, bdf.MAXORD + 1),
+                                         dtype=jnp.int32)
+        ctrl["stats"] = st
+    return (y0s, t, h, e, obs, sstate, ctrl)
+
+
+def _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor, linsolve,
+                jac, observer, seg_save, bundle_mode, jac_window,
+                newton_tol, method, stats, has_budget, n_save_total,
+                compact):
+    """The PIPELINED driver's traced segment program (un-jitted — brlint
+    tier B audits it through here): one vmapped segment solve plus the
+    device-resident control-block update that the blocking driver performs
+    on host between launches.  The arithmetic mirrors the host loop
+    statement-for-statement, which is what makes ``pipeline=True`` ==
+    ``pipeline=False`` bit-exact (regression-tested).
+
+    Signature: ``seg(bundle, t1, cfgs, budget, carry) -> (carry, aux)``
+    with ``carry = (y, t, h, e, obs, sstate, ctrl)``.  ``budget`` is the
+    traced ``max_attempts`` scalar (ignored unless ``has_budget``).  With
+    ``seg_save`` the aux dict carries the trajectory drain payload —
+    ``compact`` additionally gathers the saved rows lane-major into a flat
+    buffer on device, so the drainer thread can transfer just the rows
+    that exist instead of the whole (B, seg_save, S) block."""
+    one = _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
+                            linsolve, jac, observer, seg_save, bundle_mode,
+                            jac_window, newton_tol, method, stats)
+    vsolve = jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0, 0))
+
+    def seg(bundle, t1, cfgs, budget, carry):
+        y, t, h, e, obs, sstate, ctrl = carry
+        res = vsolve(bundle, y, t, t1, cfgs, h, e, obs, sstate)
+        # ---- host bookkeeping, verbatim, on device ------------------------
+        running = ctrl["final_status"] == int(sdirk.RUNNING)
+        n_acc = ctrl["n_acc"] + jnp.where(
+            running, res.n_accepted.astype(jnp.int64), 0)
+        n_rej = ctrl["n_rej"] + jnp.where(
+            running, res.n_rejected.astype(jnp.int64), 0)
+        terminal = res.status != int(sdirk.MAX_STEPS_REACHED)
+        newly = running & terminal
+        final_status = jnp.where(newly, res.status, ctrl["final_status"])
+        final_t = jnp.where(newly, res.t, ctrl["final_t"])
+        if has_budget:
+            # exact per-lane attempt budget (monolithic max_steps parity)
+            exhausted = (final_status == int(sdirk.RUNNING)) & (
+                n_acc + n_rej >= budget)
+            final_status = jnp.where(exhausted,
+                                     int(sdirk.MAX_STEPS_REACHED),
+                                     final_status)
+            final_t = jnp.where(exhausted, res.t, final_t)
+        ctrl2 = {"final_status": final_status.astype(jnp.int32),
+                 "final_t": final_t, "n_acc": n_acc, "n_rej": n_rej}
+        if stats:
+            ctrl2["stats"] = {k: _madd(ctrl["stats"][k], res.stats[k],
+                                       running)
+                              for k in ctrl["stats"]}
+        if seg_save:
+            saved = ctrl["saved"]
+            take = jnp.where(
+                running,
+                jnp.minimum(res.n_saved.astype(jnp.int64),
+                            n_save_total - saved),
+                jnp.int64(0))
+            ctrl2["saved"] = saved + take
+        parked = final_status != int(sdirk.RUNNING)
+        t_new = jnp.where(parked, t1, res.t)
+        h_new = jnp.where(~running, h, res.h)
+        e_new = jnp.where(~running, e, res.err_prev)
+        sstate_new = res.solver_state if method == "bdf" else sstate
+        obs_new = res.observed if observer is not None else obs
+        carry2 = (res.y, t_new, h_new, e_new, obs_new, sstate_new, ctrl2)
+        if not seg_save:
+            aux = {"ts": res.ts, "ys": res.ys, "n_saved": res.n_saved}
+        elif compact:
+            # on-device gather: compact the saved rows lane-major (lane b's
+            # rows contiguous, in-lane order — the same ordering the host
+            # scatter's np.nonzero produced) into the front of a flat
+            # buffer, so the async drain moves only rows that exist
+            B = take.shape[0]
+            cap = B * seg_save
+            off = jnp.cumsum(take) - take               # exclusive prefix
+            col = jnp.arange(seg_save, dtype=jnp.int64)
+            valid = col[None, :] < take[:, None]        # (B, seg_save)
+            dst = jnp.where(valid, off[:, None] + col[None, :], cap)
+            dstf = dst.reshape(-1)
+            flat_ts = jnp.zeros((cap,), res.ts.dtype).at[dstf].set(
+                res.ts.reshape(-1), mode="drop")
+            tail = res.ys.shape[2:]
+            flat_ys = jnp.zeros((cap,) + tail, res.ys.dtype).at[dstf].set(
+                res.ys.reshape((cap,) + tail), mode="drop")
+            aux = {"take": take, "total": take.sum(),
+                   "ts": flat_ts, "ys": flat_ys}
+        else:
+            # mesh-sharded path: the flat gather's global destination
+            # indices would force cross-shard data movement into an
+            # otherwise collective-free program, so the drainer transfers
+            # the per-lane buffers and compacts on host
+            aux = {"take": take, "ts": res.ts, "ys": res.ys}
+        return carry2, aux
+
+    return seg
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_vsolve_segmented_ctrl(rhs, rtol, atol, segment_steps,
+                                  dt_min_factor, linsolve, jac, observer,
+                                  seg_save=0, bundle_mode=False,
+                                  jac_window=1, newton_tol=0.03,
+                                  method="bdf", stats=False,
+                                  has_budget=False, n_save_total=0,
+                                  compact=True):
+    """Compiled pipelined segment program.  The carry (argument 4 — y, h,
+    e, observer fold, the (B, MAXORD+3, S) BDF history, control block) is
+    DONATED: each relaunch aliases the previous segment's output buffers
+    in place instead of copying them, removing the per-segment HBM churn
+    of the multistep history tensors."""
+    fn = _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor,
+                     linsolve, jac, observer, seg_save, bundle_mode,
+                     jac_window, newton_tol, method, stats, has_budget,
+                     n_save_total, compact)
+    return jax.jit(fn, donate_argnums=(4,))
+
+
+class _TrajectoryDrainer:
+    """Background trajectory drain for the pipelined segmented driver —
+    the lag-1 pipeline stage: while the device solves segment i+1, this
+    thread moves segment i's saved rows to host and scatters them into
+    the (B, n_save) trajectory arrays.
+
+    Transfers are two-phase so only rows that exist cross the wire: the
+    tiny per-lane ``take`` vector (and, on the compact path, the scalar
+    row total) is enqueued with a non-blocking ``copy_to_host_async`` at
+    submit time; the worker then reads the total, and for compacted
+    segments slices the on-device lane-major gather buffer to the next
+    power-of-two bucket before fetching it (bucketing bounds the distinct
+    slice programs at log2(B*seg_save); a zero-row segment transfers
+    nothing).  The worker's fetches never touch ``_host_fetch`` — they
+    are the overlapped path the blocking-sync counter contrasts with.
+
+    Worker failures are latched and re-raised from :meth:`close` (and
+    from the next :meth:`submit`), so a drain error fails the sweep call
+    instead of silently dropping trajectory rows."""
+
+    def __init__(self, B, n_save, tail_shape, recorder=None,
+                 compact=True, track_drained=False):
+        # default-f64 numpy accumulators, same as the blocking driver's
+        # all_ts/all_ys (the result is cast to the sweep dtype at return)
+        self.all_ts = np.full((B, n_save), np.inf)
+        self.all_ys = np.zeros((B, n_save) + tail_shape)
+        self.saved = np.zeros((B,), dtype=np.int64)
+        self.recorder = recorder
+        self.compact = compact
+        # drained_ts per segment is only retained for a progress consumer
+        # (pop_ready); without one it would accumulate every accepted time
+        # of the whole sweep on host
+        self.track_drained = track_drained
+        self._drained = {}       # seg -> lane-major drained ts (np)
+        self._done_upto = -1
+        self._lock = threading.Lock()
+        # bounded queue: if the drain falls behind, submit blocks (a host
+        # wait, not a device sync) instead of pinning unbounded per-segment
+        # device buffers alive
+        self._q = queue.Queue(maxsize=8)
+        self._exc = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="br-sweep-drain")
+        self._thread.start()
+
+    def submit(self, seg, aux):
+        if self._exc is not None:
+            raise self._exc
+        for k in ("take", "total"):
+            arr = aux.get(k)
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()   # non-blocking enqueue
+        self._q.put((seg, aux))
+
+    def pop_ready(self):
+        """(seg, drained_ts) for every completed segment, in segment
+        order (segments are drained in submit order, so the ready set is
+        always a prefix)."""
+        out = []
+        with self._lock:
+            for s in sorted(self._drained):
+                if s <= self._done_upto:
+                    out.append((s, self._drained.pop(s)))
+        return out
+
+    def close(self):
+        """Drain the queue, join the worker, re-raise any drain failure."""
+        self._q.put(None)
+        self._thread.join()
+        if self._exc is not None:
+            raise self._exc
+
+    # ---- worker thread ----------------------------------------------------
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._exc is not None:
+                continue   # keep consuming so submit can't deadlock
+            try:
+                self._drain(*item)
+            except BaseException as e:  # noqa: BLE001 — latched for close()
+                self._exc = e
+
+    def _drain(self, seg, aux):
+        with span_or_null(self.recorder, "drain", segment=seg) as sp:
+            take = np.asarray(aux["take"]).astype(np.int64)
+            tot = (int(np.asarray(aux["total"])) if "total" in aux
+                   else int(take.sum()))
+            if tot == 0:
+                drained_ts = np.empty((0,))
+            elif self.compact:
+                cap = aux["ts"].shape[0]
+                bucket = min(cap, 1 << max(0, (tot - 1).bit_length()))
+                ts_d = aux["ts"][:bucket]
+                ys_d = aux["ys"][:bucket]
+                for arr in (ts_d, ys_d):
+                    if hasattr(arr, "copy_to_host_async"):
+                        arr.copy_to_host_async()
+                ts_np = np.asarray(ts_d)[:tot]
+                ys_np = np.asarray(ys_d)[:tot]
+                cum = np.cumsum(take)
+                pos = np.arange(tot)
+                b_idx = np.searchsorted(cum, pos, side="right")
+                c_idx = pos - (cum - take)[b_idx]
+                dst = self.saved[b_idx] + c_idx
+                self.all_ts[b_idx, dst] = ts_np
+                self.all_ys[b_idx, dst] = ys_np
+                drained_ts = ts_np
+            else:
+                # sharded buffers: fetch per-lane blocks, compact on host
+                # (same masked scatter as the blocking driver)
+                ts_np = np.asarray(aux["ts"])
+                ys_np = np.asarray(aux["ys"])
+                col = np.arange(ts_np.shape[1])
+                src = col[None, :] < take[:, None]
+                b_idx, c_idx = np.nonzero(src)
+                dst = self.saved[b_idx] + c_idx
+                self.all_ts[b_idx, dst] = ts_np[b_idx, c_idx]
+                self.all_ys[b_idx, dst] = ys_np[b_idx, c_idx]
+                drained_ts = ts_np[b_idx, c_idx]
+            self.saved += take
+            sp["attrs"]["rows"] = tot
+            if self.recorder is not None and tot:
+                self.recorder.counter("drain_rows", tot)
+        with self._lock:
+            if self.track_drained:
+                self._drained[seg] = drained_ts
+            self._done_upto = seg
+
+
+def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
+                             segment_steps, max_segments, max_attempts,
+                             poll_every, compact, rtol, atol, linsolve, jac,
+                             observer, dt_min_factor, n_save, seg_save,
+                             bundle_mode, jac_window, newton_tol, method,
+                             stats, recorder, watch, progress):
+    """The pipelined gear of :func:`ensemble_solve_segmented` (module
+    docstring): run-ahead dispatch with carry donation, device-resident
+    termination/budget logic, strided polling, and the background
+    trajectory drain.  Bit-exact against the blocking gear."""
+    B = y0s.shape[0]
+    jitted = _cached_vsolve_segmented_ctrl(
+        rhs, rtol, atol, segment_steps, dt_min_factor, linsolve, jac,
+        observer, seg_save, bundle_mode, jac_window, newton_tol, method,
+        stats, max_attempts is not None, int(n_save) if n_save else 0,
+        compact)
+    budget = jnp.asarray(int(max_attempts) if max_attempts is not None
+                         else 0, dtype=jnp.int64)
+    # the first relaunch DONATES the carry: the y slot must not alias the
+    # caller's y0s buffer, which would be invalidated under their feet
+    carry = (jnp.array(carry[0], copy=True),) + tuple(carry[1:])
+    drainer = None
+    if n_save:
+        drainer = _TrajectoryDrainer(B, int(n_save), y0s.shape[1:],
+                                     recorder=recorder, compact=compact,
+                                     track_drained=progress is not None)
+    emitted = 0
+
+    def flush_progress(status_np, acc_np, launched):
+        """Emit one ``progress`` payload per launched segment, batched at
+        poll points (the pipelined host learns lane state only there);
+        ``drained_ts`` rides along once the drain of that segment has
+        completed, preserving the blocking driver's line order."""
+        nonlocal emitted
+        if progress is None:
+            return
+        lanes_done = int((status_np != int(sdirk.RUNNING)).sum())
+        acc_tot = int(acc_np.sum())
+        if drainer is None:
+            ready = [(s, None) for s in range(emitted, launched)]
+        else:
+            ready = drainer.pop_ready()
+        for s, dts in ready:
+            payload = {"segment": s, "lanes_done": lanes_done,
+                       "n_lanes": B, "accepted_total": acc_tot}
+            if dts is not None and len(dts):
+                payload["drained_ts"] = dts
+            progress(payload)
+            emitted = s + 1
+
+    done = False
+    launched = 0
+    aux = None
+    status_np = acc_np = None
+    try:
+        for seg in range(max_segments):
+            region = (watch.region("sweep-segment", single_program=True)
+                      if watch is not None else contextlib.nullcontext())
+            with span_or_null(recorder, "segment", index=seg), region:
+                # enqueue-only: the donated carry aliases the previous
+                # segment's output buffers; nothing here waits on the
+                # device
+                carry, aux = jitted(bundle_arg, t1, cfgs, budget, carry)
+            launched = seg + 1
+            if drainer is not None:
+                drainer.submit(seg, aux)
+            if launched % poll_every == 0 or launched == max_segments:
+                ctrl = carry[6]
+                with span_or_null(recorder, "poll", upto=seg) as sp:
+                    status_np, acc_np = _host_fetch(
+                        (ctrl["final_status"], ctrl["n_acc"]), recorder)
+                if recorder is not None and sp["dur"] is not None:
+                    # device-ahead attribution: poll wall-clock is the
+                    # only time the pipelined host waits on the device
+                    recorder.counter("poll_wait_s", sp["dur"])
+                flush_progress(status_np, acc_np, launched)
+                if not bool(np.any(status_np == int(sdirk.RUNNING))):
+                    done = True
+                    break
+    finally:
+        if drainer is not None:
+            drainer.close()
+
+    y, t_dev, h, e, obs, _sstate, ctrl = carry
+    fs, ft, na, nr, t_np = _host_fetch(
+        (ctrl["final_status"], ctrl["final_t"], ctrl["n_acc"],
+         ctrl["n_rej"], t_dev), recorder)
+    flush_progress(fs, na, launched)
+    fs = np.array(fs, copy=True)
+    ft = np.array(ft, copy=True)
+    if not done:
+        # max_segments exhausted with lanes still running (same host-side
+        # fallback as the blocking driver's for-else)
+        fs[fs == int(sdirk.RUNNING)] = int(sdirk.MAX_STEPS_REACHED)
+    # never-terminated lanes report their current t (for a lane still
+    # RUNNING the carried t IS the last segment's res.t — parking never
+    # touched it)
+    ft = np.where(np.isnan(ft), t_np, ft)
+
+    if n_save:
+        ts_out = jnp.asarray(drainer.all_ts, dtype=y0s.dtype)
+        ys_out = jnp.asarray(drainer.all_ys, dtype=y0s.dtype)
+        n_saved_out = jnp.asarray(drainer.saved)
+    else:
+        ts_out, ys_out, n_saved_out = aux["ts"], aux["ys"], aux["n_saved"]
+    return sdirk.SolveResult(
+        t=jnp.asarray(ft, dtype=y0s.dtype), y=y,
+        status=jnp.asarray(fs),
+        n_accepted=jnp.asarray(na), n_rejected=jnp.asarray(nr),
+        ts=ts_out, ys=ys_out, n_saved=n_saved_out, h=h,
+        observed=obs if observer is not None else None,
+        stats=(dict(ctrl["stats"]) if stats else None))
 
 
 def sweep_report(res, cfgs=None):

@@ -175,6 +175,7 @@ def rung_main():
     from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
     from batchreactor_tpu.parallel import (ensemble_solve_segmented,
                                            ignition_observer)
+    from batchreactor_tpu.parallel.sweep import resolve_pipeline_defaults
     from batchreactor_tpu.solver.sdirk import SUCCESS
     from batchreactor_tpu.utils.composition import density, mole_to_mass
 
@@ -263,9 +264,14 @@ def rung_main():
         write_jsonl(os.path.join(REPO, "bench_obs.jsonl"), report)
         log(f"[rung B={B}] obs report -> bench_obs.jsonl")
     tau = np.asarray(res.observed["tau"])
+    # segmented execution gear actually run (BENCH_PIPELINE=0 reverts to
+    # the blocking per-segment host loop, BENCH_POLL_EVERY sets the
+    # termination-poll stride; ONE resolution rule, parallel/sweep.py)
+    gear, stride = resolve_pipeline_defaults()
     print(json.dumps({
         "B": B, "method": method, "wall_s": round(wall, 3),
         "cps": round(B / wall, 3),
+        "pipeline": gear, "poll_every": stride,
         "n_ok": n_ok,
         "warm_s": round(t_warm, 1),
         "platform": jax.default_backend(),

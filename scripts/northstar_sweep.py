@@ -99,7 +99,7 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
               phi_hi=1.6, t1=8e-4, p=1e5, ckpt_dir=None, chunk_size=512,
               segment_steps=256, mesh=None, rtol=1e-6, atol=1e-10,
               n_spot=8, method="bdf", jac_window=8, sort_lanes=True,
-              log=print):
+              pipeline=None, poll_every=None, log=print):
     """Run the T x phi GRI ignition map; return the result record dict."""
     import jax
     import jax.numpy as jnp
@@ -112,7 +112,8 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
     from batchreactor_tpu.parallel.grid import (condition_grid,
                                                 premixed_mole_fracs,
                                                 sweep_solution_vectors)
-    from batchreactor_tpu.parallel.sweep import ensemble_solve_segmented
+    from batchreactor_tpu.parallel.sweep import (ensemble_solve_segmented,
+                                                 resolve_pipeline_defaults)
     from batchreactor_tpu.parallel import sweep_report
     from batchreactor_tpu.solver.sdirk import SUCCESS
     from batchreactor_tpu.utils.profiling import Phases
@@ -139,7 +140,8 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
 
     solve_kw = dict(rtol=rtol, atol=atol, jac=jac, observer=obs,
                     observer_init=obs0, mesh=mesh, method=method,
-                    segment_steps=segment_steps, jac_window=jac_window)
+                    segment_steps=segment_steps, jac_window=jac_window,
+                    pipeline=pipeline, poll_every=poll_every)
     lane_cost = None
     if sort_lanes and ckpt_dir:
         # cost-sorted chunking only changes anything when the sweep is
@@ -161,6 +163,13 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
 
     tau = np.asarray(res.observed["tau"])
     status = np.asarray(res.status)
+    if segment_steps and int(segment_steps) > 0:
+        gear_run, stride_run = resolve_pipeline_defaults(pipeline,
+                                                         poll_every)
+    else:
+        # monolithic launch (NORTHSTAR_SEG=0): no segmented gear ran at
+        # all — record null, not a resolved default that never executed
+        gear_run = stride_run = None
     report = sweep_report(res, cfgs)
     log(f"[northstar] B={B} wall={wall:.1f}s -> {B / wall:.2f} cond/s "
         f"({int((status == SUCCESS).sum())}/{B} ok, "
@@ -220,6 +229,10 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
         "method": method,
         "exp32": os.environ.get("BR_EXP32") == "1",
         "jac_window": jac_window,
+        # the segmented execution gear actually run (None resolves through
+        # the ONE library rule, so the record can't drift from reality)
+        "pipeline": gear_run,
+        "poll_every": stride_run,
         "lane_cost_sorted": lane_cost is not None,
         "B": int(B),
         "wall_s": round(wall, 2),
@@ -254,6 +267,12 @@ def main():
                     segment_steps=int(os.environ.get("NORTHSTAR_SEG", "256")),
                     chunk_size=int(os.environ.get("NORTHSTAR_CHUNK", "512")),
                     sort_lanes=os.environ.get("NORTHSTAR_SORT", "1") == "1",
+                    # NORTHSTAR_PIPELINE=0 pins the blocking gear for this
+                    # run regardless of the BENCH_PIPELINE library default
+                    pipeline=(None if "NORTHSTAR_PIPELINE" not in os.environ
+                              else os.environ["NORTHSTAR_PIPELINE"] != "0"),
+                    poll_every=(None if "NORTHSTAR_POLL" not in os.environ
+                                else int(os.environ["NORTHSTAR_POLL"])),
                     log=lambda m: print(m, file=sys.stderr, flush=True))
     out = os.environ.get("NORTHSTAR_OUT", os.path.join(REPO,
                                                        "NORTHSTAR.json"))

@@ -45,6 +45,51 @@ def lin_stats(fixtures_dir):
 # ---------------------------------------------------------------------------
 # device-side solver counters
 # ---------------------------------------------------------------------------
+
+#: primitive kinds the stats=True counter block is allowed to add to the
+#: traced step program: masked adds, the gating boolean logic, dtype casts
+#: of the masks, the order-histogram scatter, and jit wrapper nodes.
+#: Anything else (a dot_general, an extra while, a callback, a device_put)
+#: means the telemetry stopped being free.
+_COUNTER_BLOCK_PRIMS = frozenset({
+    "add", "and", "or", "not", "eq", "ne", "lt", "le", "gt", "ge",
+    "select_n", "broadcast_in_dim", "convert_element_type", "reshape",
+    "scatter-add", "pjit", "mul", "sub", "integer_pow", "squeeze",
+})
+
+
+@pytest.mark.parametrize("solver", [bdf.solve, sdirk.solve],
+                         ids=["bdf", "sdirk"])
+def test_stats_on_jaxpr_adds_only_counter_block(solver):
+    """The PERF.md measurement-surface guarantee, asserted on program
+    STRUCTURE instead of flaky wall time: the stats=True jaxpr differs
+    from stats=False only by counter-block primitives — same loop count,
+    no new linear algebra, no host callbacks, no in-loop staging."""
+    import collections
+
+    from batchreactor_tpu.analysis.jaxpr_audit import _iter_eqns
+
+    def hist(stats):
+        jaxpr = jax.make_jaxpr(
+            lambda y: solver(_lin_rhs, y, 0.0, 1.0, None, rtol=1e-6,
+                             atol=1e-12, max_steps=4, stats=stats).y)(
+            jnp.asarray([1.0, 2.0]))
+        c = collections.Counter()
+        for eqn, _ in _iter_eqns(jaxpr):
+            c[eqn.primitive.name] += 1
+        return c
+
+    off, on = hist(False), hist(True)
+    added = {k: on[k] - off[k] for k in set(on) | set(off)
+             if on[k] != off[k]}
+    # nothing removed, and nothing added beyond the counter block
+    assert all(v > 0 for v in added.values()), added
+    assert set(added) <= _COUNTER_BLOCK_PRIMS, added
+    # the loop structure itself is untouched
+    assert on["while"] == off["while"]
+    assert on.get("dot_general", 0) == off.get("dot_general", 0)
+
+
 def test_bdf_counter_exactness_linear_ode(lin_stats):
     """On a LINEAR ODE with the (exact) default Jacobian and the exact LU
     solve, the first Newton iteration lands on the corrector solution and

@@ -389,6 +389,256 @@ def test_coupled_sweep_sharded_matches_unsharded(h2oni):
                                    rtol=1e-9, atol=1e-14)
 
 
+# --- pipelined segmented driver: equivalence & host-sync gates -------------
+#
+# The pipelined-vs-blocking contract is solver-driver plumbing, not
+# chemistry, so these tests run a cheap stiff decay ODE: every traced
+# program compiles in ~1 s where an h2o2 segment program costs tens —
+# the h2o2-based segmented tests above already pin chemistry-on-segmented
+# behavior, and the drivers are bit-exact regardless of RHS.
+
+def _decay_rhs(t, y, cfg):
+    """Per-lane stiff linear decay: lanes with larger k need more steps,
+    so they terminate in different segments (mid-sweep termination)."""
+    return -cfg["k"] * y
+
+
+def _decay_setup(B=4, poison_lane=None):
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (B, 2))
+    if poison_lane is not None:
+        y0s = y0s.at[poison_lane, 0].set(jnp.nan)
+    cfgs = {"k": jnp.logspace(1.0, 2.5, B)}
+    return y0s, cfgs
+
+
+def _decay_observer():
+    """Flat-dict observer fold (running max of y[0] + last accepted t):
+    exercises the observer carry through parking and segment resume."""
+    init = {"ymax": -jnp.inf, "t_last": jnp.nan}
+
+    def obs(t, y, acc):
+        return {"ymax": jnp.maximum(y[0], acc["ymax"]), "t_last": t}
+
+    return obs, init
+
+
+def _solve_result_fields(res):
+    """Every value-carrying field of a SolveResult as np arrays (observed
+    and stats flattened in), for bit-exact driver comparisons."""
+    out = {f: np.asarray(getattr(res, f))
+           for f in ("t", "y", "status", "n_accepted", "n_rejected",
+                     "ts", "ys", "n_saved", "h")}
+    if res.observed is not None:
+        for k, v in res.observed.items():
+            out[f"obs_{k}"] = np.asarray(v)
+    if res.stats is not None:
+        for k, v in res.stats.items():
+            out[f"stat_{k}"] = np.asarray(v)
+    return out
+
+
+def _assert_bit_exact(a, b, ctx=""):
+    fa, fb = _solve_result_fields(a), _solve_result_fields(b)
+    assert fa.keys() == fb.keys(), (ctx, fa.keys(), fb.keys())
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], fb[k],
+                                      err_msg=f"{ctx} field {k}")
+
+
+@pytest.mark.parametrize("method", ["bdf", "sdirk"])
+@pytest.mark.parametrize("n_save", [0, 256])
+def test_pipelined_bit_exact_matrix(method, n_save):
+    """The pipelined segmented driver (device-resident park logic, carry
+    donation, async drain) must be BIT-EXACT against the blocking driver
+    across solvers x trajectory modes x poll strides — including
+    poll_every > max_segments (a single poll at the run-ahead cap, every
+    trailing segment an all-parked no-op), mid-sweep termination (the k
+    spread finishes lanes in different segments), and a DT_UNDERFLOW
+    lane exercising the parked-lane splice."""
+    from batchreactor_tpu.parallel import ensemble_solve_segmented
+    from batchreactor_tpu.solver.sdirk import DT_UNDERFLOW
+
+    obs, obs0 = _decay_observer()
+    y0s, cfgs = _decay_setup(B=4, poison_lane=1)
+    # max_segments tight (the stiffest lane needs ~11): the
+    # stride>max_segments case then caps its run-ahead at ~9 trailing
+    # all-parked segments instead of burning the suite budget on no-ops
+    # (SDIRK's zero-span re-entries reject segment_steps attempts each)
+    kw = dict(segment_steps=16, max_segments=20, observer=obs,
+              observer_init=obs0, n_save=n_save, method=method,
+              dt_min_factor=1e-12)
+    blocking = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                        pipeline=False, **kw)
+    status = np.asarray(blocking.status)
+    assert status[1] == DT_UNDERFLOW and np.all(np.delete(status, 1)
+                                                == SUCCESS)
+    assert int(blocking.n_accepted.max()) > 32  # spans >2 segments
+    for poll_every in (1, 4, 50):
+        piped = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                         pipeline=True,
+                                         poll_every=poll_every, **kw)
+        _assert_bit_exact(blocking, piped,
+                          f"{method}/n_save={n_save}/poll={poll_every}")
+
+
+def test_pipelined_mesh_sharded_bit_exact():
+    """The mesh-sharded pipelined path — which drains per-lane buffers
+    instead of the flat on-device gather (global destination indices
+    would insert collectives into a collective-free program) — matches
+    the blocking driver bit-exactly on the 8-virtual-device mesh,
+    including n_save saturation (64 rows < ~108-173 accepted steps)."""
+    from batchreactor_tpu.parallel import ensemble_solve_segmented
+
+    y0s, cfgs = _decay_setup(B=8)
+    kw = dict(segment_steps=16, max_segments=64, n_save=64,
+              mesh=make_mesh())
+    blocking = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                        pipeline=False, **kw)
+    assert np.all(np.asarray(blocking.n_saved) == 64)  # saturated
+    piped = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                     pipeline=True, poll_every=4, **kw)
+    _assert_bit_exact(blocking, piped, "mesh")
+
+
+def test_pipelined_budget_parking_bit_exact():
+    """The exact max_attempts budget — now latched on device — parks
+    lanes with MAX_STEPS_REACHED at exactly the same segment, t, and
+    attempt counts as the blocking driver's host-side ledger, and the
+    device-side stats accumulator matches the host masked-add fold
+    bit-for-bit."""
+    from batchreactor_tpu.parallel import ensemble_solve_segmented
+    from batchreactor_tpu.solver.sdirk import MAX_STEPS_REACHED
+
+    y0s, cfgs = _decay_setup(B=4)
+    # the cheapest lane needs ~108 attempts, the stiffest ~173: a budget
+    # of 120 parks the stiff lanes mid-sweep while the cheap lane finishes
+    kw = dict(segment_steps=16, max_segments=64, max_attempts=120,
+              stats=True)
+    blocking = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                        pipeline=False, **kw)
+    # the budget must actually bite for the parking path to be exercised,
+    # while cheap lanes finish inside it
+    status = np.asarray(blocking.status)
+    assert np.any(status == MAX_STEPS_REACHED) and np.any(status == SUCCESS)
+    for poll_every in (1, 3):
+        piped = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                         pipeline=True,
+                                         poll_every=poll_every, **kw)
+        _assert_bit_exact(blocking, piped, f"budget/poll={poll_every}")
+
+
+def test_pipelined_host_sync_gate(monkeypatch):
+    """Host-sync regression gate: the pipelined driver performs at most
+    ceil(segments / poll_every) + 1 main-thread blocking fetches per
+    sweep (polls + the final state fetch), where the blocking driver
+    pays >= 2 per segment on this stats+trajectory workload — the
+    per-segment halo PERF.md blames for the map-vs-rung gap cannot
+    silently creep back."""
+    import batchreactor_tpu.parallel.sweep as sweep_mod
+
+    y0s, cfgs = _decay_setup(B=4)
+    kw = dict(segment_steps=16, max_segments=64, n_save=256, stats=True)
+
+    calls = []
+    orig = sweep_mod._host_fetch
+
+    def counting_fetch(x, recorder=None):
+        calls.append(1)
+        return orig(x, recorder)
+
+    monkeypatch.setattr(sweep_mod, "_host_fetch", counting_fetch)
+
+    segs = []
+    sweep_mod.ensemble_solve_segmented(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, pipeline=False,
+        progress=lambda p: segs.append(p), **kw)
+    blocking_calls, n_segments = len(calls), len(segs)
+    assert n_segments >= 3, "workload too small to exercise the gate"
+    assert blocking_calls >= 2 * n_segments  # >=1 status +1 stats per seg
+
+    calls.clear()
+    poll_every = 4
+    sweep_mod.ensemble_solve_segmented(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, pipeline=True,
+        poll_every=poll_every, **kw)
+    budget = -(-n_segments // poll_every) + 1
+    assert len(calls) <= budget, (len(calls), budget, n_segments)
+
+
+def test_pipelined_checkpoint_resume_bit_exact(tmp_path):
+    """Checkpointed chunks running the pipelined gear reproduce the
+    blocking gear's chunks bit-exactly, including chunks served from a
+    resumed checkpoint directory."""
+    import os
+
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    y0s, cfgs = _decay_setup(B=6)
+    kw = dict(segment_steps=16, max_steps=2000, n_save=128)
+    blocking = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                  str(tmp_path / "blk"), chunk_size=3,
+                                  pipeline=False, **kw)
+    piped = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                               str(tmp_path / "pipe"), chunk_size=3,
+                               pipeline=True, poll_every=4, **kw)
+    _assert_bit_exact(blocking, piped, "checkpointed")
+    # resume: drop one chunk, re-solve it through the pipelined gear only
+    os.remove(str(tmp_path / "pipe" / "chunk_00001.npz"))
+    resumed = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 str(tmp_path / "pipe"), chunk_size=3,
+                                 pipeline=True, poll_every=4, **kw)
+    _assert_bit_exact(blocking, resumed, "checkpointed-resume")
+
+
+def test_checkpointed_monolithic_gear_knob_handling(tmp_path):
+    """Unsegmented checkpointed chunks tolerate None-valued gear knobs
+    (the northstar script passes them unconditionally) and reject
+    explicit values loudly — the monolithic path has no segmented driver
+    to configure."""
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    y0s, cfgs = _decay_setup(B=4)
+    res = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                             str(tmp_path / "mono"), chunk_size=2,
+                             pipeline=None, poll_every=None)
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    with pytest.raises(ValueError, match="segmented-path"):
+        checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                           str(tmp_path / "mono2"), chunk_size=2,
+                           pipeline=True)
+    # the check is up-front: it fires even when every chunk would resume
+    # from disk (no _solve_chunk call to host a per-chunk check)
+    with pytest.raises(ValueError, match="segmented-path"):
+        checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                           str(tmp_path / "mono"), chunk_size=2,
+                           pipeline=True)
+
+
+def test_chunk_log_thread_safe(tmp_path):
+    """checkpointed_sweep serializes chunk_log calls in the library (the
+    writer thread's save lines interleave with the main thread's solve
+    lines): a deliberately slow, concurrency-detecting logger must never
+    observe itself entered twice at once."""
+    import time
+
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    y0s, cfgs = _decay_setup(B=8)
+    state = {"active": 0, "max_active": 0, "lines": 0}
+
+    def log(msg):
+        state["active"] += 1
+        state["max_active"] = max(state["max_active"], state["active"])
+        time.sleep(0.005)  # widen the race window
+        state["active"] -= 1
+        state["lines"] += 1
+
+    checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                       str(tmp_path / "ck"), chunk_size=2, chunk_log=log)
+    assert state["lines"] >= 8  # 4 solve lines + 4 async save lines
+    assert state["max_active"] == 1
+
+
 def test_checkpointed_sweep_lane_cost_order(tmp_path, h2o2):
     """Cost-sorted chunking (lane_cost=) returns results in CALLER lane
     order, per-lane equal to the unsorted run at far-below-rtol level

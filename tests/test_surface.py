@@ -8,6 +8,7 @@ for the full convention-recovery analysis.
 """
 
 import csv
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -20,7 +21,17 @@ from batchreactor_tpu.ops.rhs import make_surface_rhs
 from batchreactor_tpu.solver.sdirk import SUCCESS, solve
 from batchreactor_tpu.utils.composition import density, mole_to_mass
 
-GOLD = "/root/reference/test/batch_gas_and_surf"
+GOLD = os.path.join(os.environ.get("BR_REFERENCE", "/root/reference"),
+                    "test", "batch_gas_and_surf")
+
+#: golden-CSV tests are reference-only: on a bare clone they must skip,
+#: not fail (conftest convention — mechanism tests run from the vendored
+#: fixtures, reference-parity tests need the reference checkout).  The
+#: guard sits at collection time so the 10 s coupled golden run never
+#: compiles before discovering its CSV is absent.
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(GOLD),
+    reason=f"reference golden CSVs unavailable at {GOLD} (bare clone)")
 
 
 @pytest.fixture(scope="module")
@@ -109,6 +120,7 @@ def _our_dx(gm, th, rhs, y0):
     return dx, dy[ng:]
 
 
+@needs_reference
 def test_golden_initial_rates_surface(setup):
     """Coverage derivatives at t=0 match the reference to <0.1% (stick theta^m
     convention, Gamma*theta Arrhenius convention, Asv default 1)."""
@@ -122,6 +134,7 @@ def test_golden_initial_rates_surface(setup):
             assert abs(dtheta[i] / gold[s] - 1) < 1e-3, (s, dtheta[i], gold[s])
 
 
+@needs_reference
 def test_golden_initial_rates_gas(setup):
     """Surface-driven and forward gas channels match the reference exactly;
     with kc_compat also the dn!=0 reverse channels (PARITY.md)."""
@@ -185,6 +198,7 @@ def test_batch_surf_integration(surf_only):
     assert np.all(np.isfinite(yf))
 
 
+@needs_reference
 def test_gas_and_surf_final_state(setup):
     """Full 10 s coupled run: bulk final composition vs golden CSV (<0.2%).
     Minor-species tails differ through the reference's falloff-reverse

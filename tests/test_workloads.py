@@ -164,10 +164,15 @@ def test_h2o2_single_condition_matches_reference_config(h2o2, lib_dir,
                                                         tmp_path):
     """batch_h2o2-shaped workload: the reference's own config file run
     through the file-driven API (the single-condition anchor the sweep
-    workloads extend)."""
+    workloads extend).  Reference-only: skips on a bare clone (conftest
+    convention) instead of failing on the missing config."""
+    import os
     import shutil
 
-    src = "/root/reference/test/batch_h2o2/batch.xml"
+    src = os.path.join(os.environ.get("BR_REFERENCE", "/root/reference"),
+                       "test", "batch_h2o2", "batch.xml")
+    if not os.path.isfile(src):
+        pytest.skip(f"reference config unavailable at {src} (bare clone)")
     shutil.copy(src, tmp_path / "batch.xml")
     ret = br.batch_reactor(str(tmp_path / "batch.xml"), lib_dir, gaschem=True)
     assert ret == "Success"
