@@ -26,6 +26,11 @@ classes the purity contract forbids in the hot loop:
   (``ops.gas_kinetics._exp32_enabled``) and never applied to solver
   programs, whose mixed-precision Newton preconditioner converts by
   design (solver/linalg.py).
+
+A fourth, structural audit backs the AOT program store (``aot/``): two
+lane counts padded into one bucket must trace to jaxpr-IDENTICAL
+segment programs (``jaxpr-bucket-fork``) — the compile-economy contract
+that one executable serves every B in a bucket.
 """
 
 import os
@@ -238,18 +243,57 @@ def run_audit(fixtures_dir=None):
 
     y0b = jnp.stack([y0, y0])
     cfgb = {k: jnp.broadcast_to(v, (2,)) for k, v in cfg.items()}
-    for sname, sstats in (("segment-pipelined-step", False),
-                          ("segment-pipelined-step-stats", True)):
-        seg_fn = _sweep._segment_fn(
+
+    # ONE construction of the audited segment program per stats variant,
+    # shared by the purity audit and the bucket-invariance audit below —
+    # duplicating the 17-positional call would let the two audits drift
+    # onto different programs under a future signature/tolerance change
+    def _mk_seg_fn(sstats):
+        return _sweep._segment_fn(
             rhs, 1e-6, 1e-10, 4, 1e-22, "auto", jac, None, 2, False, 1,
             0.03, "bdf", sstats, True, 8, True)
+
+    def _run_seg(seg_fn, cfg_arg):
+        def run(c):
+            return seg_fn(0.0, jnp.asarray(1e-7, dtype=jnp.float64),
+                          cfg_arg, jnp.asarray(64, dtype=jnp.int64), c)
+
+        return run
+
+    plain_seg_fn = _mk_seg_fn(False)
+    for sname, seg_fn, sstats in (
+            ("segment-pipelined-step", plain_seg_fn, False),
+            ("segment-pipelined-step-stats", _mk_seg_fn(True), True)):
         carry0 = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
                                             sstats, 8)
-
-        def run_seg(c, seg_fn=seg_fn):
-            return seg_fn(0.0, jnp.asarray(1e-7, dtype=jnp.float64), cfgb,
-                          jnp.asarray(64, dtype=jnp.int64), c)
-
-        jaxpr = jax.make_jaxpr(run_seg)(carry0)
+        jaxpr = jax.make_jaxpr(_run_seg(seg_fn, cfgb))(carry0)
         findings.extend(_audit_jaxpr(sname, jaxpr, check_dtype=False))
+
+    # bucket invariance (aot/ program store): two different lane counts
+    # padded into ONE bucket must trace to byte-identical segment
+    # programs — the structural guarantee behind the zero-recompile
+    # contract (a divergence here means the padding path leaks the
+    # original B into the trace, silently forking the executable set the
+    # bucket ladder exists to bound).
+    from ..aot.buckets import resolve_bucket
+
+    bucket_jaxprs = {}
+    for Bx in (3, 4):
+        bucket = resolve_bucket(Bx, "pow2")
+        y0x = jnp.stack([y0] * Bx)
+        cfgx = {k: jnp.broadcast_to(v, (Bx,)) for k, v in cfg.items()}
+        y0p, cfgp, _ = _sweep.pad_to_bucket(y0x, cfgx, bucket)
+        carryx = _sweep._init_segment_carry(y0p, 0.0, "bdf", None, None,
+                                            False, 8)
+        jaxpr = jax.make_jaxpr(_run_seg(plain_seg_fn, cfgp))(carryx)
+        bucket_jaxprs.setdefault(bucket, []).append((Bx, str(jaxpr)))
+    for bucket, traced in bucket_jaxprs.items():
+        if len(traced) > 1 and len({s for _, s in traced}) != 1:
+            findings.append(Finding(
+                "jaxpr-bucket-fork", f"<jaxpr:segment-bucket-b{bucket}>",
+                0, 0,
+                f"padded segment programs for lane counts "
+                f"{[b for b, _ in traced]} in bucket {bucket} are not "
+                f"jaxpr-identical: the padding path leaks the original "
+                f"batch size into the trace (bucket-miss hazard)"))
     return findings

@@ -173,6 +173,93 @@ def traced_control_flow(ctx):
                 symbol=info.qualname)
 
 
+@register("bucket-shape-branch",
+          "Python branch on .shape[0] of a batched value in device code "
+          "(bucket-miss hazard)")
+def bucket_shape_branch(ctx):
+    """Shape math on tracers is trace-time static, so a Python branch on
+    ``x.shape[0]`` never errors — it silently bakes a *per-batch-size*
+    program fork into the trace.  Under the AOT program store
+    (``batchreactor_tpu/aot``) that is the bucket-miss hazard: the sweep
+    pads every lane count onto a canonical bucket ladder precisely so
+    one executable serves the whole bucket, and a batch-size branch
+    forks the executable set back open behind the ladder's back (each
+    side of the branch is its own compile, ~150 s at GRI scale —
+    PERF.md).  Branch on an explicit static config argument instead, or
+    make the computation shape-polymorphic (``jnp.where`` over lanes).
+    Unlike :func:`traced_control_flow` this rule fires on *static*
+    shape tests — that staticness is exactly what hides the fork."""
+    for info in ctx.index.functions:
+        if not info.device_reachable():
+            continue
+        tainted = _tainted_names(ctx, info)
+        if not tainted:
+            continue
+        # the dominant spelling reads the dim into a local first
+        # (``B = y.shape[0]``): collect those aliases so branching on
+        # the alias flags the same as branching on the read itself
+        aliases = set()
+        for n in _own_nodes(info):
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and _is_batch_dim_read(ctx, n.value, tainted)):
+                aliases.add(n.targets[0].id)
+        for n in _own_nodes(info):
+            if not isinstance(n, (ast.If, ast.While, ast.IfExp)):
+                continue
+            if _is_static_dispatch(n.test):
+                # factory-style config dispatch (``gm is not None and
+                # n > 2``, isinstance guards): per-lane RHS factories
+                # legitimately branch on state-size shape math under a
+                # static-config gate — one program per mechanism, not
+                # per batch size (pinned by the traced-control-flow
+                # test contract)
+                continue
+            for sub in ast.walk(n.test):
+                if (_is_batch_dim_read(ctx, sub, tainted)
+                        or (isinstance(sub, ast.Name)
+                            and sub.id in aliases)):
+                    yield Finding(
+                        "bucket-shape-branch", ctx.path, n.lineno,
+                        n.col_offset,
+                        "Python branch on .shape[0] of a batched value "
+                        "inside traced sweep code forks one executable "
+                        "per batch size (bucket-miss hazard; "
+                        "docs/performance.md 'Compile economy')",
+                        symbol=info.qualname)
+                    break
+
+
+def _is_static_dispatch(test):
+    """``is``/``is not``/``isinstance`` anywhere in a branch test marks
+    it as static-config dispatch (the RHS-factory idiom), exempt from
+    bucket-shape-branch."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in sub.ops):
+            return True
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                and sub.func.id == "isinstance"):
+            return True
+    return False
+
+
+def _is_batch_dim_read(ctx, node, tainted):
+    """``<tainted>.shape[0]`` — the batch-dim read whose *branching* use
+    the bucket-shape-branch rule flags (plain reads are the idiom the
+    sweep drivers are built from)."""
+    return (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "shape"
+            and _subscript_is_zero(node)
+            and _expr_tainted(ctx, node.value.value, tainted))
+
+
+def _subscript_is_zero(sub):
+    idx = sub.slice
+    return isinstance(idx, ast.Constant) and idx.value == 0
+
+
 @register("host-sync-call",
           "host-synchronizing call (.item()/float()/np.asarray/...) in "
           "device code")

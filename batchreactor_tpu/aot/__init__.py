@@ -1,0 +1,44 @@
+"""AOT program store: shape-bucketed compilation and warm-cache management.
+
+Compile cost is the largest tax on arbitrary sweep shapes (~150 s per
+program shape for BDF, ~400 s for SDIRK at GRI scale — PERF.md), and the
+persistent compilation cache only pays off when a *re-run hits the same
+shape*.  This package closes the loop with the discipline production
+inference stacks apply to ragged batch sizes:
+
+* **Shape buckets** (:mod:`.buckets`) — pad any lane count B up to a
+  canonical bucket (pow2 ladder by default) so every grid size reuses
+  one compiled executable per bucket; dead lanes are masked no-ops
+  stripped before results/telemetry/checkpoints, and live-lane results
+  are bit-exact vs the unpadded program (asserted in tests, not
+  assumed — lanes are independent under vmap).
+* **AOT registry + warmup** (:mod:`.registry`) — a cache key (mechanism
+  fingerprint x solver config x bucket x flag set) mapped to compiled
+  sweep executables; :func:`warmup` pre-compiles the canonical program
+  set through the real sweep drivers so the executables land in BOTH the
+  in-process jit dispatch cache and JAX's persistent on-disk cache
+  (managed dir + manifest with hit/miss/version accounting).  On-chip
+  windows then spend their SIGTERM budget measuring, not compiling —
+  ``scripts/warm_cache.py`` is the CLI.
+
+The ladder helpers import light (stdlib only); the registry pulls jax
+and the sweep drivers lazily via this module's ``__getattr__``, so
+``parallel/sweep.py`` can depend on :mod:`.buckets` without a cycle.
+"""
+
+from .buckets import POW2, bucket_ladder, normalize_buckets, resolve_bucket
+
+_REGISTRY_NAMES = ("warmup", "configure_cache", "reset_persistent_cache",
+                   "program_key", "mechanism_fingerprint", "load_manifest",
+                   "manifest_path", "WarmupResult")
+
+__all__ = ["POW2", "bucket_ladder", "normalize_buckets", "resolve_bucket",
+           *_REGISTRY_NAMES]
+
+
+def __getattr__(name):
+    if name in _REGISTRY_NAMES:
+        from . import registry
+
+        return getattr(registry, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,127 @@
+"""Shape buckets: the canonical lane-count ladder of the AOT program store.
+
+Every distinct lane count B is a distinct XLA program shape, and at GRI
+scale one program shape costs ~150 s (BDF) to ~400 s (SDIRK) to compile
+(PERF.md compile ledger).  Bucketing collapses the unbounded space of
+user sweep shapes onto a small canonical ladder: the sweep pads B up to
+the smallest bucket >= B, runs the dead lanes as masked no-ops that are
+stripped before results/telemetry/checkpoints, and any grid size reuses
+ONE compiled executable per bucket — the same shape-bucketing discipline
+production inference stacks use for ragged batch sizes.
+
+This module is deliberately import-light (stdlib only): it is pulled in
+by ``parallel/sweep.py`` at module scope and by brlint's tier-B audit,
+neither of which may pay a jax import for ladder arithmetic.
+
+The knob grammar (``buckets=`` on :func:`parallel.ensemble_solve`,
+:func:`parallel.ensemble_solve_segmented`, ``batch_reactor_sweep`` and
+the warmup specs):
+
+* ``None``  — bucketing off (legacy exact-shape programs; the default).
+* ``"pow2"`` — the power-of-two ladder: B pads to ``2**ceil(log2(B))``.
+* a sequence of ints — an explicit ladder, e.g. ``(64, 256, 1024,
+  4096)``; B pads to the smallest entry >= B and a B beyond the top
+  entry is a loud error (an explicit ladder is a *promise* about which
+  programs were warmed — silently exceeding it would fork the
+  executable set the ladder exists to bound).
+"""
+
+POW2 = "pow2"
+
+
+def normalize_buckets(buckets):
+    """Validate a ``buckets=`` knob into its canonical form.
+
+    Returns ``None`` (off), ``"pow2"``, or a strictly-increasing tuple of
+    positive ints.  Anything else raises ``ValueError`` — the one loud
+    validation point shared by ``api.py``, the sweep drivers, the
+    checkpoint fingerprint, and ``aot.warmup``, so the knob cannot drift
+    between entry points.
+    """
+    if buckets is None or buckets is False:
+        return None
+    if isinstance(buckets, str):
+        if buckets != POW2:
+            raise ValueError(
+                f"buckets must be None, 'pow2', or a sequence of "
+                f"positive ints; got {buckets!r}")
+        return POW2
+    if isinstance(buckets, (bool, int, float)):
+        raise ValueError(
+            f"buckets must be None, 'pow2', or a sequence of positive "
+            f"ints; got {buckets!r} (a single bucket is spelled "
+            f"buckets=({buckets},))")
+    try:
+        ladder = tuple(buckets)
+    except TypeError:
+        raise ValueError(
+            f"buckets must be None, 'pow2', or a sequence of positive "
+            f"ints; got {buckets!r}") from None
+    if not ladder:
+        raise ValueError("buckets sequence must be non-empty (use "
+                         "buckets=None to disable bucketing)")
+    for b in ladder:
+        if isinstance(b, bool) or not isinstance(b, int) or b < 1:
+            raise ValueError(
+                f"buckets entries must be positive ints; got {b!r} in "
+                f"{buckets!r}")
+    if list(ladder) != sorted(set(ladder)):
+        raise ValueError(
+            f"buckets must be strictly increasing with no duplicates; "
+            f"got {buckets!r}")
+    return ladder
+
+
+def resolve_bucket(B, buckets, *, mesh_size=1):
+    """The padded lane count for a sweep of ``B`` lanes.
+
+    ``buckets`` is a normalized knob (:func:`normalize_buckets` output or
+    raw — raw values are normalized here).  With ``buckets=None`` the
+    answer is ``B`` itself (no padding).  ``mesh_size > 1`` additionally
+    requires the chosen bucket to divide evenly over the device mesh —
+    an indivisible bucket is a loud error, because silently re-padding
+    it would run a program shape outside the canonical set.
+    """
+    B = int(B)
+    if B < 1:
+        raise ValueError(f"lane count must be >= 1, got {B}")
+    buckets = normalize_buckets(buckets)
+    if buckets is None:
+        return B
+    if buckets == POW2:
+        bucket = 1 << max(0, (B - 1).bit_length())
+        m = int(mesh_size)
+        if m > 1:
+            if m & (m - 1):
+                # doubling can never reach divisibility by an odd prime
+                # factor — fail loudly instead of looping forever
+                raise ValueError(
+                    f"buckets='pow2' cannot cover a {m}-device mesh "
+                    f"(powers of two never divide evenly over a "
+                    f"non-power-of-two mesh); use an explicit ladder of "
+                    f"multiples of {m}")
+            # a pow2 bucket below the mesh size cannot shard evenly; the
+            # smallest valid pow2 multiple of a pow2 mesh is the mesh
+            # itself
+            while bucket % m:
+                bucket *= 2
+    else:
+        bucket = next((b for b in buckets if b >= B), None)
+        if bucket is None:
+            raise ValueError(
+                f"lane count {B} exceeds the top bucket of the explicit "
+                f"ladder {buckets}; extend the ladder (warming the new "
+                f"program shape) or use buckets='pow2'")
+    if mesh_size > 1 and bucket % int(mesh_size):
+        raise ValueError(
+            f"bucket {bucket} (for B={B}) does not divide evenly over "
+            f"the {int(mesh_size)}-device mesh; choose a ladder whose "
+            f"entries are multiples of the mesh size")
+    return bucket
+
+
+def bucket_ladder(lanes, buckets):
+    """The deduplicated, sorted bucket set covering the given lane
+    counts — what :func:`aot.warmup` compiles and ``scripts/
+    warm_cache.py`` reports."""
+    return tuple(sorted({resolve_bucket(B, buckets) for B in lanes}))

@@ -1,0 +1,305 @@
+"""AOT registry: warm the canonical bucket programs before they're needed.
+
+The compile ledger (PERF.md) prices one GRI-scale program shape at
+~150 s (BDF) to ~400 s (SDIRK), and the chip-availability log shows
+those compiles repeatedly eating the SIGTERM-bounded on-chip windows.
+With shape bucketing (:mod:`.buckets`) the program set is small and
+*enumerable* — so compile it ahead of time:
+
+* :func:`warmup` takes specs describing the chip-session sweeps
+  (mechanism callables + solver config + the lane counts to cover),
+  resolves each to its bucket set, and executes ONE zero-span dummy
+  launch per canonical program **through the real sweep drivers**
+  (``t1 == t0`` — every lane terminates after a single step attempt, so
+  the run costs compile + epsilon).  That one launch populates both the
+  in-process jit dispatch cache (a same-process sweep at any B in the
+  bucket then compiles *and traces* nothing) and JAX's persistent
+  compilation cache (a later process — the on-chip measurement window —
+  deserializes the stored executable instead of compiling:
+  ``CompileWatch`` reports it as a cache hit, compiles stay 0).
+* :func:`configure_cache` manages the persistent cache directory: it
+  pins ``jax_compilation_cache_dir`` and drops the min-compile-time
+  threshold to zero so EVERY program of the session set persists, not
+  just the slow ones.
+* A JSON **manifest** rides in the cache dir
+  (:func:`manifest_path`) keyed by :func:`program_key` — mechanism
+  fingerprint x solver config x bucket x flag set — with per-entry
+  compile/hit/miss counters and jax/package version accounting, so
+  ``scripts/warm_cache.py`` can answer "is this cache warm for THIS
+  session, under THIS jax?" without compiling anything.
+
+Execution-over-``lower().compile()`` is deliberate: an AOT lowering
+compiles the same XLA executable (and persists it identically), but
+does NOT populate the jit dispatch cache, so the first real same-process
+sweep would still pay a trace plus a cache-deserialize.  The zero-span
+execution warms every layer at once and is the cheapest call that does.
+
+Everything jax-touching imports lazily so ``batchreactor_tpu.aot`` stays
+importable from host-only tooling (brlint tier A, the CLI's --list).
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+
+SCHEMA = "br-aot-manifest-v1"
+_MANIFEST = "br_aot_manifest.json"
+
+#: spec keys that are warmup bookkeeping, not sweep kwargs
+_SPEC_KEYS = ("rhs", "y0", "cfg", "lanes", "buckets")
+
+
+def reset_persistent_cache():
+    """Detach jax's latched persistent-cache handle so a cache-dir
+    config change takes effect mid-process — jax initializes the cache
+    at most once per process (``_initialize_cache``), so a dir
+    configured after any prior compile would silently never be used.
+    No-op when the private hook is unavailable (moved upstream):
+    behavior degrades to first-compile-wins.  The one shared spelling of
+    this dance — the test fixtures reuse it."""
+    try:
+        from jax._src.compilation_cache import reset_cache
+    except ImportError:
+        return
+    reset_cache()
+
+
+def configure_cache(cache_dir=None):
+    """Point JAX's persistent compilation cache at a managed directory.
+
+    ``cache_dir=None`` resolves from ``JAX_COMPILATION_CACHE_DIR`` (the
+    env lever bench.py already uses) and falls back to ``./.jax_cache``.
+    The min-compile-time threshold is dropped to zero so every program
+    of the warmed session set persists — the default (1 s) silently
+    skips fast-compiling programs, which then re-compile in the window
+    the warmup existed to protect.  Returns the resolved directory
+    (created if absent).  Idempotent; call before any compile you want
+    persisted.
+    """
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                   os.path.join(os.getcwd(), ".jax_cache"))
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    reset_persistent_cache()
+    return cache_dir
+
+
+def mechanism_fingerprint(*callables, extra=None):
+    """Content hash of the device callables a sweep program is built
+    from (rhs / jac / observer): code identity plus any mechanism
+    tensors captured in their closures — the same recipe the checkpoint
+    resume fingerprint trusts (``parallel/checkpoint._hash_callable``),
+    so two processes that parse the same mechanism files agree on the
+    key."""
+    from ..parallel.checkpoint import _hash_callable
+
+    h = hashlib.sha256()
+    for fn in callables:
+        if fn is None:
+            h.update(b"<none>")
+        else:
+            _hash_callable(h, fn)
+    if extra is not None:
+        h.update(repr(extra).encode())
+    return h.hexdigest()
+
+
+def program_key(mech_fp, method, bucket, flags):
+    """The registry/manifest key: ``{method}-b{bucket}-{digest12}`` over
+    mechanism fingerprint x solver-config flag set x bucket.  Human-
+    greppable prefix, content-addressed tail; the same (mechanism,
+    config, bucket) triple keys identically across processes."""
+    h = hashlib.sha256()
+    h.update(mech_fp.encode())
+    h.update(str(method).encode())
+    h.update(str(int(bucket)).encode())
+    for k in sorted(flags):
+        h.update(f"{k}={flags[k]!r}".encode())
+    return f"{method}-b{int(bucket)}-{h.hexdigest()[:12]}"
+
+
+def manifest_path(cache_dir):
+    return os.path.join(cache_dir, _MANIFEST)
+
+
+def load_manifest(cache_dir):
+    """The on-disk manifest dict (empty skeleton when absent/corrupt —
+    a damaged manifest must not block warming, which rewrites it)."""
+    try:
+        with open(manifest_path(cache_dir)) as f:
+            man = json.load(f)
+        if man.get("schema") == SCHEMA:
+            return man
+    except (OSError, ValueError):
+        pass
+    return {"schema": SCHEMA, "entries": {}}
+
+
+def _save_manifest(cache_dir, man):
+    tmp = manifest_path(cache_dir) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=1, sort_keys=True)
+    os.replace(tmp, manifest_path(cache_dir))
+
+
+@dataclasses.dataclass(frozen=True)
+class WarmupResult:
+    """Per-canonical-program outcome of one :func:`warmup` pass."""
+
+    key: str
+    bucket: int
+    compiles: int       # true XLA backend compiles this pass
+    compile_s: float
+    cache_hits: int     # programs served from the persistent cache
+    warm: bool          # nothing actually compiled (fully warm already);
+                        # never True when jax.monitoring is unavailable —
+                        # unobservable compiles must not read as warmth
+
+
+def _flag_set(kw):
+    """The JSON-able solver-config flag set that joins the program key:
+    every kwarg that shapes the traced program.  Callables key through
+    the mechanism fingerprint instead (their repr is address-noise)."""
+    flags = {}
+    for k in sorted(kw):
+        v = kw[k]
+        if callable(v):
+            continue
+        flags[k] = repr(v)
+    return flags
+
+
+def warmup(specs, *, cache_dir=None, configure=True, log=None):
+    """Pre-compile the canonical bucket programs for the given sweep
+    specs; returns a list of :class:`WarmupResult` (one per program).
+
+    Each spec is a dict:
+
+    * ``rhs`` — the sweep RHS callable (build it the same way the real
+      sweep will, e.g. ``ops.rhs.make_gas_rhs``; compile caches key on
+      program *content*, so identical construction => identical key);
+    * ``y0`` — one exemplar lane state, shape (S,);
+    * ``cfg`` — one exemplar per-lane condition dict (scalars; floats
+      promote to f64, matching the API's condition arrays);
+    * ``lanes`` — the lane counts the session will sweep (each resolves
+      to its bucket; duplicates collapse);
+    * ``buckets`` — the ladder (default ``"pow2"``;
+      :func:`~.buckets.normalize_buckets` grammar; an explicit ``None``
+      warms the exact lane-count shapes, for sessions that run with
+      bucketing off);
+    * every other key (``method``, ``rtol``, ``atol``, ``jac``,
+      ``observer``/``observer_init``, ``jac_window``, ``n_save``,
+      ``segment_steps``, ``max_attempts``, ``stats``, ...) passes
+      straight through to :func:`parallel.ensemble_solve_segmented`
+      (when ``segment_steps`` > 0) or :func:`parallel.ensemble_solve` —
+      the flag set MUST match the real run's, it is part of the key.
+
+    ``configure=True`` (default) routes compiles into the managed
+    persistent cache via :func:`configure_cache` first; the manifest in
+    that directory is updated with per-program compile counts, wall,
+    persistent-cache hit/miss tallies and jax/package versions.  ``log``
+    is an optional ``print``-like callable for progress lines.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .. import __version__ as _pkg_version
+    from ..obs.retrace import CompileWatch
+    from ..parallel.sweep import ensemble_solve, ensemble_solve_segmented
+    from .buckets import bucket_ladder, normalize_buckets
+
+    man = None
+    if configure:
+        cache_dir = configure_cache(cache_dir)
+        man = load_manifest(cache_dir)
+        man["jax"] = jax.__version__
+        man["package"] = _pkg_version
+    results = []
+    for spec in specs:
+        spec = dict(spec)
+        rhs = spec.pop("rhs")
+        y0 = jnp.asarray(spec.pop("y0"))
+        cfg = spec.pop("cfg")
+        lanes = spec.pop("lanes")
+        # absent key defaults to the pow2 ladder; an EXPLICIT None is the
+        # valid bucketing-off spelling (warm the exact lane-count shapes
+        # the session will run — coercing it to pow2 would warm the
+        # wrong program set)
+        buckets = normalize_buckets(spec.pop("buckets", "pow2"))
+        kw = spec  # remaining keys are sweep kwargs == the flag set
+        method = kw.get("method", "bdf")
+        seg = int(kw.get("segment_steps", 0) or 0)
+        mech_fp = mechanism_fingerprint(
+            rhs, kw.get("jac"), kw.get("observer"),
+            extra=jax.tree_util.tree_map(repr, kw.get("observer_init")))
+        for bucket in bucket_ladder(lanes, buckets):
+            flags = _flag_set(kw)
+            key = program_key(mech_fp, method, bucket, flags)
+            y0s = jnp.broadcast_to(y0, (bucket,) + y0.shape)
+            cfgs = {
+                k: jnp.broadcast_to(
+                    jnp.asarray(v, dtype=jnp.float64
+                                if jnp.asarray(v).dtype.kind == "f"
+                                else None), (bucket,))
+                for k, v in cfg.items()}
+            watch = CompileWatch(default_label=key)
+            t0 = time.perf_counter()
+            # zero-span execution (t1 == t0): one step attempt per lane,
+            # traced and compiled as THE canonical bucket program —
+            # t0/t1 are traced operands, so the real horizon reuses it
+            with watch, watch.region(key, program_key=f"b{bucket}"):
+                run_kw = dict(kw)
+                run_kw.pop("segment_steps", None)
+                if seg > 0:
+                    res = ensemble_solve_segmented(
+                        rhs, y0s, 0.0, 0.0, cfgs, segment_steps=seg,
+                        buckets=buckets, **run_kw)
+                else:
+                    res = ensemble_solve(rhs, y0s, 0.0, 0.0, cfgs,
+                                         buckets=buckets, **run_kw)
+                jax.block_until_ready(res.y)
+            wall = time.perf_counter() - t0
+            s = watch.summary()
+            # without jax.monitoring every counter is an unobservable 0:
+            # a cold cache must not read as warm (the operator would skip
+            # the warming this pass existed to do)
+            r = WarmupResult(
+                key=key, bucket=bucket, compiles=s["compiles"],
+                compile_s=round(s["compile_s"], 3),
+                cache_hits=s["cache_hits"],
+                warm=bool(s["available"] and s["compiles"] == 0))
+            results.append(r)
+            if log is not None:
+                state = ("warm (persistent-cache hit)" if r.warm
+                         else f"compiled in {r.compile_s:.1f}s"
+                         if s["available"]
+                         else "unknown (no jax.monitoring — compile "
+                              "accounting unavailable)")
+                log(f"[warmup] {key}: {state} "
+                    f"(wall {wall:.1f}s, {r.cache_hits} hits)")
+            if man is not None:
+                e = man["entries"].setdefault(
+                    key, {"bucket": bucket, "method": method,
+                          "flags": flags,
+                          "created": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                          "warmups": 0, "compiles": 0, "compile_s": 0.0,
+                          "cache_hits": 0, "cache_misses": 0})
+                e["warmups"] += 1
+                e["compiles"] += s["compiles"]
+                e["compile_s"] = round(e["compile_s"] + s["compile_s"], 3)
+                e["cache_hits"] += s["cache_hits"]
+                e["cache_misses"] += s["cache_misses"]
+                e["jax"] = jax.__version__
+                e["package"] = _pkg_version
+                e["last_warmed"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    if man is not None:
+        _save_manifest(cache_dir, man)
+    return results

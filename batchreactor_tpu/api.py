@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .aot.buckets import normalize_buckets, resolve_bucket
 from .io.config import input_data, parse_composition_text
 from .io.writers import trim_trajectory, write_profiles
 from .ops.rhs import (make_gas_jac, make_gas_rhs, make_surface_jac,
@@ -774,7 +775,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         asv_quirk=True, ignition_marker=None,
                         ignition_mode="half", method="bdf", jac_window=None,
                         analytic_jac=True, telemetry=False, pipeline=None,
-                        poll_every=None):
+                        poll_every=None, buckets=None):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -829,6 +830,21 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     polls the status vector every ``poll_every`` segments — bit-exact
     vs ``pipeline=False`` (the per-segment blocking host loop; see
     docs/performance.md "Pipelined execution").
+
+    ``buckets`` turns on the AOT program store's shape bucketing
+    (docs/performance.md "Compile economy"): ``"pow2"`` pads the lane
+    count B up to the next power of two, an explicit ladder like
+    ``(64, 256, 1024, 4096)`` pads to its smallest entry >= B (B beyond
+    the top entry raises — the ladder is a promise about which programs
+    were warmed).  Any grid size then reuses ONE compiled executable
+    per bucket — at GRI scale each distinct sweep shape otherwise costs
+    ~150 s (BDF) to ~400 s (SDIRK) of compile, PERF.md — and the dead
+    pad lanes are stripped before ``x``/``tau``/``report``/telemetry,
+    with live-lane results bit-exact vs the unpadded program
+    (regression-asserted).  Pre-compile the ladder ahead of a chip
+    session with ``scripts/warm_cache.py`` (:mod:`batchreactor_tpu.aot`).
+    The knob is validated here, up front; the resolved bucket lands in
+    the telemetry meta as ``bucket``.
     """
     from .parallel import (ensemble_solve, ensemble_solve_segmented,
                            sweep_report)
@@ -847,6 +863,10 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         raise ValueError(
             "pipeline/poll_every are segmented-path knobs; set "
             "segment_steps > 0 or drop the arguments")
+    # canonicalize the bucket ladder up front (loud ValueError on a bad
+    # spec — aot/buckets.py is the one validation point), before any
+    # mechanism parsing happens
+    buckets = normalize_buckets(buckets)
     if chem.userchem and (chem.gaschem or chem.surfchem):
         # the reference's du assembly is an exclusive 4-way branch
         # (/root/reference/src/BatchReactor.jl:362-373): user mode never
@@ -955,6 +975,13 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         # pad the batch to the mesh device count with copies of the last
         # lane (even shards are a sharding requirement); sliced off below
         y0s, cfgs, B = pad_to_mesh(y0s, cfgs, mesh)
+    # resolve the canonical bucket NOW (not inside the sweep): an
+    # explicit ladder that cannot cover this lane count must fail before
+    # any compile is attempted, and the telemetry meta records the shape
+    # the device actually ran
+    bucket = resolve_bucket(
+        int(y0s.shape[0]), buckets,
+        mesh_size=mesh.devices.size if mesh is not None else 1)
 
     # resolve accelerator-vs-CPU defaults from the devices the sweep
     # actually runs on: a CPU-device mesh on a TPU-attached host must keep
@@ -987,7 +1014,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     watch = CompileWatch(recorder=rec, default_label="sweep")
     common = dict(mesh=mesh, rtol=rtol, atol=atol, jac=jac,
                   observer=observer, observer_init=obs0, method=method,
-                  jac_window=jac_window, stats=telemetry)
+                  jac_window=jac_window, stats=telemetry, buckets=buckets)
     with (watch if telemetry else contextlib.nullcontext()), \
             (rec.span("solve", lanes=B)
              if telemetry else contextlib.nullcontext()):
@@ -1024,7 +1051,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         out["telemetry"] = build_report(
             recorder=rec, solver_stats=res.stats, watch=watch,
             meta={"entry": "batch_reactor_sweep", "mode": mode,
-                  "method": method, "lanes": B,
+                  "method": method, "lanes": B, "bucket": bucket,
                   "segmented": bool(segment_steps > 0)})
     return out
 

@@ -163,4 +163,11 @@ def to_prometheus(report):
                 "XLA backend compile seconds per program label.",
                 [({"label": k}, v["compile_s"])
                  for k, v in sorted((comp.get("by_label") or {}).items())])
+        _metric(lines, "br_compile_cache_total", "counter",
+                "Persistent compilation-cache lookups per program label "
+                "by result (the AOT warm-cache evidence surface).",
+                [({"label": k, "result": res}, v.get(key, 0))
+                 for k, v in sorted((comp.get("by_label") or {}).items())
+                 for res, key in (("hit", "cache_hits"),
+                                  ("miss", "cache_misses"))])
     return "\n".join(lines) + ("\n" if lines else "")

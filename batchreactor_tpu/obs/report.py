@@ -144,13 +144,20 @@ def render(report):
         if not comp.get("available", True):
             lines.append("compile: unavailable (no jax.monitoring)")
         else:
+            cache = ""
+            if "cache_hits" in comp:
+                cache = (f", cache {comp['cache_hits']} hits / "
+                         f"{comp.get('cache_misses', 0)} misses")
             lines.append(f"compile: {comp['compiles']} compiles "
                          f"({comp['compile_s']:.2f}s), {comp['traces']} "
-                         f"traces, {comp['retraces']} retraces")
+                         f"traces, {comp['retraces']} retraces{cache}")
             for label, v in sorted((comp.get("by_label") or {}).items()):
+                progs = v.get("programs") or {}
+                extra = (f" programs={len(progs)}" if len(progs) > 1
+                         else "")
                 lines.append(f"  {label}: compiles={v['compiles']} "
                              f"traces={v['traces']} "
-                             f"retraces={v['retraces']}")
+                             f"retraces={v['retraces']}{extra}")
 
     events = report.get("events") or []
     if events:
@@ -209,9 +216,30 @@ def diff(a, b):
         if va != vb:
             lines.append(f"  solver {k}: {va} -> {vb}")
     ca, cb = a.get("compile") or {}, b.get("compile") or {}
-    for k in ("compiles", "retraces"):
-        if ca.get(k) != cb.get(k):
-            lines.append(f"  compile {k}: {ca.get(k)} -> {cb.get(k)}")
+    for k in ("compiles", "retraces", "cache_hits", "cache_misses"):
+        # cache_* keys are absent from pre-AOT archived reports: a
+        # missing counter is 0, not a difference
+        va, vb = ca.get(k) or 0, cb.get(k) or 0
+        if va != vb:
+            lines.append(f"  compile {k}: {va} -> {vb}")
+    # per-label compile counts: the AOT program store's zero-recompile
+    # evidence is the ARMED sweep label going to zero ("compile
+    # [sweep-segment] compiles: N -> 0"), distinct from sub-ms host
+    # eager-op compiles that ride the totals
+    bla, blb = (ca.get("by_label") or {}), (cb.get("by_label") or {})
+    for label in sorted(set(bla) | set(blb)):
+        va = (bla.get(label) or {}).get("compiles", 0)
+        vb = (blb.get(label) or {}).get("compiles", 0)
+        if va != vb:
+            lines.append(f"  compile [{label}] compiles: {va} -> {vb}")
+    # compile wall is the AOT program store's headline evidence
+    # ("compiles: N -> 0" above, seconds saved here); float-compare with
+    # a render threshold so ~us jitter doesn't read as a diff
+    va, vb = ca.get("compile_s"), cb.get("compile_s")
+    if (va is None) != (vb is None) or (
+            va is not None and abs(va - vb) >= 5e-4):
+        lines.append(f"  compile compile_s: {_fmt_ctr(va)} -> "
+                     f"{_fmt_ctr(vb)}")
     if len(lines) == 1:
         lines.append("  (no differences in spans / counters / solver "
                      "stats / compiles)")

@@ -12,14 +12,28 @@ and was any compile unexpected?".
 
 A **retrace** is counted when a *single-program* label (a region entered
 with ``single_program=True`` — one jitted callable relaunched many
-times) sees more than one compile inside a watch window: the program was
-rebuilt for inputs the first build should have covered — exactly the
-hazard class brlint's BR003/BR004 rules flag statically.  Plain labels
-only count (a cold ``batch_reactor`` legitimately compiles several
-distinct helper programs under its one ``solve`` label).  The segmented
-sweep driver marks its per-segment launches single-program, so any
-compile after the first segment surfaces as a retrace event on the wired
-Recorder.
+times) sees more than one compile for the same *program key* inside a
+watch window: the program was rebuilt for inputs the first build should
+have covered — exactly the hazard class brlint's BR003/BR004 rules flag
+statically.  ``region(..., program_key=...)`` declares the expected
+program-shape axis: the bucketed sweep drivers key their segment regions
+on the padded lane count, so a *bucket change* under one label is an
+expected first compile of a new canonical program, while a second
+compile inside one bucket still flags.  Plain labels only count (a cold
+``batch_reactor`` legitimately compiles several distinct helper programs
+under its one ``solve`` label).  The segmented sweep driver marks its
+per-segment launches single-program, so any compile after the first
+segment of a bucket surfaces as a retrace event on the wired Recorder.
+
+**Persistent-cache accounting** (the AOT program store's evidence
+surface, ``aot/``): when JAX's persistent compilation cache serves a
+program, the runtime emits a cache-hit event and then a cheap
+``backend_compile`` duration for the *deserialization* of the stored
+executable.  The watch classifies that load under ``cache_hits`` /
+``cache_load_s`` instead of ``compiles``, so ``compiles`` counts TRUE
+XLA compiles only — a warmed chip session reports ``compiles: 0`` (the
+``obs_report.py --diff`` zero-recompile evidence format) rather than N
+near-zero-cost phantom compiles.
 
 ``jax.monitoring`` listeners are process-global and not individually
 removable, so ONE dispatching listener pair is installed lazily on first
@@ -94,14 +108,18 @@ class CompileWatch:
     # ---- label regions ----------------------------------------------------
     def _label(self):
         stack = getattr(self._tls, "labels", None)
-        return stack[-1] if stack else (self.default_label, False)
+        return stack[-1] if stack else (self.default_label, False, None)
 
-    def region(self, label, single_program=False):
+    def region(self, label, single_program=False, program_key=None):
         """Context manager: attribute compile events on this thread to
         ``label`` while active (nests; innermost wins).
         ``single_program=True`` declares that the region relaunches ONE
         jitted program, arming retrace detection for the label: every
-        compile past the label's first is then flagged."""
+        compile past the first is then flagged.  ``program_key`` (any
+        hashable, e.g. the bucketed sweep's padded lane count) scopes
+        that promise per canonical program shape — the first compile of
+        each distinct key is expected, so a bucket change never flags,
+        while a second compile *within* a key still does."""
         watch = self
 
         class _Region:
@@ -109,7 +127,7 @@ class CompileWatch:
                 stack = getattr(watch._tls, "labels", None)
                 if stack is None:
                     stack = watch._tls.labels = []
-                stack.append((label, single_program))
+                stack.append((label, single_program, program_key))
                 return self
 
             def __exit__(self, *exc):
@@ -135,12 +153,13 @@ class CompileWatch:
 
     # ---- listener callbacks (any thread) ----------------------------------
     def _entry(self):
-        label, single = self._label()
+        label, single, _pk = self._label()
         with self._lock:
             e = self.by_label.setdefault(
                 label, {"traces": 0, "compiles": 0, "compile_s": 0.0,
-                        "cache_hits": 0, "cache_misses": 0, "retraces": 0,
-                        "single_program": single})
+                        "cache_hits": 0, "cache_misses": 0,
+                        "cache_load_s": 0.0, "retraces": 0,
+                        "single_program": single, "programs": {}})
             # any region arming the label keeps it armed (a label is
             # single-program by declaration, not by majority vote)
             e["single_program"] = e["single_program"] or single
@@ -151,10 +170,16 @@ class CompileWatch:
             e = self._entry()
             with self._lock:
                 e["cache_hits"] += 1
+            # the runtime follows a persistent-cache hit with a cheap
+            # backend_compile duration for deserializing the stored
+            # executable (same thread, same dispatch); flag it so that
+            # load is not miscounted as a true compile
+            self._tls.pending_hit = True
         elif event == CACHE_MISS_EVENT:
             e = self._entry()
             with self._lock:
                 e["cache_misses"] += 1
+            self._tls.pending_hit = False
 
     def _on_duration(self, event, duration):
         if event == TRACE_EVENT:
@@ -162,30 +187,55 @@ class CompileWatch:
             with self._lock:
                 e["traces"] += 1
         elif event == BACKEND_COMPILE_EVENT:
+            label, _single, pkey = self._label()
             e = self._entry()
+            hit = getattr(self._tls, "pending_hit", False)
+            if hit:
+                self._tls.pending_hit = False
             with self._lock:
-                e["compiles"] += 1
-                e["compile_s"] += float(duration)
-                retrace = e["single_program"] and e["compiles"] > 1
+                if hit:
+                    e["cache_load_s"] += float(duration)
+                else:
+                    e["compiles"] += 1
+                    e["compile_s"] += float(duration)
+                # EVERY build of the program — true compile or
+                # persistent-cache load — registers under its program
+                # key: a rebuild past the first is a retrace regardless
+                # of how it was served (a cache-served first build that
+                # masked later rebuilds would disable retrace detection
+                # in exactly the warmed sessions the AOT store promotes).
+                # Program keys stringify so summaries stay JSON-able.
+                pk = "" if pkey is None else str(pkey)
+                n = e["programs"].get(pk, 0) + 1
+                e["programs"][pk] = n
+                retrace = e["single_program"] and n > 1
                 if retrace:
                     e["retraces"] += 1
             if retrace and self.recorder is not None:
                 self.recorder.event(
-                    "retrace", label=self._label()[0],
+                    "retrace", label=label, program=pk,
                     compiles=e["compiles"], duration_s=float(duration))
 
     # ---- views ------------------------------------------------------------
     def summary(self):
         """``{"available", "compiles", "traces", "retraces", "compile_s",
-        "by_label"}`` totals over the watch window."""
+        "cache_hits", "cache_misses", "by_label"}`` totals over the watch
+        window.  ``compiles`` counts true XLA backend compiles only;
+        executables served from the persistent compilation cache count
+        under ``cache_hits`` (their deserialization wall under the
+        per-label ``cache_load_s``)."""
         with self._lock:
-            by_label = {k: dict(v) for k, v in self.by_label.items()}
+            by_label = {k: {**v, "programs": dict(v["programs"])}
+                        for k, v in self.by_label.items()}
         return {
             "available": bool(self.available),
             "compiles": sum(v["compiles"] for v in by_label.values()),
             "traces": sum(v["traces"] for v in by_label.values()),
             "retraces": sum(v["retraces"] for v in by_label.values()),
             "compile_s": sum(v["compile_s"] for v in by_label.values()),
+            "cache_hits": sum(v["cache_hits"] for v in by_label.values()),
+            "cache_misses": sum(v["cache_misses"]
+                                for v in by_label.values()),
             "by_label": by_label,
         }
 

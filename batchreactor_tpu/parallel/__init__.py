@@ -9,6 +9,7 @@ from .sweep import (
     ignition_observer,
     make_mesh,
     pad_batch,
+    pad_to_bucket,
     sweep_report,
     temperature_sweep,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "make_mesh",
     "multihost",
     "pad_batch",
+    "pad_to_bucket",
     "premixed_mole_fracs",
     "save_result",
     "sweep_report",

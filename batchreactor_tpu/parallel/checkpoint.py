@@ -228,6 +228,15 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     worker (each chunk's drain completes before the chunk's save is
     queued, because the drain joins inside ``ensemble_solve_segmented``).
 
+    ``buckets`` in ``solve_kw`` (docs/performance.md "Compile economy")
+    bucket-pads every chunk — including the ragged tail chunk, the
+    classic one-off-shape recompile — onto the canonical program ladder;
+    dead lanes are stripped before the chunk's ``.npz`` is written, so
+    checkpoint artifacts and multistep resume are byte-identical to an
+    unbucketed run's.  The bucket choice joins the resume fingerprint
+    (see the normalization above); resuming under a different ladder
+    fails loudly.
+
     ``recorder`` (an ``obs.Recorder``) collects the per-chunk telemetry —
     ``chunk_solve`` spans (with lane counts and attempt stats as
     attributes), ``chunk_save`` spans from the background writer thread,
@@ -252,6 +261,24 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
             raise ValueError(
                 f"{'/'.join(explicit)} are segmented-path knobs; set "
                 f"segment_steps > 0 or drop the arguments")
+    if "buckets" in solve_kw:
+        # canonicalize up front so the fingerprint below hashes ONE
+        # spelling per ladder ([64,256] == (64,256)) and a bad knob fails
+        # before any chunk work; buckets=None (the library default,
+        # bucketing off) is dropped so it fingerprints identically to a
+        # pre-bucketing checkpoint dir — those remain resumable.  A
+        # NON-None bucket choice deliberately joins the resume
+        # fingerprint via the generic kwarg hash: unlike the execution
+        # gears (results-neutral, exempted above) the ladder defines the
+        # canonical program set the sweep's chunks compile against, and
+        # a silent resume under a different ladder would reintroduce
+        # exactly the per-shape compiles the warmed run was sized to
+        # avoid — fail loudly, like any other changed solver setting.
+        from ..aot.buckets import normalize_buckets
+
+        solve_kw["buckets"] = normalize_buckets(solve_kw["buckets"])
+        if solve_kw["buckets"] is None:
+            del solve_kw["buckets"]
     rec = recorder if recorder is not None else Recorder()
     if chunk_log is not None:
         # the writer thread emits its completion line concurrently with

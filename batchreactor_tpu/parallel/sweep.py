@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..aot.buckets import resolve_bucket
 from ..obs import counters as obs_counters
 from ..obs.recorder import span_or_null
 from ..obs.retrace import CompileWatch
@@ -92,17 +93,48 @@ def pad_batch(batch_size, mesh):
     return ((batch_size + n - 1) // n) * n
 
 
+def _pad_lanes(y0s, cfgs, n_pad):
+    """Append ``n_pad`` dead lanes: copies of the last live lane, so the
+    padded program's extra lanes are wall-clock no-ops (vmap already runs
+    every lane until the slowest live lane finishes, and a copy finishes
+    exactly when its source does).  Dead lanes never exchange data with
+    live lanes (vmap independence), and the caller strips them with
+    :func:`unpad_result` before results/telemetry/checkpoints.
+    Live-lane bit-exactness vs the unpadded program is regression-
+    ASSERTED (tests/test_aot.py) on the linear-ODE matrix; for real
+    mechanism kernels XLA's batch-size-dependent vectorization leaves a
+    <=2 ulp spread on y (measured 8e-16 relative on h2o2/CPU — the same
+    order as the documented lane-position sensitivity, checkpoint.py),
+    with step counts, times, and statuses identical."""
+    if not n_pad:
+        return y0s, cfgs
+    y0s = jnp.concatenate([y0s, jnp.repeat(y0s[-1:], n_pad, axis=0)])
+    cfgs = jax.tree.map(
+        lambda v: jnp.concatenate([v, jnp.repeat(v[-1:], n_pad, axis=0)]),
+        cfgs)
+    return y0s, cfgs
+
+
 def pad_to_mesh(y0s, cfgs, mesh):
     """Pad the batch axis to the mesh device count with copies of the last
     lane.  Returns (y0s, cfgs, original_B); slice results back with
     :func:`unpad_result`."""
     B = y0s.shape[0]
-    pad = pad_batch(B, mesh) - B
-    if pad:
-        y0s = jnp.concatenate([y0s, jnp.repeat(y0s[-1:], pad, axis=0)])
-        cfgs = jax.tree.map(
-            lambda v: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)]),
-            cfgs)
+    y0s, cfgs = _pad_lanes(y0s, cfgs, pad_batch(B, mesh) - B)
+    return y0s, cfgs, B
+
+
+def pad_to_bucket(y0s, cfgs, bucket):
+    """Pad the batch axis up to a canonical ``bucket`` lane count
+    (:mod:`batchreactor_tpu.aot.buckets`) with dead copy-lanes.  Returns
+    (y0s, cfgs, original_B); slice results back with
+    :func:`unpad_result`.  This is what makes any sweep shape run one of
+    a small pre-compilable set of programs — the AOT store's shape
+    normalization."""
+    B = y0s.shape[0]
+    if bucket < B:
+        raise ValueError(f"bucket {bucket} < lane count {B}")
+    y0s, cfgs = _pad_lanes(y0s, cfgs, bucket - B)
     return y0s, cfgs, B
 
 
@@ -120,7 +152,7 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
                    observer=None, observer_init=None, jac_window=1,
                    newton_tol=0.03, method="bdf", freeze_precond=False,
-                   stats=False):
+                   stats=False, buckets=None):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
@@ -138,11 +170,25 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     (``SolveResult.stats``, key semantics ``obs/counters.py``) — under
     vmap every counter is per lane, so the sweep's step/Newton/rejection
     histograms come back batched for free.
+
+    ``buckets`` (default off) pads B up to a canonical bucket lane count
+    (``"pow2"`` ladder or an explicit one — :mod:`batchreactor_tpu.aot`)
+    so ANY grid size reuses one compiled executable per bucket instead
+    of compiling per exact shape; the dead pad lanes are copies of the
+    last lane, stripped from the returned SolveResult (incl. per-lane
+    ``stats``/``observed`` arrays), and live-lane results are bit-exact
+    vs the unpadded program (regression-asserted).
     """
     _check_method(method, newton_tol)
     if freeze_precond and method != "bdf":
         raise ValueError(
             f"freeze_precond is a bdf-only knob; method={method!r}")
+    y0s = jnp.asarray(y0s)
+    B_live = y0s.shape[0]
+    bucket = resolve_bucket(
+        B_live, buckets,
+        mesh_size=mesh.devices.size if mesh is not None else 1)
+    y0s, cfgs, _ = pad_to_bucket(y0s, cfgs, bucket)
     jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
                             dt_min_factor, linsolve, jac, observer,
                             jac_window, newton_tol, method, freeze_precond,
@@ -152,14 +198,14 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     obs0 = observer_init if observer is not None else 0.0
 
     if mesh is None:
-        return jitted(y0s, t0, t1, cfgs, obs0)
+        return unpad_result(jitted(y0s, t0, t1, cfgs, obs0), B_live)
 
     spec = NamedSharding(mesh, P(axis))
     y0s = jax.device_put(y0s, spec)
     cfgs = jax.tree.map(lambda x: jax.device_put(x, spec), cfgs)
     # outputs inherit the batch sharding; XLA inserts no collectives because
     # lanes never exchange data
-    return jitted(y0s, t0, t1, cfgs, obs0)
+    return unpad_result(jitted(y0s, t0, t1, cfgs, obs0), B_live)
 
 
 def _check_method(method, newton_tol):
@@ -284,7 +330,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              n_save=0, rhs_bundle=None, jac_window=1,
                              newton_tol=0.03, method="bdf", stats=False,
                              recorder=None, watch=None, pipeline=None,
-                             poll_every=None):
+                             poll_every=None, buckets=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -359,6 +405,19 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     all-parked trailing segments that are no-ops for every carried
     value (regression-tested across methods, budgets, and trajectory
     modes; docs/performance.md "Pipelined execution").
+
+    ``buckets`` (default off) pads B up to a canonical bucket lane
+    count before the carry is built, exactly like :func:`ensemble_solve`
+    — every segment of every sweep in a bucket then relaunches ONE
+    compiled program, the AOT program store's zero-recompile contract
+    (docs/performance.md "Compile economy").  Dead pad lanes are copies
+    of the last lane (they terminate with their source, so termination
+    detection and segment counts are unchanged) and are stripped from
+    the returned SolveResult; ``progress`` payloads report the PADDED
+    lane count, since that is the shape the device actually runs.  The
+    segment compile label keys on the padded lane count, so a bucket
+    change is an expected compile while any second compile inside a
+    bucket still flags as a retrace.
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
@@ -366,6 +425,11 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     if poll_every < 1:
         raise ValueError(f"poll_every must be >= 1, got {poll_every}")
     y0s = jnp.asarray(y0s)
+    B_live = y0s.shape[0]
+    bucket = resolve_bucket(
+        B_live, buckets,
+        mesh_size=mesh.devices.size if mesh is not None else 1)
+    y0s, cfgs, _ = pad_to_bucket(y0s, cfgs, bucket)
     B = y0s.shape[0]
     # a segment can accept at most segment_steps rows, so this buffer never
     # drops a row the host still has capacity for
@@ -391,7 +455,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     if pipeline:
         with (own_watch if own_watch is not None
               else contextlib.nullcontext()):
-            return _run_segmented_pipelined(
+            return unpad_result(_run_segmented_pipelined(
                 rhs, y0s, t1, cfgs, carry, bundle_arg,
                 segment_steps=segment_steps, max_segments=max_segments,
                 max_attempts=max_attempts, poll_every=poll_every,
@@ -402,7 +466,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 n_save=n_save, seg_save=seg_save,
                 bundle_mode=rhs_bundle is not None, jac_window=jac_window,
                 newton_tol=newton_tol, method=method, stats=stats,
-                recorder=recorder, watch=watch, progress=progress)
+                recorder=recorder, watch=watch, progress=progress), B_live)
 
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
                                       dt_min_factor, linsolve,
@@ -426,7 +490,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     # as retraces.
     with (own_watch if own_watch is not None else contextlib.nullcontext()):
         for seg in range(max_segments):
-            region = (watch.region("sweep-segment", single_program=True)
+            region = (watch.region("sweep-segment", single_program=True,
+                                   program_key=f"b{B}")
                       if watch is not None else contextlib.nullcontext())
             with span_or_null(recorder, "segment", index=seg), region:
                 res = jitted(bundle_arg, y, t, t1, cfgs, h, e, obs, sstate)
@@ -522,14 +587,15 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         n_saved_out = jnp.asarray(saved)
     else:
         ts_out, ys_out, n_saved_out = res.ts, res.ys, res.n_saved
-    return sdirk.SolveResult(
+    return unpad_result(sdirk.SolveResult(
         t=jnp.asarray(final_t, dtype=y0s.dtype), y=y,
         status=jnp.asarray(final_status),
         n_accepted=jnp.asarray(n_acc), n_rejected=jnp.asarray(n_rej),
         ts=ts_out, ys=ys_out, n_saved=n_saved_out, h=h,
         observed=obs if observer is not None else None,
         stats=(None if stats_acc is None
-               else {k: jnp.asarray(v) for k, v in stats_acc.items()}))
+               else {k: jnp.asarray(v) for k, v in stats_acc.items()})),
+        B_live)
 
 
 def _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
@@ -600,10 +666,18 @@ def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
     h = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: heuristic first step
     e = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: fresh PI controller
     if observer is not None:
-        obs = jax.tree.map(
-            lambda x: jnp.broadcast_to(jnp.asarray(x),
-                                       (B,) + jnp.shape(jnp.asarray(x))),
-            observer_init)
+        def _strong(x):
+            # strip weak typing (a python-float init like the ignition
+            # observer's jnp.nan fields stays weak through broadcast):
+            # the solver returns STRONGLY-typed observer arrays, so a
+            # weak-typed init would silently recompile the whole segment
+            # program at its second launch (weak -> strong carry) — at
+            # GRI scale that is a duplicated multi-minute compile per
+            # sweep, and it flags as a retrace under CompileWatch
+            a = jnp.asarray(x)
+            return jnp.broadcast_to(a.astype(a.dtype), (B,) + a.shape)
+
+        obs = jax.tree.map(_strong, observer_init)
     else:
         obs = jnp.zeros((B,))
     if method == "bdf":
@@ -939,7 +1013,8 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
     status_np = acc_np = None
     try:
         for seg in range(max_segments):
-            region = (watch.region("sweep-segment", single_program=True)
+            region = (watch.region("sweep-segment", single_program=True,
+                                   program_key=f"b{B}")
                       if watch is not None else contextlib.nullcontext())
             with span_or_null(recorder, "segment", index=seg), region:
                 # enqueue-only: the donated carry aliases the previous

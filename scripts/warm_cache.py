@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Pre-bake the chip-session sweep programs into the persistent cache.
+
+Compile cost is the dominant tax on fresh sweep shapes (~150 s per
+program shape for BDF, ~400 s for SDIRK at GRI scale — PERF.md), and
+on-chip windows are SIGTERM-bounded: a window that compiles is a window
+that doesn't measure.  This CLI resolves the lane counts you intend to
+sweep onto their canonical buckets (batchreactor_tpu/aot), compiles ONE
+program per bucket through the real sweep drivers, and persists the
+executables in JAX's compilation cache with an on-disk manifest — so the
+session's sweeps (at ANY lane count inside the warmed buckets) start
+solving immediately.  Run it on the same platform the session will use:
+executables are backend-specific.
+
+  # warm the pow2 buckets covering 48..512 lanes of a GRI ignition sweep
+  python scripts/warm_cache.py --mech tests/fixtures/grimech.dat \\
+      --therm tests/fixtures/therm.dat --comp CH4=0.25,O2=0.5,N2=0.25 \\
+      --T 1500 --lanes 48,200,512 --segment-steps 256 --ignition-marker CH4
+
+  # inspect the manifest (no compiles, no device)
+  python scripts/warm_cache.py --cache-dir .jax_cache --list
+
+Programs key on mechanism fingerprint x solver config x bucket x flag
+set; the warmed flag set must MATCH the session's sweep call (method,
+tolerances, jac_window, segment_steps, telemetry/stats, ignition
+observer) — this CLI mirrors ``batch_reactor_sweep``'s construction
+path exactly, so matching the CLI flags to the sweep kwargs suffices.
+Non-gas chemistry modes warm through the ``batchreactor_tpu.aot.warmup``
+API directly.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _parse_comp(text):
+    comp = {}
+    for part in text.split(","):
+        name, _, val = part.partition("=")
+        comp[name.strip()] = float(val)
+    return comp
+
+
+def list_manifest(cache_dir):
+    """Render the manifest without touching jax or a device."""
+    from batchreactor_tpu.aot import load_manifest, manifest_path
+
+    man = load_manifest(cache_dir)
+    entries = man.get("entries", {})
+    print(f"manifest {manifest_path(cache_dir)} "
+          f"(jax {man.get('jax', '?')}, package {man.get('package', '?')}):"
+          f" {len(entries)} programs")
+    cur_jax = man.get("jax")
+    stale = 0
+    for key in sorted(entries):
+        e = entries[key]
+        tag = ""
+        if cur_jax is not None and e.get("jax") != cur_jax:
+            tag = f"  [STALE: warmed under jax {e.get('jax')}]"
+            stale += 1
+        print(f"  {key}: bucket={e['bucket']} warmups={e['warmups']} "
+              f"compiles={e['compiles']} ({e['compile_s']:.1f}s) "
+              f"hits={e['cache_hits']} misses={e['cache_misses']} "
+              f"last={e.get('last_warmed', '?')}{tag}")
+    if stale:
+        print(f"  {stale} stale entr{'y' if stale == 1 else 'ies'} — "
+              f"re-run warmup under the current jax")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pre-compile canonical bucketed sweep programs into "
+                    "the persistent compilation cache")
+    ap.add_argument("--mech", help="CHEMKIN gas mechanism file")
+    ap.add_argument("--therm", help="NASA-7 thermo database")
+    ap.add_argument("--comp", default="CH4=0.25,O2=0.5,N2=0.25",
+                    help="inlet mole fractions, SP=x comma-separated")
+    ap.add_argument("--T", type=float, default=1500.0,
+                    help="exemplar temperature [K] (only shapes matter)")
+    ap.add_argument("--p", type=float, default=1e5, help="pressure [Pa]")
+    ap.add_argument("--lanes", default="64,128,256,512",
+                    help="lane counts the session will sweep")
+    ap.add_argument("--buckets", default="pow2",
+                    help="'pow2' or an explicit ladder like 64,256,1024")
+    ap.add_argument("--method", default="bdf", choices=["bdf", "sdirk"])
+    ap.add_argument("--rtol", type=float, default=1e-6)
+    ap.add_argument("--atol", type=float, default=1e-10)
+    ap.add_argument("--segment-steps", type=int, default=256,
+                    help="segmented-driver launch bound; 0 warms the "
+                         "monolithic program instead")
+    ap.add_argument("--max-steps", type=int, default=200_000,
+                    help="monolithic max_steps (static; part of the "
+                         "program key) — segmented runs ignore it")
+    ap.add_argument("--jac-window", default="auto",
+                    help="'auto' (platform rule) or an int")
+    ap.add_argument("--ignition-marker",
+                    help="species name for the in-loop ignition observer")
+    ap.add_argument("--ignition-mode", default="half",
+                    choices=["half", "peak"])
+    ap.add_argument("--stats", action="store_true",
+                    help="warm the telemetry-instrumented (stats=True) "
+                         "program variant, as telemetry=True sweeps run")
+    ap.add_argument("--cache-dir",
+                    default=os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                           os.path.join(REPO, ".jax_cache")),
+                    help="managed persistent-cache directory")
+    ap.add_argument("--list", action="store_true",
+                    help="print the cache manifest and exit (no compiles)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        return list_manifest(args.cache_dir)
+    if not args.mech or not args.therm:
+        ap.error("--mech and --therm are required (or use --list)")
+
+    # the cache dir must be pinned BEFORE jax compiles anything
+    from batchreactor_tpu import aot
+
+    aot.configure_cache(args.cache_dir)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import batchreactor_tpu as br
+    from batchreactor_tpu.api import _sweep_fns, resolve_jac_window
+    from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+
+    gm = br.compile_gaschemistry(args.mech)
+    th = br.create_thermo(list(gm.species), args.therm)
+    sp = list(gm.species)
+    comp = _parse_comp(args.comp)
+    idx = {s.upper(): k for k, s in enumerate(sp)}
+    X = np.zeros((1, len(sp)))
+    for name, val in comp.items():
+        if name.upper() not in idx:
+            ap.error(f"composition species {name!r} not in mechanism")
+        X[0, idx[name.upper()]] = val
+    marker_idx = None
+    if args.ignition_marker:
+        if args.ignition_marker.upper() not in idx:
+            ap.error(f"ignition marker {args.ignition_marker!r} not in "
+                     f"mechanism")
+        marker_idx = idx[args.ignition_marker.upper()]
+
+    # the EXACT callables batch_reactor_sweep builds (api._sweep_fns):
+    # identical construction => identical traced program => identical
+    # persistent-cache key in the later session process
+    rhs, jac, observer, obs0 = _sweep_fns(
+        "gas", None, gm, None, th, False, True, marker_idx,
+        args.ignition_mode)
+    T = jnp.asarray([args.T], dtype=jnp.float64)
+    y0 = sweep_solution_vectors(jnp.asarray(X), th.molwt, T, args.p)[0]
+    jw = (resolve_jac_window(None, args.method) if args.jac_window == "auto"
+          else int(args.jac_window))
+    lanes = [int(b) for b in args.lanes.split(",")]
+    buckets = (args.buckets if args.buckets == "pow2"
+               else tuple(int(b) for b in args.buckets.split(",")))
+    spec = dict(rhs=rhs, y0=y0, cfg={"T": args.T, "Asv": 1.0},
+                lanes=lanes, buckets=buckets, method=args.method,
+                rtol=args.rtol, atol=args.atol, jac=jac,
+                observer=observer, observer_init=obs0, jac_window=jw,
+                stats=args.stats)
+    if args.segment_steps > 0:
+        spec["segment_steps"] = args.segment_steps
+    else:
+        spec["max_steps"] = args.max_steps
+
+    print(f"warming {len(lanes)} lane counts -> buckets "
+          f"{aot.bucket_ladder(lanes, buckets)} on "
+          f"{jax.default_backend()} (cache: {args.cache_dir})",
+          file=sys.stderr)
+    results = aot.warmup([spec], cache_dir=args.cache_dir,
+                         log=lambda m: print(m, file=sys.stderr))
+    total_compile = sum(r.compile_s for r in results)
+    warm = sum(r.warm for r in results)
+    print(json.dumps({
+        "programs": len(results),
+        "already_warm": warm,
+        "compiled": len(results) - warm,
+        "compile_s": round(total_compile, 3),
+        "cache_dir": os.path.abspath(args.cache_dir),
+        "keys": [r.key for r in results],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

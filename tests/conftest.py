@@ -45,6 +45,25 @@ LIB = REFERENCE / "test" / "lib"
 FIXTURES = pathlib.Path(__file__).parent / "fixtures"
 
 
+@pytest.fixture
+def cold_compile_cache():
+    """Pin the persistent compilation cache OFF for tests that assert
+    TRUE XLA compiles or retraces.  Under a warm ``.jax_cache`` (exactly
+    what CI restores between tier-1 runs — ci.yml) those compiles are
+    serviced as cache loads, which CompileWatch deliberately does NOT
+    count as compiles (obs/retrace.py): right for production, wrong for
+    these assertions.  Also detaches jax's latched cache handle so the
+    config change takes effect mid-process."""
+    from batchreactor_tpu.aot import reset_persistent_cache
+
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    reset_persistent_cache()
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+    reset_persistent_cache()
+
+
 @pytest.fixture(scope="session")
 def lib_dir():
     # prefer the reference mechanism library; a bare clone (CI) falls back to

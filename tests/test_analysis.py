@@ -168,6 +168,60 @@ def test_static_argnums_params_exempt(tmp_path):
     assert rules.count("host-sync-call") == 2  # float() and np.asarray()
 
 
+def test_bucket_shape_branch(tmp_path):
+    # the bucket-miss hazard: branching on .shape[0] of a traced value
+    # is STATIC under trace (so traced-control-flow stays silent) but
+    # forks one executable per batch size behind the aot bucket ladder
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def seg(y0s, cfg):
+            if y0s.shape[0] > 256:
+                return y0s * cfg
+            return y0s + cfg
+
+        sweep = jax.jit(seg)
+        """)
+    assert [f.rule for f in findings] == ["bucket-shape-branch"]
+    assert findings[0].symbol.endswith("seg")
+
+
+def test_bucket_shape_branch_silent_on_assignment(tmp_path):
+    # shape *reads* (B = y.shape[0]) are the idiom the sweep drivers are
+    # built from — only branching forks the program set
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def seg(y0s):
+            B = y0s.shape[0]
+            return y0s.reshape(B, -1)
+
+        sweep = jax.jit(seg)
+        """)
+    assert not any(f.rule == "bucket-shape-branch" for f in findings)
+
+
+def test_bucket_shape_branch_flags_aliased_dim(tmp_path):
+    # the dominant spelling: read the dim into a local, branch on the
+    # local — same fork, must flag the same
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def seg(y0s, cfg):
+            B = y0s.shape[0]
+            if B > 256:
+                return y0s * cfg
+            return y0s + cfg
+
+        sweep = jax.jit(seg)
+        """)
+    assert [f.rule for f in findings] == ["bucket-shape-branch"]
+    assert findings[0].symbol.endswith("seg")
+
+
 def test_host_sync_item_method(tmp_path):
     findings, _ = _lint_snippet(tmp_path, """
         import jax

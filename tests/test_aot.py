@@ -1,0 +1,463 @@
+"""AOT program store: bucket ladder, padded-sweep bit-exactness, the
+zero-recompile cache contract, warmup + manifest accounting, and the
+checkpoint fingerprint coupling (batchreactor_tpu/aot,
+docs/performance.md "Compile economy").
+
+Everything runs tiny 2-species decay ODEs — compile cost, not solve
+cost, is what these tests exercise, and the tier-1 budget cannot afford
+GRI-scale programs.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchreactor_tpu import aot
+from batchreactor_tpu.aot.buckets import (bucket_ladder, normalize_buckets,
+                                          resolve_bucket)
+from batchreactor_tpu.obs import CompileWatch
+from batchreactor_tpu.parallel import (ensemble_solve,
+                                       ensemble_solve_segmented,
+                                       pad_to_bucket)
+from batchreactor_tpu.parallel.sweep import unpad_result
+from batchreactor_tpu.solver.sdirk import (MAX_STEPS_REACHED, RUNNING,
+                                           SUCCESS)
+
+
+@pytest.fixture
+def managed_cache(tmp_path):
+    """A per-test managed persistent-cache dir, with the process-global
+    jax cache config (and the latched cache handle) restored after."""
+    old = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    old_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+    cache = str(tmp_path / "cache")
+    yield cache
+    jax.config.update("jax_compilation_cache_dir", old)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", old_size)
+    aot.reset_persistent_cache()
+
+
+def _decay_rhs(t, y, cfg):
+    """Module-level (stable identity: the sweep compile caches key on the
+    callable) stiff per-lane decay; k spread finishes lanes in different
+    segments."""
+    return -cfg["k"] * y
+
+
+def _setup(B):
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (B, 2))
+    return y0s, {"k": jnp.logspace(1.0, 2.5, B)}
+
+
+def _fields(res):
+    out = {f: np.asarray(getattr(res, f))
+           for f in ("t", "y", "status", "n_accepted", "n_rejected",
+                     "ts", "ys", "n_saved", "h")}
+    if res.observed is not None:
+        for k, v in res.observed.items():
+            out[f"obs_{k}"] = np.asarray(v)
+    if res.stats is not None:
+        for k, v in res.stats.items():
+            out[f"stat_{k}"] = np.asarray(v)
+    return out
+
+
+def _assert_bit_exact(a, b, ctx=""):
+    fa, fb = _fields(a), _fields(b)
+    assert fa.keys() == fb.keys(), (ctx, fa.keys(), fb.keys())
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], fb[k],
+                                      err_msg=f"{ctx} field {k}")
+
+
+# --------------------------------------------------------------------------
+# ladder arithmetic (no jax work)
+# --------------------------------------------------------------------------
+def test_normalize_buckets_grammar():
+    assert normalize_buckets(None) is None
+    assert normalize_buckets(False) is None
+    assert normalize_buckets("pow2") == "pow2"
+    assert normalize_buckets([64, 256]) == (64, 256)
+    for bad in ("pow3", 64, 3.5, True, [], [0], [2.0], [64, 64],
+                [256, 64]):
+        with pytest.raises(ValueError):
+            normalize_buckets(bad)
+
+
+def test_resolve_bucket():
+    assert resolve_bucket(1, "pow2") == 1
+    assert resolve_bucket(3, "pow2") == 4
+    assert resolve_bucket(4, "pow2") == 4
+    assert resolve_bucket(4097, "pow2") == 8192
+    assert resolve_bucket(7, (8, 64)) == 8
+    assert resolve_bucket(9, (8, 64)) == 64
+    assert resolve_bucket(5, None) == 5          # bucketing off
+    # explicit ladder is a promise: exceeding it is loud
+    with pytest.raises(ValueError, match="exceeds the top bucket"):
+        resolve_bucket(65, (8, 64))
+    # mesh divisibility
+    assert resolve_bucket(3, "pow2", mesh_size=8) == 8
+    with pytest.raises(ValueError, match="does not divide evenly"):
+        resolve_bucket(5, (6, 12), mesh_size=8)
+    # a non-power-of-two mesh can never divide a pow2 bucket: loud error,
+    # not an infinite doubling loop (regression)
+    with pytest.raises(ValueError, match="cannot cover a 6-device mesh"):
+        resolve_bucket(3, "pow2", mesh_size=6)
+    assert bucket_ladder([3, 5, 9], "pow2") == (4, 8, 16)
+
+
+def test_pad_to_bucket_roundtrip():
+    y0s, cfgs = _setup(3)
+    yp, cp, B = pad_to_bucket(y0s, cfgs, 8)
+    assert B == 3 and yp.shape == (8, 2) and cp["k"].shape == (8,)
+    np.testing.assert_array_equal(np.asarray(yp[:3]), np.asarray(y0s))
+    np.testing.assert_array_equal(np.asarray(yp[3:]),
+                                  np.broadcast_to(np.asarray(y0s[-1]),
+                                                  (5, 2)))
+    with pytest.raises(ValueError, match="bucket 2 < lane count"):
+        pad_to_bucket(y0s, cfgs, 2)
+    # unpad is the exact inverse on the lane axis
+    res = ensemble_solve(_decay_rhs, yp, 0.0, 1.0, cp, max_steps=5000)
+    assert unpad_result(res, 3).y.shape == (3, 2)
+
+
+# --------------------------------------------------------------------------
+# masked dead lanes never affect live-lane results (the tentpole
+# bit-exactness claim: asserted, not assumed)
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("method", ["bdf", "sdirk"])
+@pytest.mark.parametrize("n_save", [0, 24])
+def test_padded_bit_exact_segmented(method, n_save):
+    y0s, cfgs = _setup(3)
+    kw = dict(segment_steps=16, max_segments=64, n_save=n_save,
+              method=method, stats=True)
+    plain = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs, **kw)
+    padded = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                      buckets="pow2", **kw)
+    assert np.all(np.asarray(plain.status) == SUCCESS)
+    _assert_bit_exact(plain, padded, f"{method}/n_save={n_save}")
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_padded_bit_exact_budget_parking(pipeline):
+    """The exact max_attempts budget parks the SAME lanes at the same t
+    and counts with dead lanes along for the ride — across both
+    execution gears."""
+    y0s, cfgs = _setup(3)
+    kw = dict(segment_steps=16, max_segments=64, max_attempts=120,
+              stats=True, pipeline=pipeline)
+    plain = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs, **kw)
+    status = np.asarray(plain.status)
+    assert np.any(status == MAX_STEPS_REACHED) and np.any(status == SUCCESS)
+    padded = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                      buckets="pow2", **kw)
+    _assert_bit_exact(plain, padded, f"budget/pipeline={pipeline}")
+
+
+def test_padded_bit_exact_monolithic():
+    y0s, cfgs = _setup(5)
+    a = ensemble_solve(_decay_rhs, y0s, 0.0, 1.0, cfgs, max_steps=5000,
+                       stats=True)
+    b = ensemble_solve(_decay_rhs, y0s, 0.0, 1.0, cfgs, max_steps=5000,
+                       stats=True, buckets="pow2")
+    _assert_bit_exact(a, b, "monolithic")
+    assert b.y.shape == (5, 2)  # dead lanes stripped
+
+
+# --------------------------------------------------------------------------
+# the zero-recompile contract
+# --------------------------------------------------------------------------
+def test_second_B_in_bucket_compiles_nothing():
+    """The cache-hit regression gate: after one sweep at any B in a
+    bucket, a sweep at a DIFFERENT B in the same bucket runs zero new
+    compiles of the sweep program (the padded shapes are identical, so
+    the jit dispatch cache serves the executable outright)."""
+    y0s5, cfgs5 = _setup(5)
+    ensemble_solve_segmented(_decay_rhs, y0s5, 0.0, 1.0, cfgs5,
+                             segment_steps=16, max_segments=64,
+                             buckets="pow2")
+    y0s7, cfgs7 = _setup(7)
+    watch = CompileWatch()
+    with watch:
+        res = ensemble_solve_segmented(_decay_rhs, y0s7, 0.0, 1.0, cfgs7,
+                                       segment_steps=16, max_segments=64,
+                                       buckets="pow2", watch=watch)
+    assert res.y.shape == (7, 2)
+    seg = watch.summary()["by_label"].get("sweep-segment", {})
+    assert seg.get("compiles", 0) == 0, seg
+    assert watch.retraces == 0
+
+
+def test_bucket_change_is_expected_compile_not_retrace(cold_compile_cache):
+    """A single_program label keyed per bucket treats a bucket change as
+    the expected first compile of a new canonical program; a second
+    compile INSIDE one bucket still flags."""
+
+    def f(x):
+        return (x * 2.0).sum()
+
+    jf = jax.jit(f)
+    watch = CompileWatch()
+    x4, x8, x16 = (jnp.ones((n,)) for n in (4, 8, 16))
+    with watch:
+        with watch.region("sweep", single_program=True, program_key="b4"):
+            jf(x4)
+        with watch.region("sweep", single_program=True, program_key="b8"):
+            jf(x8)                      # bucket change: expected
+        s1 = watch.summary()
+        with watch.region("sweep", single_program=True, program_key="b8"):
+            jf(x16)                     # same key, new shape: retrace
+        s2 = watch.summary()
+    assert s1["by_label"]["sweep"]["compiles"] == 2
+    assert s1["retraces"] == 0
+    assert s2["by_label"]["sweep"]["programs"] == {"b4": 1, "b8": 2}
+    assert s2["retraces"] == 1
+
+
+def test_persistent_cache_hit_not_counted_as_compile(managed_cache):
+    """A persistent-cache-served program counts under cache_hits (with
+    its deserialize wall in cache_load_s), NOT compiles — the schema the
+    'compiles: N -> 0' evidence format rests on."""
+    aot.configure_cache(managed_cache)
+
+    def make_g():
+        # a FRESH function object per call (jit caches key on callable
+        # identity) whose traced program is nonetheless byte-identical —
+        # the in-process model of a new process hitting the persistent
+        # cache
+        def g(x):
+            return jnp.cumsum(x * 3.0)
+
+        return g
+
+    x = jnp.ones((13,))
+    w1 = CompileWatch()
+    with w1:
+        jax.jit(make_g())(x)            # cold: true compile, cache miss
+    w2 = CompileWatch()
+    with w2:
+        jax.jit(make_g())(x)
+    s1, s2 = w1.summary(), w2.summary()
+    assert s1["compiles"] >= 1 and s1["cache_misses"] >= 1
+    assert s2["compiles"] == 0, s2
+    assert s2["cache_hits"] >= 1
+    lbl = s2["by_label"]["program"]
+    assert lbl["cache_load_s"] > 0.0
+
+
+def test_cache_served_build_still_arms_retrace_detection(managed_cache):
+    """A persistent-cache-served build registers under its program key
+    like a true compile: a later rebuild of the same armed key flags as
+    a retrace even though the first build never counted as a compile
+    (else a warmed session — exactly the AOT store's target state —
+    would silently disable retrace detection)."""
+    aot.configure_cache(managed_cache)
+
+    def make_g():
+        def g(x):
+            return jnp.sort(x * 5.0)
+
+        return g
+
+    # inputs built OUTSIDE the regions: array creation can itself
+    # compile tiny eager-op programs that must not attribute to the key
+    x11, x12 = jnp.ones((11,)), jnp.ones((12,))
+    jax.block_until_ready((x11, x12))
+    jax.jit(make_g())(x11)              # populate the persistent cache
+    watch = CompileWatch()
+    with watch:
+        with watch.region("sweep", single_program=True, program_key="b16"):
+            jax.jit(make_g())(x11)      # cache-served first build
+        s1 = watch.summary()
+        with watch.region("sweep", single_program=True, program_key="b16"):
+            jax.jit(make_g())(x12)      # true rebuild, same key
+    s2 = watch.summary()
+    assert s1["compiles"] == 0 and s1["cache_hits"] >= 1
+    assert s1["retraces"] == 0
+    assert s2["retraces"] == 1
+    assert s2["by_label"]["sweep"]["programs"] == {"b16": 2}
+
+
+def test_warmup_manifest_and_zero_compile_sweep(managed_cache):
+    """warmup() compiles each canonical bucket program once through the
+    real drivers, writes the manifest, and a later sweep at any B inside
+    a warmed bucket compiles nothing; a second warmup reports warm."""
+    spec = dict(rhs=_decay_rhs, y0=jnp.asarray([1.0, 0.5]),
+                cfg={"k": 10.0}, lanes=[3, 9], buckets="pow2",
+                segment_steps=16)
+    results = aot.warmup([spec], cache_dir=managed_cache)
+    assert [r.bucket for r in results] == [4, 16]
+    man = aot.load_manifest(managed_cache)
+    assert set(man["entries"]) == {r.key for r in results}
+    assert all(e["warmups"] == 1 for e in man["entries"].values())
+    assert os.path.exists(aot.manifest_path(managed_cache))
+    json.load(open(aot.manifest_path(managed_cache)))  # valid json on disk
+
+    # any B inside a warmed bucket: zero compiles of the sweep program
+    y0s, cfgs = _setup(9)
+    watch = CompileWatch()
+    with watch:
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 segment_steps=16, max_segments=64,
+                                 buckets="pow2", watch=watch)
+    seg = watch.summary()["by_label"].get("sweep-segment", {})
+    assert seg.get("compiles", 0) == 0, seg
+
+    # re-warm: everything already in the dispatch cache
+    again = aot.warmup([spec], cache_dir=managed_cache)
+    assert all(r.warm and r.compiles == 0 for r in again), again
+    man = aot.load_manifest(managed_cache)
+    assert all(e["warmups"] == 2 for e in man["entries"].values())
+
+    # an EXPLICIT buckets=None warms the exact lane-count shape (the
+    # bucketing-off session), not a silently-coerced pow2 bucket
+    exact = aot.warmup([dict(spec, lanes=[3], buckets=None)],
+                       cache_dir=managed_cache)
+    assert [r.bucket for r in exact] == [3]
+
+
+def test_host_sync_gate_holds_on_padded_programs(monkeypatch):
+    """The PR-4 pipelining regression gate composes with bucketing: a
+    padded sweep performs at most ceil(segments/poll_every) + 1
+    main-thread blocking fetches."""
+    import batchreactor_tpu.parallel.sweep as sweep_mod
+
+    y0s, cfgs = _setup(5)
+    kw = dict(segment_steps=16, max_segments=64, n_save=64, stats=True,
+              buckets="pow2")
+    segs = []
+    ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                             pipeline=False,
+                             progress=lambda p: segs.append(p), **kw)
+    n_segments = len(segs)
+    assert n_segments >= 3
+    assert all(p["n_lanes"] == 8 for p in segs)  # padded shape reported
+
+    calls = []
+    orig = sweep_mod._host_fetch
+    monkeypatch.setattr(
+        sweep_mod, "_host_fetch",
+        lambda x, recorder=None: (calls.append(1), orig(x, recorder))[1])
+    sweep_mod.ensemble_solve_segmented(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, pipeline=True, poll_every=4, **kw)
+    assert len(calls) <= -(-n_segments // 4) + 1, (len(calls), n_segments)
+
+
+# --------------------------------------------------------------------------
+# api plumbing + validation
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def h2o2(fixtures_dir):
+    import batchreactor_tpu as br
+
+    gm = br.compile_gaschemistry(os.path.join(fixtures_dir, "h2o2.dat"))
+    th = br.create_thermo(list(gm.species),
+                          os.path.join(fixtures_dir, "therm.dat"))
+    return gm, th
+
+
+def test_api_bucket_validation(h2o2):
+    import batchreactor_tpu as br
+
+    gm, th = h2o2
+    kw = dict(chem=br.Chemistry(gaschem=True), thermo_obj=th, md=gm)
+    comp = {"H2": 0.3, "O2": 0.2, "N2": 0.5}
+    with pytest.raises(ValueError, match="buckets must be"):
+        br.batch_reactor_sweep(comp, np.linspace(1050, 1150, 4), 1e5,
+                               1e-6, buckets="pow3", **kw)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        br.batch_reactor_sweep(comp, np.linspace(1050, 1150, 4), 1e5,
+                               1e-6, buckets=[8, 4], **kw)
+    # an explicit ladder that cannot cover B fails BEFORE any compile
+    with pytest.raises(ValueError, match="exceeds the top bucket"):
+        br.batch_reactor_sweep(comp, np.linspace(1050, 1150, 6), 1e5,
+                               1e-6, buckets=(2, 4), **kw)
+
+
+def test_api_bucketed_sweep_matches_unbucketed(h2o2):
+    import batchreactor_tpu as br
+
+    gm, th = h2o2
+    kw = dict(chem=br.Chemistry(gaschem=True), thermo_obj=th, md=gm,
+              segment_steps=16, ignition_marker="H2")
+    comp = {"H2": 0.3, "O2": 0.2, "N2": 0.5}
+    T = np.linspace(1050, 1150, 5)
+    # telemetry on BOTH: stats=True threads the counter block through the
+    # traced program, so only same-instrumentation runs are comparable
+    # bit-for-bit (the padding itself is the variable under test)
+    plain = br.batch_reactor_sweep(comp, T, 1e5, 1e-5, telemetry=True,
+                                   **kw)
+    padded = br.batch_reactor_sweep(comp, T, 1e5, 1e-5, buckets="pow2",
+                                    telemetry=True, **kw)
+    assert padded["telemetry"]["meta"]["bucket"] == 8
+    assert padded["t"].shape == (5,)
+    np.testing.assert_array_equal(plain["status"], padded["status"])
+    np.testing.assert_array_equal(plain["t"], padded["t"])
+    # real-mechanism kernels: XLA's batch-size-dependent vectorization
+    # introduces <=2 ulp spread on y (measured 8e-16 relative on this
+    # workload) — the same order as the documented lane-position
+    # sensitivity (checkpoint.py lane_cost), ~1e10 x below rtol.  The
+    # strict bit-exactness contract is asserted on the linear-ODE
+    # matrix above, where no such re-tiling occurs.
+    np.testing.assert_allclose(plain["tau"], padded["tau"], rtol=1e-12)
+    for sp in plain["x"]:
+        np.testing.assert_allclose(plain["x"][sp], padded["x"][sp],
+                                   rtol=1e-12)
+    assert padded["report"]["n_lanes"] == 5  # dead lanes stripped
+    # per-lane telemetry arrays are stripped to live lanes too
+    per_lane = padded["telemetry"]["solver_stats"]["per_lane"]
+    assert len(per_lane["newton_iters"]) == 5
+
+
+# --------------------------------------------------------------------------
+# checkpoint coupling
+# --------------------------------------------------------------------------
+def test_checkpoint_bucketed_resume_bit_exact(tmp_path):
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    y0s, cfgs = _setup(6)
+    kw = dict(segment_steps=16, max_steps=2000, n_save=64)
+    plain = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                               str(tmp_path / "plain"), chunk_size=3, **kw)
+    bucketed = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                  str(tmp_path / "buck"), chunk_size=3,
+                                  buckets="pow2", **kw)
+    _assert_bit_exact(plain, bucketed, "checkpointed")
+    # resume: drop a chunk, re-solve through the padded program only
+    os.remove(str(tmp_path / "buck" / "chunk_00001.npz"))
+    resumed = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 str(tmp_path / "buck"), chunk_size=3,
+                                 buckets="pow2", **kw)
+    _assert_bit_exact(plain, resumed, "checkpointed-resume")
+
+
+def test_checkpoint_fingerprint_includes_bucket(tmp_path):
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    y0s, cfgs = _setup(4)
+    kw = dict(segment_steps=16, max_steps=2000)
+    d = str(tmp_path / "ck")
+    checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs, d, chunk_size=2,
+                       buckets="pow2", **kw)
+    # same ladder, different spelling of the same canonical form: resumes
+    checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs, d, chunk_size=2,
+                       buckets="pow2", **kw)
+    # a different ladder is a different sweep: loud mismatch
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs, d,
+                           chunk_size=2, buckets=(4, 8), **kw)
+    # buckets=None fingerprints identically to the knob being absent
+    # (pre-bucketing checkpoint dirs stay resumable)
+    d2 = str(tmp_path / "legacy")
+    checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs, d2, chunk_size=2,
+                       **kw)
+    checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs, d2, chunk_size=2,
+                       buckets=None, **kw)
+    man = json.load(open(os.path.join(d2, "manifest.json")))
+    assert man["fingerprint"]
