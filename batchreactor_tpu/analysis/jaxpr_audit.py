@@ -31,8 +31,20 @@ A fourth, structural audit backs the AOT program store (``aot/``): two
 lane counts padded into one bucket must trace to jaxpr-IDENTICAL
 segment programs (``jaxpr-bucket-fork``) — the compile-economy contract
 that one executable serves every B in a bucket.
+
+Two more structural audits back the Newton setup economy and the
+Pallas kernel path (solver/linalg_pallas.py):
+
+* **economy-noop-fork** — ``setup_economy=True`` at ``jac_window=1`` is
+  documented as a structural no-op (solver/bdf.py); the audit traces
+  both knob settings and requires byte-identical jaxprs, the same
+  invariance class as the PR-3 "stats=False jaxprs unchanged" contract.
+* **kernel-missing** — a ``linsolve="lu32p"`` step program must
+  actually contain the ``pallas_call`` primitive (a silent fallback to
+  the jnp path would keep tests green while the kernel never runs).
 """
 
+import functools
 import os
 
 from .core import Finding
@@ -195,6 +207,50 @@ def run_audit(fixtures_dir=None):
 
         jaxpr = jax.make_jaxpr(run)(y0)
         findings.extend(_audit_jaxpr(sname, jaxpr, check_dtype=False))
+
+    # the setup-economy step program (this PR's cross-window
+    # factorization carry): same purity contract — the carried
+    # factorization is data in the while-loop carry, never a callback
+    # or an in-loop staging — plus the structural no-op invariance:
+    # setup_economy=True at jac_window=1 must trace BYTE-IDENTICAL to
+    # the knob off (solver/bdf.py documents it as silently ignored
+    # there; a fork means the economy plumbing leaked into the default
+    # program — the same invariance class as the stats=False contract)
+    def _bdf_run(y0_, **skw):
+        return bdf.solve(rhs, y0_, 0.0, 1e-7, cfg, rtol=1e-6,
+                         atol=1e-10, max_steps=3, n_save=0, jac=jac,
+                         **skw).y
+
+    jaxpr = jax.make_jaxpr(functools.partial(
+        _bdf_run, jac_window=4, setup_economy=True, stats=True))(y0)
+    findings.extend(_audit_jaxpr("bdf-step-economy", jaxpr,
+                                 check_dtype=False))
+    j_off = str(jax.make_jaxpr(_bdf_run)(y0))
+    j_on = str(jax.make_jaxpr(functools.partial(
+        _bdf_run, setup_economy=True))(y0))
+    if j_off != j_on:
+        findings.append(Finding(
+            "economy-noop-fork", "<jaxpr:bdf-step-economy-noop>", 0, 0,
+            "setup_economy=True at jac_window=1 traces a DIFFERENT "
+            "program than the knob off: the economy carry leaked into "
+            "the structural-no-op configuration (solver/bdf.py "
+            "contract)"))
+
+    # the lu32p kernel path: the step program must be pure like every
+    # other mode AND must actually contain the pallas_call primitive —
+    # a silent fallback to the jnp LU would keep the parity tests green
+    # while the hand-written kernel never runs
+    jaxpr = jax.make_jaxpr(functools.partial(
+        _bdf_run, linsolve="lu32p"))(y0)
+    findings.extend(_audit_jaxpr("bdf-step-lu32p", jaxpr,
+                                 check_dtype=False))
+    prims = {e.primitive.name for e, _ in _iter_eqns(jaxpr)}
+    if not any("pallas" in p for p in prims):
+        findings.append(Finding(
+            "kernel-missing", "<jaxpr:bdf-step-lu32p>", 0, 0,
+            "linsolve='lu32p' step program contains no pallas_call "
+            "primitive: the blocked-LU kernel silently fell back to "
+            "the jnp path (solver/linalg_pallas.py)"))
 
     # the two sensitivity programs (sensitivity/, docs/sensitivity.md):
     # the tangent-carrying BDF step program and the adjoint fixed-grid

@@ -774,6 +774,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         max_steps=200_000, segment_steps=0, kc_compat=False,
                         asv_quirk=True, ignition_marker=None,
                         ignition_mode="half", method="bdf", jac_window=None,
+                        linsolve="auto", setup_economy=False, stale_tol=0.3,
                         analytic_jac=True, telemetry=False, pipeline=None,
                         poll_every=None, buckets=None):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
@@ -814,6 +815,29 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     closed form but wraps it in ``jax.checkpoint`` (numerically identical,
     different XLA program structure).  Both are measurement/escape knobs
     for the coupled analytic-J TPU-backend compile-time wall (PERF.md).
+
+    ``linsolve`` picks the Newton linear-solver mode (table:
+    docs/api.md "Newton linear algebra"; semantics: solver/linalg.py
+    ``MODES``).  ``"auto"`` — the default — follows THE one resolution
+    rule (:func:`batchreactor_tpu.solver.linalg.resolve_linsolve`, the
+    ``resolve_jac_window`` convention): exact f64 ``"lu"`` on CPU,
+    ``"inv32"`` for SDIRK on accelerators, ``"inv32f"`` for BDF on
+    accelerators — except on TPU when the padded lane count reaches
+    ``B * n >= linalg.LU32P_MIN_BN``, where the Pallas-blocked batched
+    f32 LU ``"lu32p"`` (solver/linalg_pallas.py, the first hand-written
+    kernel) takes over.  Explicit modes pass through validated.
+
+    ``setup_economy=True`` (BDF with ``jac_window > 1``; a structural
+    no-op at ``jac_window=1``) turns on CVODE-style Newton setup economy
+    (docs/performance.md "Newton setup economy"): the iteration-matrix
+    factorization is carried ACROSS jac windows and refreshed only on a
+    cj-ratio breach (``|c/c0 - 1| > stale_tol``, CVODE's dgamrat; default
+    0.3 = CVODE's dgmax), a Newton convergence failure, or the msbp age
+    backstop — so the ``factorizations`` counter drops strictly below
+    ``jac_builds`` wherever reuse fires (``setup_reuses`` counts it).
+    Trajectories stay within the solve's tolerance of the economy-off
+    run (the frozen factorization only preconditions the quasi-Newton
+    corrector; its fixed point is unchanged).
 
     ``telemetry=True`` adds ``out["telemetry"]``: the structured ``obs``
     report (docs/observability.md) with prepare/solve spans, PER-LANE
@@ -1014,7 +1038,9 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     watch = CompileWatch(recorder=rec, default_label="sweep")
     common = dict(mesh=mesh, rtol=rtol, atol=atol, jac=jac,
                   observer=observer, observer_init=obs0, method=method,
-                  jac_window=jac_window, stats=telemetry, buckets=buckets)
+                  jac_window=jac_window, linsolve=linsolve,
+                  setup_economy=setup_economy, stale_tol=stale_tol,
+                  stats=telemetry, buckets=buckets)
     with (watch if telemetry else contextlib.nullcontext()), \
             (rec.span("solve", lanes=B)
              if telemetry else contextlib.nullcontext()):

@@ -27,6 +27,17 @@ Keys (CVODE's ``CVodeGetNumSteps``-family counters, per lane):
     rejected attempts split by cause: error test failed with a converged
     corrector vs Newton convergence failure (incl. non-finite iterates).
     ``err_rejects + conv_rejects == n_rejected`` exactly.
+``setup_reuses``  (BDF ``setup_economy=True`` only; 0 otherwise)
+    jac-window opens that *reused* the carried iteration-matrix
+    factorization instead of refactoring (the CVODE msbp/dgamrat test
+    passed).  ``setup_reuses + factorizations == jac_builds`` exactly
+    under economy, so ``factorizations < jac_builds`` wherever reuse
+    fired.
+``precond_age``  (gauge — see ``GAUGE_KEYS``)
+    peak number of consecutive jac windows one factorization served
+    (CVODE's msbp counter at its high-water mark).  A gauge, not a
+    counter: segmented accumulation and totals reduce it by ``max``,
+    never by sum.
 ``order_hist``  (BDF only)
     (MAXORD+1,) int32 histogram of *accepted* steps by the order they
     were taken at; slot 0 is structurally unused (orders run 1..5), and
@@ -47,8 +58,11 @@ import numpy as np
 #: counter keys common to both solvers (beyond the SolveResult aliases)
 COMMON_KEYS = ("newton_iters", "jac_builds", "factorizations",
                "err_rejects", "conv_rejects")
-#: additional BDF-only key
-BDF_KEYS = ("order_hist",)
+#: additional BDF-only keys (setup_reuses stays 0 without setup_economy)
+BDF_KEYS = ("order_hist", "setup_reuses", "precond_age")
+#: gauge keys: high-water marks, reduced by max — summing a peak age
+#: across segments would report an age no factorization ever reached
+GAUGE_KEYS = ("precond_age",)
 #: step_audit payloads folded into stats (not counters; excluded from sums)
 AUDIT_KEYS = ("accept_ring", "it_matrix")
 
@@ -76,6 +90,8 @@ def accumulate(total, seg_stats, live):
             if k in AUDIT_KEYS:
                 total[k] = np.asarray(v)
             else:
+                # gauges start from their first live observation too:
+                # max(0, v) == v for the int32 high-water marks
                 total[k] = masked_add(np.zeros_like(np.asarray(v)), v, live)
         return total
     out = dict(total)
@@ -85,6 +101,11 @@ def accumulate(total, seg_stats, live):
             mask = mask.reshape(mask.shape + (1,) * (np.asarray(v).ndim
                                                      - mask.ndim))
             out[k] = np.where(mask, np.asarray(v), total[k])
+        elif k in GAUGE_KEYS:
+            # high-water mark across segments, not a sum (a reuse streak
+            # broken by a segment boundary reports the larger piece)
+            out[k] = np.maximum(total[k],
+                                masked_add(np.zeros_like(total[k]), v, live))
         else:
             out[k] = masked_add(total[k], v, live)
     return out
@@ -93,8 +114,9 @@ def accumulate(total, seg_stats, live):
 def totals(stats):
     """Reduce a (possibly vmap-batched) stats dict to python totals:
     scalar counters sum over every axis; ``order_hist`` sums over the
-    batch axis only (stays a per-order list); audit payloads are
-    dropped (they are samples, not counters)."""
+    batch axis only (stays a per-order list); gauges (``GAUGE_KEYS``)
+    take the max; audit payloads are dropped (they are samples, not
+    counters)."""
     if stats is None:
         return None
     out = {}
@@ -105,6 +127,8 @@ def totals(stats):
         if k == "order_hist":
             hist = a.reshape(-1, a.shape[-1]).sum(axis=0)
             out[k] = [int(x) for x in hist]
+        elif k in GAUGE_KEYS:
+            out[k] = int(a.max())
         else:
             out[k] = int(a.sum())
     return out

@@ -138,10 +138,17 @@ def to_prometheus(report):
             "Solver step attempts by outcome.", steps)
     _metric(lines, "br_solver_work_total", "counter",
             "Solver work counters (Newton iterations, Jacobian builds, "
-            "iteration-matrix factorizations, rejection causes).",
+            "iteration-matrix factorizations, setup-economy reuses, "
+            "rejection causes).",
             [({"kind": k}, totals[k]) for k in
              ("newton_iters", "jac_builds", "factorizations",
-              "err_rejects", "conv_rejects") if k in totals])
+              "setup_reuses", "err_rejects", "conv_rejects") if k in totals])
+    if "precond_age" in totals:
+        # a high-water mark, not a monotone count: gauge, its own family
+        _metric(lines, "br_solver_precond_age", "gauge",
+                "Peak consecutive jac windows served by one iteration-"
+                "matrix factorization (setup economy msbp high-water).",
+                [({}, totals["precond_age"])])
     if "order_hist" in totals:
         _metric(lines, "br_solver_order_steps_total", "counter",
                 "Accepted BDF steps by method order.",

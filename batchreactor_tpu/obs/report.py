@@ -126,7 +126,8 @@ def render(report):
     if st:
         lines.append("solver:")
         for k in ("n_accepted", "n_rejected", "newton_iters", "jac_builds",
-                  "factorizations", "err_rejects", "conv_rejects"):
+                  "factorizations", "setup_reuses", "precond_age",
+                  "err_rejects", "conv_rejects"):
             if k in st:
                 lines.append(f"  {k}: {st[k]}")
         if "order_hist" in st:
@@ -213,6 +214,11 @@ def diff(a, b):
     tb = (b.get("solver_stats") or {}).get("totals") or {}
     for k in sorted(set(ta) | set(tb)):
         va, vb = ta.get(k), tb.get(k)
+        if k in ("setup_reuses", "precond_age"):
+            # setup-economy keys are absent from pre-economy archived
+            # reports: missing is 0, not a difference (the cache_* key
+            # convention below)
+            va, vb = va or 0, vb or 0
         if va != vb:
             lines.append(f"  solver {k}: {va} -> {vb}")
     ca, cb = a.get("compile") or {}, b.get("compile") or {}

@@ -47,6 +47,7 @@ from ..obs import counters as obs_counters
 from ..obs.recorder import span_or_null
 from ..obs.retrace import CompileWatch
 from ..solver import bdf, sdirk
+from ..solver.linalg import factor_zeros, resolve_linsolve
 
 _SOLVERS = {"sdirk": sdirk.solve, "bdf": bdf.solve}
 
@@ -152,7 +153,8 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    dt0=None, dt_min_factor=1e-22, linsolve="auto", jac=None,
                    observer=None, observer_init=None, jac_window=1,
                    newton_tol=0.03, method="bdf", freeze_precond=False,
-                   stats=False, buckets=None):
+                   setup_economy=False, stale_tol=0.3, stats=False,
+                   buckets=None):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
@@ -178,21 +180,38 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     last lane, stripped from the returned SolveResult (incl. per-lane
     ``stats``/``observed`` arrays), and live-lane results are bit-exact
     vs the unpadded program (regression-asserted).
+
+    ``setup_economy``/``stale_tol`` (BDF only): CVODE-style cross-window
+    factorization reuse (``solver/bdf.py setup_economy=``).  ``linsolve=
+    "auto"`` resolves HERE with the sweep's padded lane count and state
+    size (``linalg.resolve_linsolve`` — one rule), which is how the
+    Pallas-blocked ``"lu32p"`` mode self-selects on TPU at large B x n.
     """
     _check_method(method, newton_tol)
     if freeze_precond and method != "bdf":
         raise ValueError(
             f"freeze_precond is a bdf-only knob; method={method!r}")
+    if setup_economy and method != "bdf":
+        raise ValueError(
+            f"setup_economy is a bdf-only knob; method={method!r}")
     y0s = jnp.asarray(y0s)
     B_live = y0s.shape[0]
     bucket = resolve_bucket(
         B_live, buckets,
         mesh_size=mesh.devices.size if mesh is not None else 1)
     y0s, cfgs, _ = pad_to_bucket(y0s, cfgs, bucket)
+    # the sweep drivers are where "auto" can see the batch: resolve with
+    # the PADDED lane count (the shape the device runs) and the state
+    # size, so lu32p turns on exactly where its blocked regime starts
+    linsolve = resolve_linsolve(
+        linsolve, method=method,
+        platform=(mesh.devices.flat[0].platform if mesh is not None
+                  else jax.default_backend()),
+        batch=int(y0s.shape[0]), n=int(y0s.shape[1]))
     jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
                             dt_min_factor, linsolve, jac, observer,
                             jac_window, newton_tol, method, freeze_precond,
-                            stats)
+                            setup_economy, stale_tol, stats)
     t0 = jnp.asarray(t0, dtype=y0s.dtype)
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     obs0 = observer_init if observer is not None else 0.0
@@ -224,7 +243,7 @@ def _check_method(method, newton_tol):
 def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
                    linsolve, jac=None, observer=None, jac_window=1,
                    newton_tol=0.03, method="bdf", freeze_precond=False,
-                   stats=False):
+                   setup_economy=False, stale_tol=0.3, stats=False):
     """One compiled batched solve per (rhs, solver-settings) combination.
 
     Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
@@ -238,7 +257,9 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
         kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
               if method == "sdirk"
               else {"jac_window": jac_window,
-                    "freeze_precond": freeze_precond})
+                    "freeze_precond": freeze_precond,
+                    "setup_economy": setup_economy,
+                    "stale_tol": stale_tol})
         return _SOLVERS[method](
             rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
             n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
@@ -328,9 +349,10 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              linsolve="auto", jac=None, observer=None,
                              observer_init=None, dt_min_factor=1e-22,
                              n_save=0, rhs_bundle=None, jac_window=1,
-                             newton_tol=0.03, method="bdf", stats=False,
-                             recorder=None, watch=None, pipeline=None,
-                             poll_every=None, buckets=None):
+                             newton_tol=0.03, method="bdf",
+                             setup_economy=False, stale_tol=0.3,
+                             stats=False, recorder=None, watch=None,
+                             pipeline=None, poll_every=None, buckets=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -418,6 +440,15 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     segment compile label keys on the padded lane count, so a bucket
     change is an expected compile while any second compile inside a
     bucket still flags as a retrace.
+
+    ``setup_economy``/``stale_tol`` (BDF, ``jac_window > 1``): CVODE-style
+    cross-window factorization reuse (``solver/bdf.py setup_economy=``).
+    The carried factorization joins the segment carry (the solver's
+    5-tuple ``solver_state``), so reuse streaks survive segment
+    relaunches in both gears; ``linsolve="auto"`` resolves here with the
+    padded lane count, which is how ``"lu32p"`` self-selects on TPU at
+    large B x n.  ``precond_age`` accumulates across segments by max
+    (it is a gauge), in both the host and the on-device accumulators.
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
@@ -435,10 +466,26 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     # drops a row the host still has capacity for
     seg_save = min(int(n_save), int(segment_steps)) if n_save else 0
     _check_method(method, newton_tol)
+    if setup_economy and method != "bdf":
+        raise ValueError(
+            f"setup_economy is a bdf-only knob; method={method!r}")
+    # "auto" resolves here with the padded batch (one rule —
+    # linalg.resolve_linsolve; ensemble_solve does the same), so lu32p
+    # self-selects on TPU at large B x n for every segment program
+    linsolve = resolve_linsolve(
+        linsolve, method=method,
+        platform=(mesh.devices.flat[0].platform if mesh is not None
+                  else jax.default_backend()),
+        batch=int(y0s.shape[0]), n=int(y0s.shape[1]))
+    # mirror bdf.solve's structural predicate: at jac_window=1 economy is
+    # a no-op and the solver returns the classic 4-tuple solver_state, so
+    # the segment carry must not grow the economy slot either
+    economy = bool(setup_economy) and jac_window > 1 and method == "bdf"
     bundle_arg = rhs_bundle if rhs_bundle is not None else 0.0
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     carry = _init_segment_carry(y0s, t0, method, observer, observer_init,
-                                stats, n_save)
+                                stats, n_save, economy=economy,
+                                linsolve=linsolve)
     if mesh is not None:
         spec = NamedSharding(mesh, P(axis))
         carry = jax.tree.map(lambda x: jax.device_put(x, spec), carry)
@@ -465,15 +512,18 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 observer=observer, dt_min_factor=dt_min_factor,
                 n_save=n_save, seg_save=seg_save,
                 bundle_mode=rhs_bundle is not None, jac_window=jac_window,
-                newton_tol=newton_tol, method=method, stats=stats,
-                recorder=recorder, watch=watch, progress=progress), B_live)
+                newton_tol=newton_tol, method=method,
+                setup_economy=setup_economy, stale_tol=float(stale_tol),
+                stats=stats, recorder=recorder, watch=watch,
+                progress=progress), B_live)
 
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
                                       dt_min_factor, linsolve,
                                       None if rhs_bundle is not None else jac,
                                       observer, seg_save,
                                       rhs_bundle is not None, jac_window,
-                                      newton_tol, method, stats)
+                                      newton_tol, method, stats,
+                                      setup_economy, float(stale_tol))
     final_status = np.full((B,), int(sdirk.RUNNING), dtype=np.int32)
     final_t = np.full((B,), np.nan)
     n_acc = np.zeros((B,), dtype=np.int64)
@@ -600,7 +650,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
 
 def _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
                       linsolve, jac, observer, n_save, bundle_mode,
-                      jac_window, newton_tol, method, stats):
+                      jac_window, newton_tol, method, stats,
+                      setup_economy=False, stale_tol=0.3):
     """Per-lane segment solve shared by the blocking and pipelined traced
     programs — keeping it single-sourced is what makes the two drivers'
     step sequences identical by construction."""
@@ -612,7 +663,9 @@ def _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
             rhs_fn, jac_fn = rhs, jac
         kw = ({"jac_window": jac_window, "newton_tol": newton_tol}
               if method == "sdirk"
-              else {"solver_state": sstate, "jac_window": jac_window})
+              else {"solver_state": sstate, "jac_window": jac_window,
+                    "setup_economy": setup_economy,
+                    "stale_tol": stale_tol})
         return _SOLVERS[method](
             rhs_fn, y0, t0, t1, cfg, rtol=rtol, atol=atol,
             max_steps=segment_steps, n_save=n_save, dt0=h0, err0=e0,
@@ -627,7 +680,8 @@ def _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
 def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
                              linsolve, jac, observer, n_save=0,
                              bundle_mode=False, jac_window=1,
-                             newton_tol=0.03, method="bdf", stats=False):
+                             newton_tol=0.03, method="bdf", stats=False,
+                             setup_economy=False, stale_tol=0.3):
     """Compiled per-segment batched solve (the BLOCKING driver's program):
     per-lane t0 and carried-in step size are traced operands (vmap axis 0),
     so every segment reuses one executable.  In ``bundle_mode`` the first
@@ -635,7 +689,8 @@ def _cached_vsolve_segmented(rhs, rtol, atol, segment_steps, dt_min_factor,
     ``rhs`` is a builder."""
     one = _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
                             linsolve, jac, observer, n_save, bundle_mode,
-                            jac_window, newton_tol, method, stats)
+                            jac_window, newton_tol, method, stats,
+                            setup_economy, stale_tol)
     return jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0, 0)))
 
 
@@ -655,12 +710,21 @@ def _madd(acc, seg, live):
 
 
 def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
-                        n_save):
+                        n_save, economy=False, linsolve="lu"):
     """Initial per-segment carry shared by both segmented drivers:
     ``(y, t, h, e, obs, sstate, ctrl)``.  ``ctrl`` is the pipelined
     driver's device-resident control block — the park/budget/accumulate
     state the blocking driver keeps in host numpy arrays — and is simply
-    unused by the blocking path (a few (B,) allocations)."""
+    unused by the blocking path (a few (B,) allocations).
+
+    With ``economy`` (BDF setup economy at jac_window > 1) the sstate
+    grows the batched cold economy slot — zero ``c0`` marks every lane's
+    carried factorization invalid, exactly bdf.solve's cold state — so
+    the segment program's carry structure matches the 5-tuple
+    ``solver_state`` the economy solver returns from launch one (a
+    4-tuple first carry would restructure at the second launch: a
+    recompile the blocking driver would flag as a retrace and the
+    pipelined driver's donation would reject)."""
     B = y0s.shape[0]
     t = jnp.full((B,), t0, dtype=y0s.dtype)
     h = jnp.full((B,), -1.0, dtype=y0s.dtype)   # <=0: heuristic first step
@@ -687,6 +751,14 @@ def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
                   jnp.ones((B,), dtype=jnp.int32),
                   jnp.full((B,), -1.0, dtype=y0s.dtype),
                   jnp.zeros((B,), dtype=jnp.int32))
+        if economy:
+            fz = factor_zeros(linsolve, int(y0s.shape[1]), y0s.dtype)
+            sstate = sstate + ({
+                "fac": jax.tree.map(
+                    lambda a: jnp.zeros((B,) + a.shape, a.dtype), fz),
+                "c0": jnp.zeros((B,), dtype=y0s.dtype),
+                "ok": jnp.zeros((B,), dtype=bool),
+                "age": jnp.zeros((B,), dtype=jnp.int32)},)
     else:
         sstate = jnp.zeros((B,), dtype=y0s.dtype)  # unused dummy
     ctrl = {"final_status": jnp.full((B,), int(sdirk.RUNNING),
@@ -704,6 +776,10 @@ def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
         if method == "bdf":
             st["order_hist"] = jnp.zeros((B, bdf.MAXORD + 1),
                                          dtype=jnp.int32)
+            # uniform-schema keys (zero without setup_economy) — the
+            # solver's stats block always carries them under bdf
+            st["setup_reuses"] = jnp.zeros((B,), dtype=jnp.int32)
+            st["precond_age"] = jnp.zeros((B,), dtype=jnp.int32)
         ctrl["stats"] = st
     return (y0s, t, h, e, obs, sstate, ctrl)
 
@@ -711,7 +787,7 @@ def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
 def _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor, linsolve,
                 jac, observer, seg_save, bundle_mode, jac_window,
                 newton_tol, method, stats, has_budget, n_save_total,
-                compact):
+                compact, setup_economy=False, stale_tol=0.3):
     """The PIPELINED driver's traced segment program (un-jitted — brlint
     tier B audits it through here): one vmapped segment solve plus the
     device-resident control-block update that the blocking driver performs
@@ -728,7 +804,8 @@ def _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor, linsolve,
     that exist instead of the whole (B, seg_save, S) block."""
     one = _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
                             linsolve, jac, observer, seg_save, bundle_mode,
-                            jac_window, newton_tol, method, stats)
+                            jac_window, newton_tol, method, stats,
+                            setup_economy, stale_tol)
     vsolve = jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0, 0))
 
     def seg(bundle, t1, cfgs, budget, carry):
@@ -755,9 +832,16 @@ def _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor, linsolve,
         ctrl2 = {"final_status": final_status.astype(jnp.int32),
                  "final_t": final_t, "n_acc": n_acc, "n_rej": n_rej}
         if stats:
-            ctrl2["stats"] = {k: _madd(ctrl["stats"][k], res.stats[k],
-                                       running)
-                              for k in ctrl["stats"]}
+            # device twin of obs.counters.accumulate: counters masked-add,
+            # gauges (precond_age) take the running max — summing a
+            # high-water mark across segments would report an age no
+            # factorization ever reached
+            ctrl2["stats"] = {
+                k: (jnp.maximum(ctrl["stats"][k],
+                                jnp.where(running, res.stats[k], 0))
+                    if k in obs_counters.GAUGE_KEYS
+                    else _madd(ctrl["stats"][k], res.stats[k], running))
+                for k in ctrl["stats"]}
         if seg_save:
             saved = ctrl["saved"]
             take = jnp.where(
@@ -812,7 +896,8 @@ def _cached_vsolve_segmented_ctrl(rhs, rtol, atol, segment_steps,
                                   jac_window=1, newton_tol=0.03,
                                   method="bdf", stats=False,
                                   has_budget=False, n_save_total=0,
-                                  compact=True):
+                                  compact=True, setup_economy=False,
+                                  stale_tol=0.3):
     """Compiled pipelined segment program.  The carry (argument 4 — y, h,
     e, observer fold, the (B, MAXORD+3, S) BDF history, control block) is
     DONATED: each relaunch aliases the previous segment's output buffers
@@ -821,7 +906,7 @@ def _cached_vsolve_segmented_ctrl(rhs, rtol, atol, segment_steps,
     fn = _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor,
                      linsolve, jac, observer, seg_save, bundle_mode,
                      jac_window, newton_tol, method, stats, has_budget,
-                     n_save_total, compact)
+                     n_save_total, compact, setup_economy, stale_tol)
     return jax.jit(fn, donate_argnums=(4,))
 
 
@@ -962,7 +1047,8 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
                              poll_every, compact, rtol, atol, linsolve, jac,
                              observer, dt_min_factor, n_save, seg_save,
                              bundle_mode, jac_window, newton_tol, method,
-                             stats, recorder, watch, progress):
+                             setup_economy, stale_tol, stats, recorder,
+                             watch, progress):
     """The pipelined gear of :func:`ensemble_solve_segmented` (module
     docstring): run-ahead dispatch with carry donation, device-resident
     termination/budget logic, strided polling, and the background
@@ -972,7 +1058,7 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
         rhs, rtol, atol, segment_steps, dt_min_factor, linsolve, jac,
         observer, seg_save, bundle_mode, jac_window, newton_tol, method,
         stats, max_attempts is not None, int(n_save) if n_save else 0,
-        compact)
+        compact, setup_economy, stale_tol)
     budget = jnp.asarray(int(max_attempts) if max_attempts is not None
                          else 0, dtype=jnp.int64)
     # the first relaunch DONATES the carry: the y slot must not alias the

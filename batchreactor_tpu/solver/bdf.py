@@ -40,7 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .linalg import make_solve_m
+from .linalg import (apply_factor, factor_m, factor_zeros, make_solve_m,
+                     resolve_linsolve)
 from .sdirk import (DT_UNDERFLOW, MAX_STEPS_REACHED, RUNNING, SUCCESS,
                     SolveResult, _scaled_norm)
 
@@ -52,6 +53,13 @@ _M = MAXORD + 1             # active change_D block, 6
 _GAMMA_TAB = [0.0]
 for _j in range(1, _ROWS):
     _GAMMA_TAB.append(_GAMMA_TAB[-1] + 1.0 / _j)
+# setup-economy backstop: a carried factorization is force-refreshed
+# after serving this many jac windows even if the cj-ratio test keeps
+# passing (CVODE's msbp: J inside the frozen factorization also ages
+# with the STATE, which the ratio test cannot see; Newton convergence
+# failure is the reactive guard, this cap is the proactive one)
+_ECON_MAX_AGE = 20
+
 # numpy, not jnp: module-level device arrays would initialize the
 # backend at import (hangs host-only use when the tunneled TPU is
 # wedged); they enter jitted code as constants either way
@@ -119,6 +127,8 @@ def solve(
     solver_state=None,
     jac_window=1,
     freeze_precond=False,
+    setup_economy=False,
+    stale_tol=0.3,
     tangent=None,
     sens_iters=2,
     sens_errcon=False,
@@ -155,6 +165,39 @@ def solve(
     (quasi-Newton: convergence rate degrades, displacement test gates), so
     accuracy is untouched at tau level; per-attempt cost drops by one
     (B, n, n) inverse construction.
+
+    ``setup_economy=True`` (BDF's CVODE setup economy — the msbp/dgamrat
+    logic; docs/performance.md "Newton setup economy") extends the window
+    factorization reuse ACROSS ``jac_window`` boundaries: the iteration-
+    matrix factorization and its ``c0`` ride the while-loop carry, and
+    each window open *tests* staleness instead of unconditionally
+    re-setting up.  The carried factorization is reused — with the same
+    cj-ratio rescale ``freeze_precond`` applies for in-window drift —
+    whenever the previous window's Newton converged without a refresh AND
+    ``|c/c0 - 1| <= stale_tol`` (CVODE's dgamrat test, default 0.3 =
+    CVODE's dgmax) AND the factorization has served fewer than 20 windows
+    (the msbp backstop); otherwise the window does a full refactor at the
+    fresh c.  A Newton convergence failure still closes the window early
+    AND invalidates the carried factorization, so the retry window opens
+    with a full setup — CVODE's convergence-triggered refresh — and,
+    when the failing setup was STALE (a reused factorization or an
+    in-window attempt past the opening J), the retry runs at the SAME h
+    (CVODE's CV_FAIL_BAD_J path): only a failure under a current setup
+    pays the halving, so a misjudged reuse costs one attempt, never an
+    h collapse.  The
+    Jacobian refresh cadence is UNCHANGED (one J per window open, exactly
+    ``jac_window``'s contract), so with economy the ``factorizations``
+    counter drops strictly below ``jac_builds`` wherever reuse fires;
+    ``setup_reuses`` counts the reused windows and ``precond_age`` the
+    peak windows-served-per-factorization (obs/counters.py).  Accuracy
+    contract: identical to ``freeze_precond`` (the preconditioner's fixed
+    point is unchanged; only the quasi-Newton rate feels the staleness,
+    gated by the displacement test).  With ``jac_window=1`` the knob is a
+    structural no-op (a fresh J and M are built every attempt anyway) and
+    is silently ignored — trajectories are bit-identical to
+    ``setup_economy=False``.  With ``solver_state`` resume the carried
+    factorization crosses segment relaunches (the economy state joins the
+    opaque carry), so segmented sweeps keep their reuse streaks.
 
     ``tangent=(fdot, S0)`` activates CVODES-style staggered forward
     sensitivities (sensitivity/forward.py): a (P, n) tangent block
@@ -207,18 +250,18 @@ def solve(
     span = t1 - t0
     eye = jnp.eye(n, dtype=y0.dtype)
 
-    if linsolve == "auto":
-        # "inv32f" on accelerators: in a quasi-Newton corrector the f32
-        # inverse only preconditions the iteration — its fixed point is
-        # solve-accuracy independent and the displacement test gates
-        # convergence — so neither the refinement matvecs nor an f64
-        # application of the preconditioner buy anything.  Measured on TPU
-        # (GRI bench, B=256/384): bit-identical tau and step counts to
-        # "inv32", +18% dropping refinement and +10% more with the f32
-        # matvec (PERF.md).
-        linsolve = "lu" if jax.default_backend() == "cpu" else "inv32f"
-    if linsolve not in ("lu", "inv32", "inv32nr", "inv32f"):
-        raise ValueError(f"unknown linsolve {linsolve!r}")
+    # "inv32f" on accelerators: in a quasi-Newton corrector the f32
+    # inverse only preconditions the iteration — its fixed point is
+    # solve-accuracy independent and the displacement test gates
+    # convergence — so neither the refinement matvecs nor an f64
+    # application of the preconditioner buy anything.  Measured on TPU
+    # (GRI bench, B=256/384): bit-identical tau and step counts to
+    # "inv32", +18% dropping refinement and +10% more with the f32
+    # matvec (PERF.md).  This per-lane entry point doesn't know the
+    # sweep's batch, so "auto" never self-selects "lu32p" here — the
+    # ensemble drivers resolve with their B (linalg.resolve_linsolve,
+    # one rule).
+    linsolve = resolve_linsolve(linsolve, method="bdf")
     if jac_window < 1:
         # fori_loop(0, 0, ...) would return the carry unchanged and spin
         # the outer while_loop forever inside jit
@@ -227,6 +270,17 @@ def solve(
         raise ValueError(
             "freeze_precond requires jac_window > 1 (with a window of 1 "
             "the preconditioner is rebuilt with J anyway)")
+    if not 0.0 <= float(stale_tol) <= 1.0:
+        # the cj-rescale 2/(1 + c/c0) is a first-order compensation: past
+        # ratio 2 it is the wrong operator, and CVODE's dgmax is 0.3
+        raise ValueError(f"stale_tol must be in [0, 1], got {stale_tol}")
+    # economy is structurally meaningless at jac_window=1 (every attempt
+    # rebuilds J and M regardless): silently a no-op, NOT an error, so
+    # callers can set the knob unconditionally and let jac_window resolve
+    economy = bool(setup_economy) and jac_window > 1
+    # economy subsumes freeze_precond's in-window behavior (the window
+    # solve is the same frozen-factorization + cj-rescale path); an
+    # explicit freeze_precond=True alongside it is redundant, not an error
     if tangent is not None and solver_state is not None:
         raise ValueError(
             "tangent propagation cannot resume from solver_state: the "
@@ -259,13 +313,27 @@ def solve(
     else:
         h_init = jnp.asarray(dt0, dtype=y0.dtype)
 
+    # economy cold state: zero c0 marks the factorization invalid, ok=False
+    # forces a full setup at the first window open
+    econ_cold = None
+    if economy:
+        econ_cold = {"fac": factor_zeros(linsolve, n, y0.dtype),
+                     "c0": jnp.zeros((), dtype=y0.dtype),
+                     "ok": jnp.asarray(False),
+                     "age": jnp.asarray(0, dtype=jnp.int32)}
+    econ_init = econ_cold
     if solver_state is None:
         D_init = jnp.zeros((_ROWS, n), dtype=y0.dtype)
         D_init = D_init.at[0].set(y0).at[1].set(h_init * f0)
         order_init = jnp.asarray(1, dtype=jnp.int32)
         nequal_init = jnp.asarray(0, dtype=jnp.int32)
     else:
-        D_prev, order_prev, h_prev, nequal_prev = solver_state
+        # 4-tuple: the classic multistep carry; 5-tuple: + the setup-
+        # economy state (fac, c0, ok, age) a previous economy segment
+        # returned.  A 4-tuple into an economy solve cold-starts the
+        # economy only (full setup at the first window), never the history.
+        econ_prev = solver_state[4] if len(solver_state) > 4 else None
+        D_prev, order_prev, h_prev, nequal_prev = solver_state[:4]
         # fresh lanes (all-zero D, e.g. padded) fall back to a cold start
         cold = jnp.all(D_prev == 0)
         D_cold = jnp.zeros((_ROWS, n), dtype=y0.dtype)
@@ -274,6 +342,11 @@ def solve(
         order_init = jnp.where(cold, 1, order_prev).astype(jnp.int32)
         h_init = jnp.where(cold, h_init, h_prev)
         nequal_init = jnp.where(cold, 0, nequal_prev).astype(jnp.int32)
+        if economy and econ_prev is not None:
+            # fresh lanes reset their economy state with the history
+            econ_init = jax.tree.map(
+                lambda cz, cp: jnp.where(cold, cz, cp), econ_cold,
+                econ_prev)
 
     if tangent is not None:
         fdot, S0 = tangent
@@ -332,7 +405,7 @@ def solve(
         d, _, n_it, _, conv, _ = lax.while_loop(cond, body, init)
         return d, conv, n_it
 
-    def step_once(carry, J_stale, pre=None):
+    def step_once(carry, J_stale, pre=None, stale_pre=None):
         """One step attempt; ``J_stale=None`` evaluates a fresh Jacobian at
         this attempt's predictor (jac_window=1), otherwise the passed J is
         used as-is — CVODE's quasi-constant iteration matrix economy.  M and
@@ -431,11 +504,21 @@ def solve(
 
         # ---- rejected: shrink h (newton failure: halve; error: PI-free
         # asymptotic factor), rescale history -------------------------------
+        # CVODE's CV_FAIL_BAD_J distinction (economy only, stale_pre is a
+        # trace-time None otherwise): a Newton failure under a STALE setup
+        # (reused factorization, or an in-window attempt past the opening
+        # J) retries at the SAME h — the failure closes the window, the
+        # reopen does a full fresh setup, and only a failure under a
+        # CURRENT setup pays the halving.  Without it every misjudged
+        # reuse converts into an h collapse (CVODE halves only after the
+        # fresh-J retry fails too).
+        conv_fac = (0.5 if stale_pre is None
+                    else jnp.where(stale_pre, 1.0, 0.5))
         fac_rej = jnp.where(conv,
                             jnp.clip(0.9 * err ** (-1.0 /
                                                    (order.astype(y0.dtype)
                                                     + 1.0)), 0.1, 1.0),
-                            0.5)
+                            conv_fac)
         # ---- accepted: update differences ---------------------------------
         #   D[q+2] = d - D[q+1]; D[q+1] = d; D[j] += D[j+1] for j = q..0
         ridx = jnp.arange(_ROWS, dtype=jnp.int32)[:, None]
@@ -558,6 +641,7 @@ def solve(
             live = running & ~already
             rej = live & ~accept
             st2 = {
+                **st,  # setup_reuses/precond_age move only at window opens
                 "newton_iters": st["newton_iters"]
                 + jnp.where(live, n_newton, 0),
                 # J_stale/pre are trace-time statics: a fresh J (or M)
@@ -575,15 +659,20 @@ def solve(
                     accept.astype(jnp.int32)),
             }
             out = out + (st2,)
+        if economy:
+            # the economy state is window-open/close business (body()):
+            # in-window attempts carry it through untouched
+            out = out + (carry[k_econ],)
         return out, newton_failed
 
     def cond(carry):
         return carry[5] == RUNNING
 
     # carry index of the stats block (after the optional tangent history
-    # and step-audit pair)
+    # and step-audit pair) and of the setup-economy state (after stats)
     k_stats = 12 + (1 if tangent is not None else 0) + (2 if step_audit
                                                         else 0)
+    k_econ = k_stats + (1 if stats else 0)
 
     def _count_window_open(carry):
         """Window-open work: one J build (+ one factorization under
@@ -593,6 +682,30 @@ def solve(
         upd = {"jac_builds": st["jac_builds"] + live}
         if freeze_precond:
             upd["factorizations"] = st["factorizations"] + live
+        return carry[:k_stats] + ({**st, **upd},) + carry[k_stats + 1:]
+
+    def _count_window_open_econ(carry, need, reuse, age):
+        """Economy window open: J always builds (jac_window's contract);
+        the factorization counts only when the staleness test demanded a
+        refresh, so ``factorizations`` falls strictly below ``jac_builds``
+        wherever reuse fires.  ``precond_age`` is a gauge — peak windows
+        served by one factorization — accumulated by max, not sum
+        (obs/counters.py GAUGE_KEYS)."""
+        st = carry[k_stats]
+        live = carry[5] == RUNNING
+        upd = {
+            "jac_builds": st["jac_builds"] + live.astype(jnp.int32),
+            "factorizations": st["factorizations"]
+            + (live & need).astype(jnp.int32),
+            "setup_reuses": st["setup_reuses"]
+            + (live & reuse).astype(jnp.int32),
+            # windows SERVED by the current factorization (age counts
+            # reuses, so served = age + 1): a never-reused setup reports
+            # 1, matching the counters.py "peak consecutive jac windows
+            # one factorization served" / CVODE-msbp semantics exactly
+            "precond_age": jnp.maximum(st["precond_age"],
+                                       jnp.where(live, age + 1, 0)),
+        }
         return carry[:k_stats] + ({**st, **upd},) + carry[k_stats + 1:]
 
     if jac_window == 1:
@@ -619,7 +732,46 @@ def solve(
             t, D, order, h = carry[0], carry[1], carry[2], carry[3]
             y_pred = _masked_row_sum(D, jnp.ones((_ROWS,), y0.dtype), order)
             J = jac(t + h, y_pred)
-            if freeze_precond:
+            if economy:
+                # CVODE setup economy (msbp/dgamrat): the carried
+                # factorization is reused across window boundaries while
+                # the cj ratio stays inside stale_tol, the last window
+                # closed without a Newton failure, and the msbp age cap
+                # holds; only then does the window open pay a refactor.
+                # The refresh branch is a select, and select_n evaluates
+                # BOTH operands — batched or not — so the fresh factor is
+                # computed at every window open regardless of reuse; the
+                # counters therefore report per-lane ALGORITHMIC setups
+                # (the established counter convention, obs/counters.py
+                # "liveness" note), NOT elided device compute.  The
+                # device-compute win of the economy family is the
+                # per-attempt -> per-window factorization cadence (shared
+                # with freeze_precond) plus the same-h stale-setup retry;
+                # cross-window reuse itself buys bookkeeping/counter
+                # truth, not flops.
+                econ = carry[k_econ]
+                live0 = carry[5] == RUNNING
+                c_open = h / gamma_tab[order]
+                ratio = jnp.where(econ["c0"] > 0, c_open / econ["c0"],
+                                  jnp.inf)
+                # age counts REUSES (served = age + 1): the cap admits a
+                # reuse only while served-after-reuse <= _ECON_MAX_AGE,
+                # so one factorization serves at most _ECON_MAX_AGE
+                # windows — the msbp backstop, exactly as documented
+                reuse = (econ["ok"] & (jnp.abs(ratio - 1.0) <= stale_tol)
+                         & (econ["age"] + 1 < _ECON_MAX_AGE))
+                need = ~reuse
+                fac_fresh = factor_m(eye - c_open * J, linsolve, y0.dtype)
+                fac = jax.tree.map(lambda a, b: jnp.where(need, a, b),
+                                   fac_fresh, econ["fac"])
+                c0 = jnp.where(need, c_open, econ["c0"])
+                age = jnp.where(need, jnp.asarray(0, dtype=jnp.int32),
+                                econ["age"] + 1)
+                pre = ((lambda b: apply_factor(fac, b, linsolve, y0.dtype)),
+                       c0)
+                if stats:
+                    carry = _count_window_open_econ(carry, need, reuse, age)
+            elif freeze_precond:
                 # build the Newton solver once per window at the opening
                 # c0 = h/gamma_q; attempts inside the window rescale by the
                 # cj-ratio factor instead of re-inverting (CVODE's setup
@@ -630,10 +782,12 @@ def solve(
                 c0 = h / gamma_tab[order]
                 solve0 = make_solve_m(eye - c0 * J, linsolve, y0.dtype)
                 pre = (solve0, c0)
+                if stats:
+                    carry = _count_window_open(carry)
             else:
                 pre = None
-            if stats:
-                carry = _count_window_open(carry)
+                if stats:
+                    carry = _count_window_open(carry)
 
             def win_cond(s):
                 i, nf, c = s
@@ -641,12 +795,37 @@ def solve(
 
             def win_body(s):
                 i, _, c = s
-                c2, nf = step_once(c, J, pre)
+                if economy:
+                    # the setup is CURRENT only on the opening attempt of
+                    # a refreshed window; reused factorizations and every
+                    # in-window attempt are stale — their Newton failures
+                    # retry at the same h (CVODE's CV_FAIL_BAD_J path,
+                    # step_once fac_rej)
+                    c2, nf = step_once(c, J, pre,
+                                       stale_pre=reuse | (i > 0))
+                else:
+                    c2, nf = step_once(c, J, pre)
                 return (i + 1, nf, c2)
 
-            _, _, out = lax.while_loop(
+            _, nf, out = lax.while_loop(
                 win_cond, win_body,
                 (jnp.asarray(0, dtype=jnp.int32), jnp.asarray(False), carry))
+            if economy:
+                # write the economy state back: a clean window close (~nf)
+                # validates the factorization for the next window's test;
+                # a convergence failure invalidates it (the retry window
+                # does a full setup — CVODE's convergence-triggered
+                # refresh).  Held (terminated) lanes keep their state
+                # frozen like the rest of the carry.
+                econ_new = {
+                    "fac": jax.tree.map(
+                        lambda a, b: jnp.where(live0, a, b), fac,
+                        econ["fac"]),
+                    "c0": jnp.where(live0, c0, econ["c0"]),
+                    "ok": jnp.where(live0, ~nf, econ["ok"]),
+                    "age": jnp.where(live0, age, econ["age"]),
+                }
+                out = out[:k_econ] + (econ_new,) + out[k_econ + 1:]
             return out
 
     zero = jnp.asarray(0, dtype=jnp.int32)
@@ -659,10 +838,18 @@ def solve(
         init = init + (jnp.full((64,), -1, dtype=jnp.int8),
                        jnp.zeros((n, n), dtype=y0.dtype))
     if stats:
+        # setup_reuses/precond_age are present whether or not economy is
+        # on (zero without it), so the counter-block schema is uniform
+        # across knob configurations — segmented accumulation and the obs
+        # exports never branch on solver options (obs_report --diff maps
+        # the keys to 0 for pre-PR archived reports)
         init = init + ({"newton_iters": zero, "jac_builds": zero,
                         "factorizations": zero, "err_rejects": zero,
                         "conv_rejects": zero,
+                        "setup_reuses": zero, "precond_age": zero,
                         "order_hist": jnp.zeros((_M,), dtype=jnp.int32)},)
+    if economy:
+        init = init + (econ_init,)
     final = lax.while_loop(cond, body, init)
     (t, D, order, h, n_equal, status, n_acc, n_rej, ts, ys, n_saved,
      obs) = final[:12]
@@ -680,6 +867,12 @@ def solve(
         # n_accepted/n_rejected repeated inside stats so an exported
         # counter block is self-contained (obs/counters.py)
         stats_out = {"n_accepted": n_acc, "n_rejected": n_rej, **final[k]}
+        k += 1
+    state_out = (D, order, h, n_equal)
+    if economy:
+        # the carried factorization joins the opaque resume carry so
+        # segmented sweeps keep their reuse streaks across relaunches
+        state_out = state_out + (final[k],)
     if step_audit:
         # the audit payloads live under stats too (the telemetry surface);
         # the top-level SolveResult fields alias the same arrays
@@ -691,7 +884,7 @@ def solve(
         ts=ts, ys=ys, n_saved=n_saved, h=h,
         observed=obs if observer is not None else None,
         err_prev=jnp.asarray(1.0, dtype=y0.dtype),
-        solver_state=(D, order, h, n_equal),
+        solver_state=state_out,
         tangents=tangents, it_matrix=M_out, accept_ring=ring_out,
         stats=stats_out,
     )

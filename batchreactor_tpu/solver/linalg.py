@@ -11,18 +11,75 @@ lanes (every lane shares the same O(n) sequential factor loop; the inner work
 is (B, n) / (B, n, n) vectorized).
 
 Jacobians here are small (n = n_species <= ~53 for GRI-Mech 3.0), so an
-unblocked right-looking elimination is appropriate; a Pallas-blocked batched
-kernel is the planned upgrade path for large batches.
+unblocked right-looking elimination is appropriate for the f64 path; the
+Pallas-blocked batched f32 kernel this module long flagged as "the planned
+upgrade path for large batches" now exists as :mod:`.linalg_pallas`
+(``linsolve="lu32p"``, auto-selected on TPU at large B x n by
+:func:`resolve_linsolve`).
+
+Two API layers:
+
+* :func:`factor_m` / :func:`apply_factor` — the factorization as a plain
+  array pytree plus a pure apply.  This is the form the BDF setup-economy
+  carry needs (``solver/bdf.py setup_economy=``): a factorization that
+  lives in a ``lax.while_loop`` carry across ``jac_window`` boundaries
+  must be data, not a closure.
+* :func:`make_solve_m` — the legacy closure factory (factor once, return
+  ``solve(b)``), now a thin composition of the two primitives so the two
+  layers cannot drift.
 """
 
 import jax.numpy as jnp
 from jax import lax
+
+#: Newton linear-solver modes (docs/performance.md "Newton linear algebra"):
+#:
+#: ``"lu"``       exact f64 partially pivoted elimination (pure jnp) — the
+#:                CPU / golden-parity mode.
+#: ``"inv32"``    native f32 batched inverse + one f64 iterative-refinement
+#:                pass (refinement restores ~f64 accuracy below cond ~1e7).
+#: ``"inv32nr"``  f32 inverse, no refinement: the inverse only
+#:                preconditions the quasi-Newton corrector, whose fixed
+#:                point is solve-accuracy independent.
+#: ``"inv32f"``   inv32nr with the matvec itself in f32 (residual and
+#:                correction are state-scale, so f32 range suffices) — the
+#:                measured-fastest TPU mode below the lu32p batch regime.
+#: ``"lu32p"``    Pallas-blocked batched f32 LU with partial pivoting
+#:                (:mod:`.linalg_pallas`) — the first hand-written kernel;
+#:                f32-preconditioner accuracy class of inv32f with O(n^3/3)
+#:                factor flops instead of the inverse's O(n^3), for
+#:                f32-tolerant chemistry at large B.
+MODES = ("lu", "inv32", "inv32nr", "inv32f", "lu32p")
+
+#: resolve_linsolve auto-gate: "lu32p" is selected on TPU only when the
+#: sweep's B * n reaches this many lane-equations (the kernel's blocked
+#: structure needs enough parallel systems to beat XLA's batched inverse;
+#: B=1024 GRI lanes (n=53) qualify, small-mechanism or small-B sweeps keep
+#: inv32f).  Bench-protocol constant, overridable per call with an
+#: explicit ``linsolve=``.
+LU32P_MIN_BN = 32768
 
 
 def lu_factor(A):
     """Partially pivoted LU: returns (LU, piv) with L unit-lower in-place.
 
     piv[k] is the row swapped into position k at step k (LAPACK-style ipiv).
+
+    Exactly-singular pivot guard (regression-asserted,
+    tests/test_linalg.py): when the pivot column is identically zero at and
+    below the diagonal — a structurally singular iteration matrix — the
+    elimination substitutes pivot 1.0 (``safe``) instead of dividing by
+    zero.  Without it the multipliers would be inf (nonzero/0) or NaN
+    (0/0), and the rank-1 trailing update would smear NaN across every
+    remaining column (NaN * 0 = NaN), destroying even the NONSINGULAR part
+    of the factorization.  With it the FACTOR is always finite and exact
+    on the nonsingular directions; the zero stays on the diagonal, so a
+    subsequent :func:`lu_solve` returns inf/NaN only in the singular
+    directions.  That is the designed recovery seam: Newton's displacement
+    norm goes non-finite, its ``bad`` gate declares divergence, the step
+    rejects and the controller shrinks h — which re-conditions M = I - cJ.
+    The guard's job is containment (finite factor, detectable solve), not
+    making a singular system solvable.
     """
     n = A.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
@@ -78,6 +135,114 @@ def lu_solve(lu_piv, b):
     return lax.fori_loop(0, n, backward, x)
 
 
+def resolve_linsolve(linsolve, method="bdf", platform=None, batch=None,
+                     n=None):
+    """THE resolution rule for ``linsolve="auto"`` (one knob, one rule —
+    the :func:`batchreactor_tpu.api.resolve_jac_window` convention; shared
+    by the solvers and the sweep drivers so the mode cannot drift between
+    entry points):
+
+    * CPU: ``"lu"`` — exact f64, the golden-parity tier.
+    * accelerators, SDIRK: ``"inv32"`` (its stage solves want the
+      refinement accuracy).
+    * accelerators, BDF: ``"inv32f"`` — except on **TPU** when the
+      caller's batch is known and ``batch * n >= LU32P_MIN_BN``, where the
+      Pallas-blocked batched LU ``"lu32p"`` takes over (same
+      f32-preconditioner accuracy class; the sweep drivers pass their B
+      and state size, the per-lane ``solve()`` entry points don't know B
+      and keep inv32f).
+
+    Explicit modes pass through validated; unknown modes raise here, one
+    place.
+    """
+    if linsolve != "auto":
+        if linsolve not in MODES:
+            raise ValueError(f"unknown linsolve {linsolve!r}; use one of "
+                             f"{MODES + ('auto',)}")
+        return linsolve
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    if platform == "cpu":
+        return "lu"
+    if method != "bdf":
+        return "inv32"
+    if (platform == "tpu" and batch is not None and n is not None
+            and batch * n >= LU32P_MIN_BN):
+        return "lu32p"
+    return "inv32f"
+
+
+def factor_zeros(linsolve, n, dtype):
+    """All-zero factorization pytree for ``linsolve`` at state size ``n``
+    — the cold-start carry the BDF setup economy resumes from (a zero
+    ``c0`` marks it invalid; the first window always does a full setup).
+    Must mirror :func:`factor_m`'s structure leaf for leaf."""
+    if linsolve == "lu":
+        return {"lu": jnp.zeros((n, n), dtype=dtype),
+                "piv": jnp.zeros((n,), dtype=jnp.int32)}
+    if linsolve == "lu32p":
+        from .linalg_pallas import padded_n
+
+        npad = padded_n(n)
+        return {"lu": jnp.zeros((npad, npad), dtype=jnp.float32),
+                "piv": jnp.zeros((npad,), dtype=jnp.int32)}
+    if linsolve == "inv32f":
+        return {"minv": jnp.zeros((n, n), dtype=jnp.float32)}
+    if linsolve == "inv32nr":
+        return {"minv": jnp.zeros((n, n), dtype=dtype)}
+    if linsolve == "inv32":
+        return {"minv": jnp.zeros((n, n), dtype=dtype),
+                "m": jnp.zeros((n, n), dtype=dtype)}
+    raise ValueError(f"unknown linsolve {linsolve!r}")
+
+
+def factor_m(M, linsolve, dtype):
+    """Factor the Newton iteration matrix ``M`` for mode ``linsolve`` into
+    a plain array pytree (leaf layout: :func:`factor_zeros`).  Being data
+    rather than a closure is what lets the factorization ride a
+    ``lax.while_loop`` carry across jac windows (solver/bdf.py
+    ``setup_economy=``) and a segmented sweep's relaunch carry
+    (parallel/sweep.py)."""
+    if linsolve == "lu":
+        LU, piv = lu_factor(M)
+        return {"lu": LU, "piv": piv}
+    if linsolve == "lu32p":
+        from .linalg_pallas import lu32p_factor
+
+        LU, piv = lu32p_factor(M)
+        return {"lu": LU, "piv": piv}
+    Minv32 = jnp.linalg.inv(M.astype(jnp.float32))
+    if linsolve == "inv32f":
+        return {"minv": Minv32}
+    Minv = Minv32.astype(dtype)
+    if linsolve == "inv32nr":
+        return {"minv": Minv}
+    if linsolve == "inv32":
+        return {"minv": Minv, "m": M}
+    raise ValueError(f"unknown linsolve {linsolve!r}")
+
+
+def apply_factor(fac, b, linsolve, dtype):
+    """Solve M x = b given ``fac = factor_m(M, ...)`` — pure, closure-free
+    twin of the solve returned by :func:`make_solve_m`."""
+    if linsolve == "lu":
+        return lu_solve((fac["lu"], fac["piv"]), b)
+    if linsolve == "lu32p":
+        from .linalg_pallas import lu32p_solve
+
+        return lu32p_solve((fac["lu"], fac["piv"]), b).astype(dtype)
+    if linsolve == "inv32f":
+        return (fac["minv"] @ b.astype(jnp.float32)).astype(dtype)
+    if linsolve == "inv32nr":
+        return fac["minv"] @ b
+    if linsolve == "inv32":
+        x = fac["minv"] @ b
+        return x + fac["minv"] @ (b - fac["m"] @ x)
+    raise ValueError(f"unknown linsolve {linsolve!r}")
+
+
 def make_solve_m(M, linsolve, dtype):
     """Newton linear-solver factory shared by solver/sdirk.py and
     solver/bdf.py: "lu" (exact f64 pivoted elimination, CPU), "inv32"
@@ -87,21 +252,11 @@ def make_solve_m(M, linsolve, dtype):
     the quasi-Newton iteration, whose fixed point is solve-accuracy
     independent), "inv32f" (inv32nr with the matvec itself in f32 — the
     residual and correction are state-scale so f32 range suffices;
-    components under f32-tiny flush to zero 28 orders below atol)."""
-    import jax.numpy as jnp
-
-    if linsolve == "lu":
-        lu = lu_factor(M)
-        return lambda b: lu_solve(lu, b)
-    Minv32 = jnp.linalg.inv(M.astype(jnp.float32))
-    if linsolve == "inv32f":
-        return lambda b: (Minv32 @ b.astype(jnp.float32)).astype(dtype)
-    Minv = Minv32.astype(dtype)
-    if linsolve == "inv32nr":
-        return lambda b: Minv @ b
-
-    def solve_m(b):
-        x = Minv @ b
-        return x + Minv @ (b - M @ x)
-
-    return solve_m
+    components under f32-tiny flush to zero 28 orders below atol),
+    "lu32p" (Pallas-blocked batched f32 LU, :mod:`.linalg_pallas` —
+    inv32f's accuracy class at LU's flop count; the large-B TPU mode).
+    Composition of :func:`factor_m` + :func:`apply_factor`, so the
+    closure and carry-pytree forms of every mode are one implementation.
+    """
+    fac = factor_m(M, linsolve, dtype)
+    return lambda b: apply_factor(fac, b, linsolve, dtype)

@@ -1,0 +1,197 @@
+"""Pallas-blocked batched f32 LU with partial pivoting — the framework's
+first hand-written TPU kernel (``linsolve="lu32p"``).
+
+Why a kernel, and why only now (PERF.md "Known non-levers" reserved the
+spot): the f64 chemistry path has no Pallas story (TPU Pallas is native
+f32/bf16), but the Newton *preconditioner* never needed f64 — the inv32*
+modes established that an f32-preconditioned quasi-Newton corrector's
+fixed point is solve-accuracy independent.  At large B the remaining
+preconditioner cost is XLA's batched ``jnp.linalg.inv`` (~2n^3 flops +
+a full triangular inversion it cannot skip); a blocked LU is ~n^3/3
+flops with the trailing updates on the MXU, and pivoted LU is the
+numerically honest factorization for the near-singular iteration
+matrices stiff ignition fronts produce.  ``resolve_linsolve`` turns the
+mode on automatically only on TPU at large B x n
+(``linalg.LU32P_MIN_BN``); everywhere else the elementwise-jnp ``lu``
+and the inv32* modes remain the defaults and the fallback path.
+
+Kernel structure (classic right-looking blocked LU, LAPACK ``getrf``
+shape, one matrix per grid program — ``vmap`` batches it by prepending a
+grid dimension, which is how the sweep's (B, n, n) factorizations map
+onto the chip):
+
+1. the matrix is padded to a multiple of the panel width ``_BLOCK`` with
+   an identity block (pad rows/columns eliminate trivially and can never
+   win a pivot against a live column — see :func:`padded_n`);
+2. each panel of ``_BLOCK`` columns is factored with partial pivoting
+   using masked column/row reductions only (no dynamic lane indexing —
+   Mosaic-friendly), recording LAPACK-style ``ipiv`` entries;
+3. the panel's row swaps are applied to the off-panel columns
+   (delayed ``laswp``), then the panel's unit-lower block back-solves
+   the U12 strip and one ``jnp.dot`` (MXU, ``preferred_element_type``)
+   rank-``_BLOCK`` updates the trailing submatrix.
+
+The solve stays in plain jnp (:func:`lu32p_solve` == ``linalg.lu_solve``
+on the f32 factors): substitution is O(n^2), bandwidth-bound, and runs
+once per Newton iteration inside the step program where XLA fuses it;
+a per-iteration kernel launch has nothing to win there.  The factor —
+the O(n^3) part, once per window (or less, under ``setup_economy``) —
+is the kernel.
+
+``interpret=`` defaults to interpreter mode off-TPU, so the CPU tier-1
+suite runs the kernel path end-to-end (tests/test_linalg.py parity
+matrix) without Mosaic.  The exactly-singular pivot guard mirrors
+``linalg.lu_factor``'s (finite garbage -> Newton divergence -> step
+rejection owns recovery).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: panel width: 8 matches the f32 sublane tile, divides every padded n,
+#: and keeps the per-column masked work small while the trailing update
+#: runs at rank 8 on the MXU.  GRI (n=53 -> npad=56) runs 7 panels.
+_BLOCK = 8
+
+
+def padded_n(n):
+    """Padded size: next multiple of ``_BLOCK``.  The pad block is
+    identity, which factors as itself: pad columns pivot on their own
+    diagonal 1 (live rows hold exact zeros there, and pad rows hold
+    exact zeros in live columns, so no swap ever crosses the boundary
+    and the pad contributes zero fill-in)."""
+    return max(_BLOCK, -(-n // _BLOCK) * _BLOCK)
+
+
+def _lu_kernel(a_ref, lu_ref, piv_ref):
+    npad = a_ref.shape[0]
+    lu_ref[:, :] = a_ref[:, :]
+    ridx = jax.lax.broadcasted_iota(jnp.int32, (npad, 1), 0)
+    cidx_full = jax.lax.broadcasted_iota(jnp.int32, (1, npad), 1)
+
+    for ps in range(0, npad, _BLOCK):
+        pe = ps + _BLOCK
+        bcol = jax.lax.broadcasted_iota(jnp.int32, (1, _BLOCK), 1)
+
+        # ---- panel factorization (masked, value-carried) ------------------
+        def col_step(j, state):
+            P, piv = state                       # (npad, _BLOCK), (_BLOCK, 1)
+            k = ps + j                           # global column index
+            col = jnp.sum(jnp.where(bcol == j, P, 0.0), axis=1,
+                          keepdims=True)         # (npad, 1)
+            cand = jnp.where(ridx >= k, jnp.abs(col), -jnp.inf)
+            # (npad, 1) flat argmax == row index; stays 2D for Mosaic
+            p = jnp.argmax(cand).astype(jnp.int32)
+            # swap rows k <-> p of the panel (masked row exchange)
+            row_k = jnp.sum(jnp.where(ridx == k, P, 0.0), axis=0,
+                            keepdims=True)       # (1, _BLOCK)
+            row_p = jnp.sum(jnp.where(ridx == p, P, 0.0), axis=0,
+                            keepdims=True)
+            P = jnp.where(ridx == k, row_p, jnp.where(ridx == p, row_k, P))
+            col = jnp.sum(jnp.where(bcol == j, P, 0.0), axis=1,
+                          keepdims=True)
+            pivot = jnp.sum(jnp.where(ridx == k, col, 0.0))
+            # singular-pivot guard, same contract as linalg.lu_factor
+            safe = jnp.where(jnp.abs(pivot) > 0, pivot, 1.0)
+            factor = jnp.where(ridx > k, col / safe, 0.0)
+            # rank-1 update of the panel columns strictly right of j
+            row_k_new = jnp.sum(jnp.where(ridx == k, P, 0.0), axis=0,
+                                keepdims=True)
+            row_masked = jnp.where(bcol > j, row_k_new, 0.0)
+            P = P - factor * row_masked
+            # write the multipliers into column j below the diagonal
+            P = jnp.where((bcol == j) & (ridx > k), factor, P)
+            piv = jax.lax.dynamic_update_slice(
+                piv, p.reshape(1, 1), (j, 0))
+            return P, piv
+
+        P0 = lu_ref[:, ps:pe]
+        piv0 = jnp.zeros((_BLOCK, 1), dtype=jnp.int32)
+        P, piv = jax.lax.fori_loop(0, _BLOCK, col_step, (P0, piv0))
+        lu_ref[:, ps:pe] = P
+        piv_ref[ps:pe, :] = piv
+
+        # ---- delayed laswp: apply the panel's swaps to off-panel columns --
+        off_panel = (cidx_full < ps) | (cidx_full >= pe)
+
+        def swap_step(j, _):
+            k = ps + j
+            p = jax.lax.dynamic_slice(piv, (j, 0), (1, 1))[0, 0]
+            rk = lu_ref[pl.ds(k, 1), :]
+            rp = lu_ref[pl.ds(p, 1), :]
+            lu_ref[pl.ds(k, 1), :] = jnp.where(off_panel, rp, rk)
+            lu_ref[pl.ds(p, 1), :] = jnp.where(off_panel, rk, rp)
+            return 0
+
+        jax.lax.fori_loop(0, _BLOCK, swap_step, 0)
+
+        if pe < npad:
+            # ---- U12 strip: L11^{-1} (unit lower) applied to the trailing
+            # columns of the panel rows, as _BLOCK masked rank-1 sweeps ----
+            L11 = P[ps:pe, :]                    # (_BLOCK, _BLOCK)
+            T = lu_ref[ps:pe, pe:]               # (_BLOCK, W)
+            r_small = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK, 1), 0)
+            c_small = jax.lax.broadcasted_iota(jnp.int32, (1, _BLOCK), 1)
+
+            def trsm_step(j, T):
+                lcol = jnp.sum(jnp.where(c_small == j, L11, 0.0), axis=1,
+                               keepdims=True)    # (_BLOCK, 1)
+                trow = jnp.sum(jnp.where(r_small == j, T, 0.0), axis=0,
+                               keepdims=True)    # (1, W)
+                return T - jnp.where(r_small > j, lcol, 0.0) * trow
+
+            T = jax.lax.fori_loop(0, _BLOCK, trsm_step, T)
+            lu_ref[ps:pe, pe:] = T
+            # ---- trailing update: A22 -= L21 @ U12 (MXU) ------------------
+            L21 = P[pe:, :]                      # (npad - pe, _BLOCK)
+            lu_ref[pe:, pe:] = lu_ref[pe:, pe:] - jnp.dot(
+                L21, T, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _lu32p_factor_padded(Ap, interpret):
+    npad = Ap.shape[-1]
+    LU, piv = pl.pallas_call(
+        _lu_kernel,
+        out_shape=(jax.ShapeDtypeStruct((npad, npad), jnp.float32),
+                   jax.ShapeDtypeStruct((npad, 1), jnp.int32)),
+        interpret=interpret,
+    )(Ap)
+    return LU, piv[:, 0]
+
+
+def lu32p_factor(A, interpret=None):
+    """Blocked, partially pivoted f32 LU of one (n, n) matrix (``vmap``
+    over lanes for the batched sweep form).  Returns ``(LU, piv)`` on the
+    PADDED size (:func:`padded_n`): LU unit-lower in-place, LAPACK-style
+    ``ipiv`` — the same contract as :func:`linalg.lu_factor`, in f32.
+
+    ``interpret=None`` resolves to interpreter mode off-TPU (the CPU
+    tier-1 suite exercises the kernel path without Mosaic); pass
+    ``False``/``True`` to force."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = A.shape[-1]
+    npad = padded_n(n)
+    Ap = jnp.eye(npad, dtype=jnp.float32).at[:n, :n].set(
+        A.astype(jnp.float32))
+    return _lu32p_factor_padded(Ap, interpret)
+
+
+def lu32p_solve(lu_piv, b):
+    """Substitution solve on :func:`lu32p_factor` output: f32 in, f32
+    out, padded internally (pad rows solve to exact 0 against the
+    identity pad block).  Plain jnp on purpose — O(n^2), run per Newton
+    iteration, fused by XLA into the step program; the kernel owns only
+    the O(n^3) factor."""
+    from .linalg import lu_solve
+
+    LU, piv = lu_piv
+    npad = LU.shape[-1]
+    n = b.shape[-1]
+    bp = jnp.zeros((npad,), dtype=jnp.float32).at[:n].set(
+        b.astype(jnp.float32))
+    return lu_solve((LU, piv), bp)[:n]

@@ -28,7 +28,8 @@ import numpy as np
 from jax import lax
 
 from ..utils.pytree import pytree_dataclass
-from .linalg import lu_factor, lu_solve, make_solve_m  # noqa: F401
+from .linalg import (lu_factor, lu_solve, make_solve_m,  # noqa: F401
+                     resolve_linsolve)
 
 # --- SDIRK4 tableau (Hairer & Wanner II, Table 6.5; gamma = 1/4) ---
 _GAMMA = 0.25
@@ -175,11 +176,13 @@ def solve(
     span = t1 - t0
     eye = jnp.eye(n, dtype=y0.dtype)
 
-    if linsolve == "auto":
-        linsolve = "lu" if jax.default_backend() == "cpu" else "inv32"
-    if linsolve not in ("lu", "inv32", "inv32nr", "inv32f"):
-        raise ValueError(f"unknown linsolve {linsolve!r}; use "
-                         f"'lu'/'inv32'/'inv32nr'/'inv32f'/'auto'")
+    # shared resolution rule (linalg.resolve_linsolve, one place): "lu" on
+    # CPU, "inv32" on accelerators for SDIRK — its 5 sequential stage
+    # solves want the refinement accuracy, and never auto-select "lu32p"
+    # (the M = I - h*gamma*J factorization is h-fresh every attempt, so
+    # the batched-LU regime the BDF sweep reaches doesn't arise here);
+    # explicit modes, lu32p included, pass through validated
+    linsolve = resolve_linsolve(linsolve, method="sdirk")
 
     f = functools.partial(rhs, cfg=cfg)
     if jac is None:

@@ -211,6 +211,21 @@ def rung_main():
     if method == "sdirk":
         solver_kw["newton_tol"] = float(
             os.environ.get("BENCH_NEWTON_TOL", "0.03"))
+    # setup economy (BDF, jac_window>1; the r06 bench-protocol default):
+    # CVODE-style cross-window setup economy — the carried factorization
+    # is refreshed only on a cj-ratio breach / Newton failure instead of
+    # every window open (solver/bdf.py setup_economy=; BENCH_ECONOMY=0
+    # reverts to the r05 refactor-every-window configuration,
+    # BENCH_STALE_TOL tunes the dgamrat threshold).  The rung json
+    # records the knob and the RESOLVED linsolve mode, so a BENCH round
+    # can cite which Newton linear algebra actually ran (lu32p
+    # self-selects on TPU at large B x n).
+    econ_default = "1" if method == "bdf" else "0"
+    economy = os.environ.get("BENCH_ECONOMY", econ_default) == "1"
+    if method == "bdf":
+        solver_kw["setup_economy"] = economy
+        if "BENCH_STALE_TOL" in os.environ:
+            solver_kw["stale_tol"] = float(os.environ["BENCH_STALE_TOL"])
     with ph("parse"):
         gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
         th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
@@ -286,10 +301,20 @@ def rung_main():
     # the blocking per-segment host loop, BENCH_POLL_EVERY sets the
     # termination-poll stride; ONE resolution rule, parallel/sweep.py)
     gear, stride = resolve_pipeline_defaults()
+    from batchreactor_tpu.solver.linalg import resolve_linsolve
+    # the rung runs BUCKETLESS (no buckets= above), so the live B *is*
+    # the lane count the sweep resolves with; if buckets ever joins the
+    # rung, resolve with the padded bucket size here or the recorded
+    # mode can diverge from the one that actually ran
+    linsolve_resolved = resolve_linsolve(
+        os.environ.get("BENCH_LINSOLVE", "auto"), method=method,
+        platform=jax.default_backend(), batch=B, n=len(sp))
     print(json.dumps({
         "B": B, "method": method, "wall_s": round(wall, 3),
         "cps": round(B / wall, 3),
         "pipeline": gear, "poll_every": stride,
+        "linsolve": linsolve_resolved,
+        "economy": economy if method == "bdf" else False,
         "n_ok": n_ok,
         "warm_s": round(t_warm, 1),
         # compile economy split (aot/ program store): true XLA compiles
@@ -484,6 +509,30 @@ def emit_result(best, state, cached_tpu=False):
     print(json.dumps(out))
 
 
+def parse_args(argv):
+    """CLI for the parent orchestrator.  ``--help`` must never run the
+    ladder (it used to: any invocation executed main() and clobbered
+    bench_partial.json); with no arguments the behavior is byte-identical
+    to the pre-CLI bench.  Child subprocesses re-exec this file with
+    BENCH_MODE set and no argv, so the flags only shape the parent."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="GRI-Mech 3.0 ignition-sweep throughput bench "
+                    "(module docstring has the full protocol; env knobs: "
+                    "BENCH_B/BENCH_LADDER/BENCH_METHOD/BENCH_JAC_WINDOW/"
+                    "BENCH_LINSOLVE/BENCH_ECONOMY/BENCH_OBS/...)")
+    p.add_argument("--rungs",
+                   help="comma-separated batch-size ladder, e.g. 64,256,"
+                        "1024 (same meaning as BENCH_LADDER; the flag "
+                        "wins over the env)")
+    p.add_argument("--out",
+                   help=f"path for the per-rung progress artifact "
+                        f"(default {os.path.basename(PARTIAL)} next to "
+                        f"this file)")
+    return p.parse_args(argv)
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "")
     if mode == "cpu_probe":
@@ -493,4 +542,9 @@ if __name__ == "__main__":
     elif mode == "rung":
         rung_main()
     else:
+        args = parse_args(sys.argv[1:])
+        if args.rungs:
+            os.environ["BENCH_LADDER"] = args.rungs  # main() reads it
+        if args.out:
+            PARTIAL = os.path.abspath(args.out)
         main()

@@ -48,6 +48,33 @@ SECTIONS = [
       "write_jsonl", "read_jsonl"]),
     ("Solvers", "batchreactor_tpu.solver.bdf", ["solve"]),
     ("Solvers (SDIRK)", "batchreactor_tpu.solver.sdirk", ["solve"]),
+    # the intro (4th element) carries the mode table — docstring first
+    # paragraphs are prose-wrapped, so tables live here
+    ("Newton linear algebra", "batchreactor_tpu.solver.linalg",
+     ["resolve_linsolve", "factor_m", "apply_factor", "make_solve_m"],
+     """\
+`linsolve=` picks how each Newton correction solves M dx = r
+(M = I - cJ).  Modes (semantics: `solver/linalg.MODES`; performance:
+docs/performance.md "Newton linear algebra"):
+
+| mode      | arithmetic                              | accuracy class        | when |
+|-----------|-----------------------------------------|-----------------------|------|
+| `lu`      | f64 pivoted elimination (pure jnp)      | exact / golden parity | CPU default; f64 fallback everywhere |
+| `inv32`   | f32 inverse + one f64 refinement pass   | ~f64 below cond 1e7   | accelerator SDIRK default |
+| `inv32nr` | f32 inverse, no refinement              | f32 preconditioner    | explicit opt-in |
+| `inv32f`  | f32 inverse and f32 matvec              | f32 preconditioner    | accelerator BDF default |
+| `lu32p`   | Pallas-blocked batched f32 LU (pivoted) | f32 preconditioner    | TPU BDF at `B * n >= LU32P_MIN_BN` (32768) |
+
+`"auto"` follows ONE resolution rule — `resolve_linsolve`, the
+`resolve_jac_window` convention, shared by every entry point so the mode
+cannot drift between them.  `lu32p` runs the hand-written kernel in
+`solver/linalg_pallas.py` (`interpret=` defaults to interpreter mode
+off-TPU, so CPU CI exercises the same program).  The related BDF knobs
+`setup_economy=` / `stale_tol=` (CVODE msbp/dgamrat setup economy,
+docs/performance.md "Newton setup economy") reuse the carried
+factorization across `jac_window` boundaries until `|c/c0 - 1| >
+stale_tol` (default 0.3) or a Newton convergence failure forces a
+refresh."""),
     ("Kinetics kernels", "batchreactor_tpu.ops.rhs",
      ["make_gas_rhs", "make_gas_jac", "make_surface_rhs",
       "make_surface_jac", "make_udf_rhs"]),
@@ -71,9 +98,11 @@ def render():
              "Generated from live docstrings by `scripts/gen_api_docs.py` "
              "— do not edit by hand (CI checks freshness).",
              ""]
-    for title, modname, names in SECTIONS:
+    for title, modname, names, *intro in SECTIONS:
         mod = importlib.import_module(modname)
         lines += [f"## {title} (`{modname}`)", ""]
+        if intro:
+            lines += [intro[0], ""]
         for name in names:
             obj = getattr(mod, name, None)
             if obj is None:

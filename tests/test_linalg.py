@@ -1,0 +1,192 @@
+"""Newton linear algebra (solver/linalg.py + solver/linalg_pallas.py).
+
+Three contracts pinned here:
+
+* the exactly-singular pivot guard — downstream Newton-divergence
+  recovery (bdf/sdirk ``bad`` gate -> step rejection -> h shrink) is
+  ASSERTED, not assumed: the factor stays finite, the solve goes
+  non-finite only through the singular directions, and the displacement
+  norm the Newton gate reads is non-finite;
+* jnp-LU vs Pallas-LU parity (interpret mode — the CPU tier-1 suite
+  runs the kernel path end-to-end without Mosaic) on batched random
+  systems including pivoting-required and near-singular matrices;
+* the factor-as-data layer (``factor_zeros``/``factor_m``/
+  ``apply_factor``) that the BDF setup-economy carry rides: structure
+  match leaf-for-leaf and closure/carry-form equivalence.
+
+Everything is tiny (n <= 13, B <= 8): pure-linalg compiles, no
+mechanism parses, well inside the tier-1 budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchreactor_tpu.solver import linalg
+from batchreactor_tpu.solver.linalg import (MODES, apply_factor, factor_m,
+                                            factor_zeros, lu_factor,
+                                            lu_solve, make_solve_m,
+                                            resolve_linsolve)
+from batchreactor_tpu.solver.linalg_pallas import (lu32p_factor, lu32p_solve,
+                                                   padded_n)
+
+
+# ---------------------------------------------------------------- parity
+
+@pytest.mark.parametrize("n", [1, 3, 5, 8, 13])
+def test_lu32p_matches_numpy_batched(n):
+    """Blocked Pallas LU (interpret on CPU) solves batched random systems
+    to f32 accuracy — the inv32* preconditioner accuracy class."""
+    rng = np.random.default_rng(n)
+    A = rng.standard_normal((8, n, n))
+    b = rng.standard_normal((8, n))
+    x_ref = np.linalg.solve(A, b[..., None])[..., 0]
+    LU, piv = jax.vmap(lu32p_factor)(jnp.asarray(A))
+    x = jax.vmap(lu32p_solve)((LU, piv), jnp.asarray(b, dtype=jnp.float32))
+    scale = np.max(np.abs(x_ref), axis=-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(x), x_ref, atol=2e-5 * scale.max(),
+                               rtol=2e-5)
+
+
+def test_lu32p_padding_shape():
+    assert padded_n(1) == 8 and padded_n(8) == 8 and padded_n(9) == 16
+    LU, piv = lu32p_factor(jnp.eye(5))
+    assert LU.shape == (8, 8) and piv.shape == (8,)
+
+
+def test_lu32p_pivoting_required():
+    """Zero diagonal: unpivoted elimination would divide by zero at step
+    0 — partial pivoting is load-bearing, not an optimization."""
+    A = jnp.asarray([[0.0, 1.0, 0.0],
+                     [2.0, 0.0, 1.0],
+                     [0.0, 3.0, 1.0]])
+    b = jnp.asarray([1.0, 2.0, 3.0], dtype=jnp.float32)
+    x_ref = np.linalg.solve(np.asarray(A), np.asarray(b))
+    x = lu32p_solve(lu32p_factor(A), b)
+    np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-5, atol=1e-5)
+    # and the jnp reference path agrees with itself on the same system
+    xj = lu_solve(lu_factor(A.astype(jnp.float64)),
+                  b.astype(jnp.float64))
+    np.testing.assert_allclose(np.asarray(xj), x_ref, rtol=1e-12, atol=1e-14)
+
+
+def test_lu32p_near_singular_parity_with_jnp_f32():
+    """Near-singular (cond ~1e5) systems: the two factorizations must
+    agree to the accuracy f32 conditioning permits — the stiff-ignition
+    iteration matrices the mode exists for are exactly this class."""
+    rng = np.random.default_rng(7)
+    U, _ = np.linalg.qr(rng.standard_normal((6, 6)))
+    V, _ = np.linalg.qr(rng.standard_normal((6, 6)))
+    A = (U * np.logspace(0, -5, 6)) @ V  # singular values 1 .. 1e-5
+    b = rng.standard_normal(6)
+    x_ref = np.linalg.solve(A, b)
+    x_p = lu32p_solve(lu32p_factor(jnp.asarray(A)),
+                      jnp.asarray(b, dtype=jnp.float32))
+    # f32 forward error bound ~ cond * eps32 ~ 1e5 * 1e-7 = 1e-2 relative
+    rel = np.max(np.abs(np.asarray(x_p) - x_ref)) / np.max(np.abs(x_ref))
+    assert rel < 5e-2, rel
+
+
+# ------------------------------------------- exactly-singular pivot guard
+
+def _singular():
+    # third column identically zero: structurally singular, pivot 0 at k=2
+    return jnp.asarray([[1.0, 2.0, 0.0],
+                        [3.0, 4.0, 0.0],
+                        [5.0, 6.0, 0.0]])
+
+
+def test_singular_pivot_guard_factor_finite_solve_detectable():
+    """The documented recovery seam (linalg.lu_factor docstring): the
+    FACTOR is always finite (no NaN smear into nonsingular columns), the
+    solve goes non-finite through the singular directions, and the
+    displacement norm Newton's ``bad`` gate reads is non-finite — which
+    is what turns a singular iteration matrix into a step rejection
+    instead of a silent wrong answer."""
+    LU, piv = lu_factor(_singular())
+    assert bool(jnp.all(jnp.isfinite(LU))), np.asarray(LU)
+    x = lu_solve((LU, piv), jnp.asarray([1.0, 1.0, 1.0]))
+    assert not bool(jnp.all(jnp.isfinite(x)))
+    # the exact gate expression bdf.newton applies to the displacement
+    dw = jnp.sqrt(jnp.mean(jnp.square(x / 1.0)))
+    assert not bool(jnp.isfinite(dw))
+
+
+def test_singular_pivot_guard_pallas_matches_contract():
+    """Same containment contract on the kernel path (interpret mode)."""
+    LU, piv = lu32p_factor(_singular())
+    assert bool(jnp.all(jnp.isfinite(LU))), np.asarray(LU)
+    x = lu32p_solve((LU, piv), jnp.asarray([1.0, 1.0, 1.0],
+                                           dtype=jnp.float32))
+    assert not bool(jnp.all(jnp.isfinite(x)))
+
+
+def test_singular_system_inside_newton_rejects_not_poisons():
+    """End-to-end recovery: a solve whose very first iteration matrix is
+    singular (rhs rows linearly dependent at y0) must not return NaN with
+    SUCCESS — either it converges after step-size recovery or it reports
+    a failure status."""
+    from batchreactor_tpu.solver import bdf
+    from batchreactor_tpu.solver.sdirk import SUCCESS
+
+    def rhs(t, y, cfg):
+        # f(y) has rank-deficient Jacobian at y=0 (rows 0 and 1 equal)
+        r = y[0] + y[1]
+        return jnp.stack([-r, -r, -y[2]])
+
+    r = bdf.solve(rhs, jnp.asarray([1.0, 1.0, 1.0]), 0.0, 1.0, {},
+                  rtol=1e-6, atol=1e-10)
+    if int(r.status) == SUCCESS:
+        assert bool(jnp.all(jnp.isfinite(r.y)))
+
+
+# ------------------------------------------------- factor-as-data layer
+
+@pytest.mark.parametrize("mode", MODES)
+def test_factor_zeros_matches_factor_m_structure(mode):
+    """The economy cold-start carry must mirror factor_m leaf for leaf —
+    a shape/dtype mismatch would restructure the while-loop carry at the
+    first window open (a trace error at best, a recompile at worst)."""
+    n = 5
+    M = jnp.eye(n, dtype=jnp.float64) * 2.0
+    fz = factor_zeros(mode, n, jnp.float64)
+    fm = factor_m(M, mode, jnp.float64)
+    assert jax.tree.structure(fz) == jax.tree.structure(fm)
+    for a, b in zip(jax.tree.leaves(fz), jax.tree.leaves(fm)):
+        assert a.shape == b.shape and a.dtype == b.dtype, (mode, a, b)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_apply_factor_is_make_solve_m(mode):
+    """Closure and carry forms are one implementation (linalg docstring):
+    identical bits out."""
+    rng = np.random.default_rng(3)
+    M = jnp.asarray(rng.standard_normal((5, 5)) + 5 * np.eye(5))
+    b = jnp.asarray(rng.standard_normal(5))
+    via_closure = make_solve_m(M, mode, jnp.float64)(b)
+    via_carry = apply_factor(factor_m(M, mode, jnp.float64), b, mode,
+                             jnp.float64)
+    np.testing.assert_array_equal(np.asarray(via_closure),
+                                  np.asarray(via_carry))
+
+
+# ------------------------------------------------------- resolution rule
+
+def test_resolve_linsolve_one_rule():
+    assert resolve_linsolve("auto", platform="cpu") == "lu"
+    assert resolve_linsolve("auto", method="sdirk", platform="tpu") == "inv32"
+    assert resolve_linsolve("auto", method="bdf", platform="tpu") == "inv32f"
+    # the lu32p gate: TPU + BDF + known batch at/over the lane-equation
+    # floor; small sweeps and batch-blind per-lane entry points keep inv32f
+    big_b = linalg.LU32P_MIN_BN // 53 + 1
+    assert resolve_linsolve("auto", method="bdf", platform="tpu",
+                            batch=big_b, n=53) == "lu32p"
+    assert resolve_linsolve("auto", method="bdf", platform="tpu",
+                            batch=4, n=53) == "inv32f"
+    assert resolve_linsolve("auto", method="bdf", platform="gpu",
+                            batch=big_b, n=53) == "inv32f"
+    # explicit modes pass through validated; unknown raises in ONE place
+    assert resolve_linsolve("lu32p", platform="cpu") == "lu32p"
+    with pytest.raises(ValueError, match="unknown linsolve"):
+        resolve_linsolve("qr")

@@ -433,6 +433,26 @@ def test_diff_pre_aot_compile_schema():
     assert "compile cache_hits: 0 -> 3" in obs.diff(old, new2)
 
 
+def test_diff_pre_economy_solver_schema():
+    # same convention for the setup-economy counters: archived reports
+    # predating setup_reuses/precond_age read as 0, not as a difference
+    old = {"solver_stats": {"totals": {"jac_builds": 10,
+                                       "factorizations": 10}}}
+    new = {"solver_stats": {"totals": {"jac_builds": 10,
+                                       "factorizations": 10,
+                                       "setup_reuses": 0,
+                                       "precond_age": 0}}}
+    d = obs.diff(old, new)
+    assert "setup_reuses" not in d and "precond_age" not in d
+    econ = {"solver_stats": {"totals": {"jac_builds": 10,
+                                        "factorizations": 4,
+                                        "setup_reuses": 6,
+                                        "precond_age": 3}}}
+    d2 = obs.diff(old, econ)
+    assert "solver setup_reuses: 0 -> 6" in d2
+    assert "solver factorizations: 10 -> 4" in d2
+
+
 # ---------------------------------------------------------------------------
 # API integration (the acceptance-criterion path)
 # ---------------------------------------------------------------------------

@@ -298,6 +298,95 @@ class TestSweepAPI:
         assert out["x"]["H2O"][1] > out["x"]["H2O"][0]
 
 
+class TestSetupEconomy:
+    """CVODE-style Newton setup economy (``setup_economy=True``) on the
+    north-star regression workload shape: the ``factorizations <
+    jac_builds`` acceptance criterion, trajectory tolerance vs the
+    economy-off run, and the structural no-op guarantee at
+    ``jac_window=1`` (docs/performance.md "Newton setup economy")."""
+
+    def test_economy_counters_and_tau_parity(self, h2o2):
+        """Economy run on the small T-grid ignition sweep: reuse fires
+        (``setup_reuses > 0``), ``factorizations`` drops strictly below
+        ``jac_builds`` (the window-open count), the exact partition
+        ``setup_reuses + factorizations == jac_builds`` holds, and the
+        ignition delays stay at tolerance scale of the economy-off run."""
+        gm, th = h2o2
+        outs = {}
+        for econ in (False, True):
+            outs[econ] = br.batch_reactor_sweep(
+                {"H2": 0.25, "O2": 0.25, "N2": 0.5},
+                jnp.linspace(1200.0, 1400.0, 3), 1e5, 2e-3,
+                chem=br.Chemistry(gaschem=True), thermo_obj=th, md=gm,
+                method="bdf", jac_window=8, setup_economy=econ,
+                telemetry=True, ignition_marker="H2")
+            assert outs[econ]["report"]["counts"]["success"] == 3
+        tot = outs[True]["telemetry"]["solver_stats"]["totals"]
+        assert tot["setup_reuses"] > 0, tot
+        assert tot["factorizations"] < tot["jac_builds"], tot
+        assert (tot["setup_reuses"] + tot["factorizations"]
+                == tot["jac_builds"]), tot
+        # a factorization that was ever reused served >= 2 windows
+        assert tot["precond_age"] >= 2, tot
+        # economy-off control: no reuse, and M is rebuilt c-correct every
+        # attempt (factorizations >= window opens); economy froze in-window
+        # AND across windows, so its factorization count is strictly lower
+        base = outs[False]["telemetry"]["solver_stats"]["totals"]
+        assert base["setup_reuses"] == 0, base
+        assert base["factorizations"] >= base["jac_builds"], base
+        assert tot["factorizations"] < base["factorizations"], (tot, base)
+        # quasi-Newton preconditioning leaves the corrector fixed point
+        # alone: ignition delays agree at tolerance scale
+        np.testing.assert_allclose(np.asarray(outs[True]["tau"]),
+                                   np.asarray(outs[False]["tau"]),
+                                   rtol=1e-3)
+
+    def test_economy_survives_segment_relaunches(self, h2o2):
+        """The economy state joins the segment carry (solver_state), so
+        reuse streaks cross segment relaunches: the counter partition
+        holds on segmented totals and reuse still fires."""
+        gm, th = h2o2
+        out = br.batch_reactor_sweep(
+            {"H2": 0.25, "O2": 0.25, "N2": 0.5},
+            jnp.array([1200.0, 1350.0]), 1e5, 2e-3,
+            chem=br.Chemistry(gaschem=True), thermo_obj=th, md=gm,
+            method="bdf", jac_window=8, setup_economy=True,
+            segment_steps=64, telemetry=True)
+        assert out["report"]["counts"]["success"] == 2
+        tot = out["telemetry"]["solver_stats"]["totals"]
+        assert tot["setup_reuses"] > 0, tot
+        assert (tot["setup_reuses"] + tot["factorizations"]
+                == tot["jac_builds"]), tot
+
+    def test_economy_noop_at_jac_window1(self):
+        """At ``jac_window=1`` economy is structurally meaningless (every
+        attempt refactors anyway): the knob must be a NO-OP — identical
+        traced program, bit-identical trajectories."""
+        from batchreactor_tpu.solver import bdf
+
+        def rob(t, y, cfg):
+            k1, k2, k3 = 0.04, 3e7, 1e4
+            d0 = -k1 * y[0] + k3 * y[1] * y[2]
+            d2 = k2 * y[1] * y[1]
+            return jnp.stack([d0, -d0 - d2, d2])
+
+        y0 = jnp.asarray([1.0, 0.0, 0.0])
+
+        def run(econ, y=y0):
+            return bdf.solve(rob, y, 0.0, 1e2, {}, rtol=1e-8, atol=1e-12,
+                             n_save=16, jac_window=1, setup_economy=econ)
+
+        jaxprs = {e: str(jax.make_jaxpr(lambda y, e=e: run(e, y).y)(y0))
+                  for e in (False, True)}
+        assert jaxprs[True] == jaxprs[False]
+        r_off, r_on = run(False), run(True)
+        assert int(r_on.status) == SUCCESS
+        np.testing.assert_array_equal(np.asarray(r_on.ys),
+                                      np.asarray(r_off.ys))
+        np.testing.assert_array_equal(np.asarray(r_on.y),
+                                      np.asarray(r_off.y))
+
+
 def test_northstar_sweep_small(gri_lib_dir, tmp_path):
     """CPU-sized regression of the north-star workload machinery
     (scripts/northstar_sweep.py): T x phi GRI grid through the checkpointed
