@@ -42,6 +42,13 @@ Pallas kernel path (solver/linalg_pallas.py):
 * **kernel-missing** — a ``linsolve="lu32p"`` step program must
   actually contain the ``pallas_call`` primitive (a silent fallback to
   the jnp path would keep tests green while the kernel never runs).
+
+A seventh audit backs the fault-tolerance layer (``resilience/``):
+
+* **resilience-noop-fork** — the wedge watchdog, fault injection, and
+  retry/quarantine machinery are host-side by contract; tracing the
+  segment program with the layer fully armed (injection plan +
+  ``BR_FETCH_DEADLINE_S``) must yield a byte-identical jaxpr.
 """
 
 import functools
@@ -352,4 +359,37 @@ def run_audit(fixtures_dir=None):
                 f"{[b for b, _ in traced]} in bucket {bucket} are not "
                 f"jaxpr-identical: the padding path leaks the original "
                 f"batch size into the trace (bucket-miss hazard)"))
+
+    # resilience no-op (resilience/ — docs/robustness.md): the fault-
+    # tolerance layer is host-side BY CONTRACT — watchdog deadlines,
+    # armed fault-injection plans, retry/quarantine policies must never
+    # reach a traced program.  Trace the segment program with the layer
+    # fully armed (injection plan + fetch-deadline env lever) and
+    # require byte-identity with the unarmed trace — the same invariance
+    # class as economy-noop-fork, guarding against a future deadline or
+    # injection hook leaking into the trace.
+    from ..resilience import inject as _inject
+
+    carry_r = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
+                                         False, 8)
+    j_unarmed = str(jax.make_jaxpr(_run_seg(plain_seg_fn, cfgb))(carry_r))
+    prev_deadline = os.environ.get("BR_FETCH_DEADLINE_S")
+    _inject.arm("hang_fetch:delay=0.01;nan_lane:lane=0")
+    os.environ["BR_FETCH_DEADLINE_S"] = "5"
+    try:
+        j_armed = str(jax.make_jaxpr(_run_seg(plain_seg_fn, cfgb))(carry_r))
+    finally:
+        _inject.disarm()
+        if prev_deadline is None:
+            os.environ.pop("BR_FETCH_DEADLINE_S", None)
+        else:
+            os.environ["BR_FETCH_DEADLINE_S"] = prev_deadline
+    if j_unarmed != j_armed:
+        findings.append(Finding(
+            "resilience-noop-fork", "<jaxpr:segment-resilience-noop>",
+            0, 0,
+            "arming the resilience layer (fault injection + watchdog "
+            "deadline) changed the traced segment program: the fault-"
+            "tolerance plumbing leaked into the trace (resilience/ "
+            "host-side contract, docs/robustness.md)"))
     return findings

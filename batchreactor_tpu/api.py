@@ -776,7 +776,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         ignition_mode="half", method="bdf", jac_window=None,
                         linsolve="auto", setup_economy=False, stale_tol=0.3,
                         analytic_jac=True, telemetry=False, pipeline=None,
-                        poll_every=None, buckets=None):
+                        poll_every=None, buckets=None, fetch_deadline=None,
+                        quarantine=None):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -869,6 +870,28 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     session with ``scripts/warm_cache.py`` (:mod:`batchreactor_tpu.aot`).
     The knob is validated here, up front; the resolved bucket lands in
     the telemetry meta as ``bucket``.
+
+    ``fetch_deadline`` (segmented runs only — explicit with
+    ``segment_steps=0`` raises, the pipeline/poll_every loudness
+    convention) arms the resilience wedge watchdog on the segmented
+    driver's blocking fetches: a breach marks the device suspect, emits
+    a ``fault`` event into the telemetry, and raises
+    ``resilience.WedgeError`` instead of hanging the session
+    (docs/robustness.md); ``None`` resolves from ``BR_FETCH_DEADLINE_S``
+    (unset = off).
+
+    ``quarantine`` (None/True/dict/``resilience.QuarantinePolicy``)
+    recovers non-success lanes instead of reporting them failed: a
+    same-settings full-batch retry pass (bit-exact for transient
+    faults), then a tighter-tolerance fallback pass
+    (``rtol_factor``/``atol_factor``/``max_steps_factor``), then — with
+    ``oracle=True`` in the policy — a per-lane cross-check against the
+    ``native/`` CPU BDF.  ``out["provenance"]`` carries the per-lane
+    recovery code (``resilience.quarantine.PROVENANCE_NAMES``),
+    ``out["report"]["quarantine"]`` the counts, and the quarantine
+    counters/events ride the telemetry report.  Purely host-side: the
+    traced sweep programs are unchanged (brlint tier-B
+    ``resilience-noop-fork``).
     """
     from .parallel import (ensemble_solve, ensemble_solve_segmented,
                            sweep_report)
@@ -878,15 +901,22 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     if chem is None or thermo_obj is None:
         raise TypeError("batch_reactor_sweep needs chem= and thermo_obj=")
     if segment_steps <= 0 and (pipeline is not None
-                               or poll_every is not None):
+                               or poll_every is not None
+                               or fetch_deadline is not None):
         # loudness convention (cf. jac_window with backend='cpu'): these
         # knobs shape the segmented driver only — silently ignoring them
         # on the monolithic path would report a configuration that never
         # ran.  Checked up front with the other argument validation, so
         # the error fires before any mechanism parsing happens.
         raise ValueError(
-            "pipeline/poll_every are segmented-path knobs; set "
-            "segment_steps > 0 or drop the arguments")
+            "pipeline/poll_every/fetch_deadline are segmented-path knobs; "
+            "set segment_steps > 0 or drop the arguments")
+    # normalize the quarantine policy up front (loud ValueError on a bad
+    # spec — resilience/policy.py is the one validation point), before
+    # any mechanism parsing happens
+    from .resilience.policy import normalize_quarantine
+
+    qpol = normalize_quarantine(quarantine)
     # canonicalize the bucket ladder up front (loud ValueError on a bad
     # spec — aot/buckets.py is the one validation point), before any
     # mechanism parsing happens
@@ -1050,6 +1080,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                                            recorder=rec,
                                            pipeline=pipeline,
                                            poll_every=poll_every,
+                                           fetch_deadline=fetch_deadline,
                                            watch=watch if telemetry
                                            else None, **common)
         else:
@@ -1058,7 +1089,71 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         if telemetry:
             jax.block_until_ready(res.y)
     res = unpad_result(res, B)
+    cfgs_padded = cfgs          # mesh-padded lane set, for the retry pass
     cfgs = {k: v[:B] for k, v in cfgs.items()}
+    prov = None
+    if qpol is not None:
+        # lane quarantine (resilience/quarantine.py): recover failed
+        # lanes through the escalation ladder before results are
+        # assembled, so x/tau/report reflect the recovered sweep.
+        from .resilience import quarantine as _quarantine
+        from .resilience.policy import fallback_kwargs
+
+        base_kw = {"rtol": rtol, "atol": atol, "max_steps": max_steps}
+
+        def _primary_solve():
+            # the retry pass's bit-exact recovery contract (quarantine.py
+            # module doc) requires the IDENTICAL program and batch shape
+            # the primary attempt ran — same mesh/bucket padding, same
+            # segmented-vs-monolithic branch, same instrumentation — so
+            # this re-invokes the exact primary call (recorder/watch
+            # omitted: the re-solve's spans would double-count)
+            if segment_steps > 0:
+                r = ensemble_solve_segmented(
+                    rhs, y0s, 0.0, float(time), cfgs_padded,
+                    segment_steps=segment_steps, pipeline=pipeline,
+                    poll_every=poll_every, fetch_deadline=fetch_deadline,
+                    **common)
+            else:
+                r = ensemble_solve(rhs, y0s, 0.0, float(time),
+                                   cfgs_padded, max_steps=max_steps,
+                                   **common)
+            return unpad_result(r, B)
+
+        def _subset_solve(y0_sub, cfg_sub, pass_name):
+            if pass_name == "retry":
+                return _primary_solve()
+            # fallback pass: the quarantined subset only, unsharded and
+            # unbucketed (the subset is small and padding would change
+            # its program shape — no bit-exact contract here, the
+            # tolerances change anyway); a segmented primary keeps the
+            # fallback launches segment-bounded too (the whole point of
+            # segmenting is that monolithic launches are unsafe there)
+            kw = fallback_kwargs(qpol, base_kw)
+            sub_common = dict(
+                jac=jac, observer=observer, observer_init=obs0,
+                method=method, jac_window=jac_window, linsolve=linsolve,
+                setup_economy=setup_economy, stale_tol=stale_tol,
+                stats=telemetry, rtol=kw["rtol"], atol=kw["atol"])
+            if segment_steps > 0:
+                ms = kw["max_steps"]
+                return ensemble_solve_segmented(
+                    rhs, y0_sub, 0.0, float(time), cfg_sub,
+                    segment_steps=segment_steps,
+                    max_segments=max(1, -(-ms // segment_steps)),
+                    max_attempts=ms, **sub_common)
+            return ensemble_solve(rhs, y0_sub, 0.0, float(time), cfg_sub,
+                                  max_steps=kw["max_steps"], **sub_common)
+
+        oracle_fn = None
+        if qpol.oracle:
+            from .resilience.quarantine import native_oracle
+
+            oracle_fn = native_oracle(rhs, 0.0, float(time), rtol=rtol,
+                                      atol=atol, max_steps=max_steps)
+        res, prov = _quarantine.resolve(
+            res, y0s[:B], cfgs, _subset_solve, policy=qpol, recorder=rec,
+            oracle=oracle_fn)
 
     ng = len(species)
     moles = np.asarray(res.y)[:, :ng] / np.asarray(thermo_obj.molwt)
@@ -1069,6 +1164,11 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         "status": np.asarray(res.status),
         "report": sweep_report(res, cfgs),
     }
+    if prov is not None:
+        from .resilience import quarantine as _quarantine
+
+        out["provenance"] = np.asarray(prov)
+        out["report"]["quarantine"] = _quarantine.provenance_counts(prov)
     if chem.surfchem:
         out["covg"] = np.asarray(res.y)[:, ng:]
     if ignition_marker is not None:

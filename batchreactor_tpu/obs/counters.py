@@ -63,6 +63,14 @@ BDF_KEYS = ("order_hist", "setup_reuses", "precond_age")
 #: gauge keys: high-water marks, reduced by max — summing a peak age
 #: across segments would report an age no factorization ever reached
 GAUGE_KEYS = ("precond_age",)
+#: host-side fault/recovery counters (resilience/ — docs/robustness.md):
+#: Recorder counters, not device stats.  Absent from a report means zero
+#: faults, so ``obs.diff`` maps a missing key to 0 (the setup_reuses /
+#: cache_* convention) — a fault-free baseline diffs cleanly against a
+#: faulted run instead of reporting "None -> n".
+FAULT_KEYS = ("fetch_timeouts", "chunk_retries", "chunks_corrupt",
+              "chunks_reassigned", "lanes_quarantined", "lanes_recovered",
+              "lanes_unrecovered")
 #: step_audit payloads folded into stats (not counters; excluded from sums)
 AUDIT_KEYS = ("accept_ring", "it_matrix")
 

@@ -128,6 +128,20 @@ def to_prometheus(report):
             [({"name": k}, v) for k, v in
              sorted((report.get("counters") or {}).items())])
 
+    # fault/recovery events (resilience/ — docs/robustness.md) aggregate
+    # by kind: the alerting surface for wedges, retries, reassignments,
+    # and quarantines (the per-event detail stays in the JSONL export)
+    faults = {}
+    for e in report.get("events") or []:
+        if e.get("name") == "fault":
+            kind = (e.get("attrs") or {}).get("kind", "unknown")
+            faults[kind] = faults.get(kind, 0) + 1
+    _metric(lines, "br_fault_events_total", "counter",
+            "Fault/recovery events by kind (resilience layer: wedge "
+            "watchdog, chunk retry, corrupt-chunk resume, dead-host "
+            "reassignment, lane quarantine).",
+            [({"kind": k}, v) for k, v in sorted(faults.items())])
+
     totals = (report.get("solver_stats") or {}).get("totals") or {}
     steps = []
     if "n_accepted" in totals:

@@ -207,6 +207,12 @@ def diff(a, b):
     ka, kb = a.get("counters") or {}, b.get("counters") or {}
     for k in sorted(set(ka) | set(kb)):
         va, vb = ka.get(k), kb.get(k)
+        if k in C.FAULT_KEYS:
+            # fault counters are absent from fault-free reports: missing
+            # is 0, not a difference (the setup_reuses/cache_* convention)
+            va, vb = va or 0, vb or 0
+            if va == vb:
+                continue
         if va != vb:
             lines.append(f"  counter {k}: {_fmt_ctr(va)} -> {_fmt_ctr(vb)}")
 

@@ -10,6 +10,16 @@ exist.  Recovery from preemption is therefore "run the same command again"
 — the surviving chunks load from disk and only the missing ones touch the
 device.  (Orbax would also work; plain npz keeps the artifact readable
 anywhere and dependency-free.)
+
+Fault tolerance (resilience/ — docs/robustness.md): chunk saves are
+crash-atomic (tmp + ``os.replace``), resume *validates* each existing
+chunk file and re-solves — instead of crashing on — a corrupt/truncated
+one, ``retry=`` re-solves failed/wedged chunks with exponential backoff
+and a per-chunk attempt ledger in the manifest, ``chunk_budget_s=`` arms
+the wedge watchdog on each chunk's device wait, and ``quarantine=``
+re-solves non-success lanes through the escalation ladder
+(``resilience/quarantine.py``) with per-lane provenance persisted in the
+chunk artifacts.
 """
 
 import concurrent.futures as _futures
@@ -17,6 +27,8 @@ import hashlib
 import json
 import os
 import threading
+import time
+import zipfile
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +41,13 @@ from .sweep import ensemble_solve
 
 _FIELDS = ("t", "y", "status", "n_accepted", "n_rejected", "ts", "ys",
            "n_saved", "h")
+
+#: exception classes a chunk LOAD may raise on a torn/corrupt file —
+#: resume treats any of them as "this chunk does not exist" and re-solves
+#: (np.load raises zipfile.BadZipFile on truncation, OSError/EOFError on
+#: short reads, KeyError/ValueError on missing/garbled members)
+_CORRUPT_ERRORS = (zipfile.BadZipFile, OSError, EOFError, KeyError,
+                   ValueError)
 
 
 def _obs_dict(res):
@@ -52,10 +71,17 @@ def _obs_dict(res):
 def save_result(path, res, cfgs=None):
     """Write a (possibly batched) SolveResult [+ conditions] to one .npz.
 
+    Crash-atomic by construction: the payload lands in ``<path>.tmp.npz``
+    first and ``os.replace``s into place, so a preemption mid-write can
+    never leave a half-written file under the final name (resume
+    additionally VALIDATES loadability — a torn file from a pre-atomic
+    writer or a disk fault re-solves instead of crashing).
+
     The telemetry counter block (``stats=True`` in ``solve_kw`` —
     obs/counters.py) persists under ``stat_*`` keys, so resumed chunks
     keep their counters and a checkpointed sweep's concatenated result
-    reports them like an unchunked one."""
+    reports them like an unchunked one; the quarantine layer's per-lane
+    ``provenance`` codes persist under ``prov``."""
     payload = {f: np.asarray(getattr(res, f)) for f in _FIELDS}
     obs = _obs_dict(res)
     if obs is not None:
@@ -64,6 +90,8 @@ def save_result(path, res, cfgs=None):
     if res.stats is not None:
         for k, v in res.stats.items():
             payload[f"stat_{k}"] = np.asarray(v)
+    if res.provenance is not None:
+        payload["prov"] = np.asarray(res.provenance)
     if cfgs:
         for k, v in cfgs.items():
             payload[f"cfg_{k}"] = np.asarray(v)
@@ -79,7 +107,9 @@ def load_result(path):
         stats = {k[5:]: jnp.asarray(z[k]) for k in z.files
                  if k.startswith("stat_")}
         res = SolveResult(**{f: jnp.asarray(z[f]) for f in _FIELDS},
-                          observed=obs or None, stats=stats or None)
+                          observed=obs or None, stats=stats or None,
+                          provenance=(jnp.asarray(z["prov"])
+                                      if "prov" in z.files else None))
         cfgs = {k[4:]: jnp.asarray(z[k]) for k in z.files if k.startswith("cfg_")}
     return res, cfgs
 
@@ -94,10 +124,19 @@ def _concat_results(parts):
     if parts and parts[0].stats is not None:
         stats = {k: jnp.concatenate([p.stats[k] for p in parts], axis=0)
                  for k in parts[0].stats}
+    provenance = None
+    if parts and any(p.provenance is not None for p in parts):
+        # chunks resumed from a quarantine-off (or pre-provenance) run
+        # carry no codes: they are primary-provenance by definition, so
+        # the mixed case concatenates zeros for them instead of failing
+        provenance = jnp.concatenate([
+            (p.provenance if p.provenance is not None
+             else jnp.zeros((int(p.status.shape[0]),), dtype=jnp.int8))
+            for p in parts], axis=0)
     return SolveResult(**{
         f: jnp.concatenate([getattr(p, f) for p in parts], axis=0)
         for f in _FIELDS
-    }, observed=observed, stats=stats)
+    }, observed=observed, stats=stats, provenance=provenance)
 
 
 def _hash_callable(h, fn, depth=0):
@@ -160,12 +199,14 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
             # too would make an explicit method="bdf" fingerprint differ
             # from the identical default-resolved configuration
             continue
-        if k in ("pipeline", "poll_every"):
-            # segmented execution-GEAR knobs, contractually bit-exact
-            # (parallel/sweep.py): they change how segments are driven,
-            # never the results, so a resume under a different gear — or a
-            # pre-gear checkpoint dir resumed after the knobs existed —
-            # must serve the same chunks, not raise a manifest mismatch
+        if k in ("pipeline", "poll_every", "fetch_deadline"):
+            # segmented execution-GEAR / watchdog knobs, contractually
+            # results-neutral (parallel/sweep.py): they change how
+            # segments are driven or how long the host waits, never the
+            # results, so a resume under a different gear or deadline —
+            # or a pre-knob checkpoint dir resumed after the knobs
+            # existed — must serve the same chunks, not raise a manifest
+            # mismatch
             continue
         v = solve_kw[k]
         h.update(k.encode())
@@ -189,9 +230,192 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
     return h.hexdigest()
 
 
+# --------------------------------------------------------------------------
+# manifest + attempt ledger
+# --------------------------------------------------------------------------
+_PINNED_KEYS = ("B", "chunk_size", "t0", "t1", "fingerprint")
+_LEDGER_CAP = 20   # attempt records kept per chunk (newest win)
+
+
+def _write_manifest_atomic(path, manifest):
+    # per-process tmp name (the steal_claim convention): N elastic
+    # processes racing to create the manifest must not share one tmp —
+    # a shared name lets a faster process os.replace it away and the
+    # slower one crash on FileNotFoundError (or expose a torn write)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)
+
+
+def ensure_manifest(ckpt_dir, pinned):
+    """Create-or-validate ``manifest.json`` against the ``pinned`` sweep
+    identity; returns the (mutable) per-chunk attempt ledger dict.  Only
+    the pinned keys participate in the resume-mismatch check — the
+    ledger is operational history, free to differ between runs.  The
+    write is atomic (tmp + replace), so two processes of the multihost
+    tier racing to create it converge on identical content (the
+    fingerprint is deterministic)."""
+    manifest_path = os.path.join(ckpt_dir, "manifest.json")
+    if os.path.exists(manifest_path):
+        prev = json.load(open(manifest_path))
+        prev_pinned = {k: prev.get(k) for k in _PINNED_KEYS}
+        if prev_pinned != pinned:
+            raise ValueError(
+                f"checkpoint dir {ckpt_dir} holds a different sweep "
+                f"({prev_pinned} != {pinned}); use a fresh directory")
+        return prev.get("attempts", {})
+    _write_manifest_atomic(manifest_path, {**pinned, "attempts": {}})
+    return {}
+
+
+class _Ledger:
+    """Per-chunk attempt ledger persisted inside ``manifest.json`` (the
+    honest operational record the wedge postmortems lacked: which chunk
+    failed, how, how many times, before it finally solved).  Appends are
+    lock-guarded and each write rewrites the manifest atomically."""
+
+    def __init__(self, ckpt_dir, pinned, attempts):
+        self._path = os.path.join(ckpt_dir, "manifest.json")
+        self._pinned = pinned
+        self.attempts = attempts
+        self._lock = threading.Lock()
+
+    def record(self, chunk, outcome, attempt, error=None):
+        with self._lock:
+            entry = {"attempt": int(attempt), "outcome": outcome,
+                     "time": time.time()}
+            if error is not None:
+                entry["kind"] = type(error).__name__
+                entry["error"] = str(error)[:300]
+            rows = self.attempts.setdefault(str(int(chunk)), [])
+            rows.append(entry)
+            del rows[:-_LEDGER_CAP]
+            _write_manifest_atomic(self._path, {**self._pinned,
+                                                "attempts": self.attempts})
+
+
+# --------------------------------------------------------------------------
+# chunk solve (shared with the elastic multihost tier)
+# --------------------------------------------------------------------------
+def _solve_chunk(rhs, y0c, t0, t1, cfgc, solve_kw, recorder=None):
+    """Solve one chunk through the configured path (monolithic
+    ``ensemble_solve`` or, with ``segment_steps > 0`` in ``solve_kw``,
+    ``ensemble_solve_segmented`` with ``max_steps`` mapped onto the
+    exact per-lane attempt budget), padding a ragged mesh tail with
+    copies of its last lane.  Module-level (not a closure) so the
+    elastic multihost tier and the quarantine re-solve passes run the
+    IDENTICAL chunk program the primary attempt ran."""
+    n = y0c.shape[0]
+    pad = 0
+    mesh = solve_kw.get("mesh")
+    if mesh is not None:
+        # mesh sharding needs the batch axis to divide the device count;
+        # pad the ragged tail chunk with copies of its last lane and
+        # slice them back off
+        from .sweep import pad_batch
+
+        pad = pad_batch(n, mesh) - n
+    if pad:
+        y0c = jnp.concatenate([y0c, jnp.repeat(y0c[-1:], pad, axis=0)])
+        cfgc = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
+                for k, v in cfgc.items()}
+    seg_steps = int(solve_kw.get("segment_steps", 0) or 0)
+    if seg_steps > 0:
+        import inspect
+
+        from .sweep import ensemble_solve_segmented
+
+        handled = {"segment_steps", "max_steps"}
+        allowed = set(
+            inspect.signature(ensemble_solve_segmented).parameters)
+        unsupported = set(solve_kw) - handled - allowed
+        if unsupported:
+            raise TypeError(
+                f"solve kwargs {sorted(unsupported)} are not supported "
+                f"by the segmented sweep path (segment_steps > 0)")
+        kw = {k: v for k, v in solve_kw.items() if k not in handled}
+        ms = int(solve_kw.get("max_steps", 200_000))
+        # the CALLER's recorder, not a private one: segment-level spans
+        # on a default max_steps sweep are ~200 per chunk, and recording
+        # them into a recorder nobody reads would grow host memory for
+        # the whole (long-running, by design) sweep.  With recorder=None
+        # the segmented driver records nothing and arms no CompileWatch:
+        # segment telemetry is opt-in via recorder=.
+        res = ensemble_solve_segmented(
+            rhs, y0c, t0, t1, cfgc, segment_steps=seg_steps,
+            max_segments=max(1, -(-ms // seg_steps)), max_attempts=ms,
+            recorder=recorder, **kw)
+    else:
+        # None-valued gear knobs (library-default pass-through, e.g.
+        # the northstar script) don't exist on the monolithic path —
+        # drop them; explicit values were rejected up front
+        kw = {k: v for k, v in solve_kw.items()
+              if k not in ("segment_steps", "pipeline", "poll_every",
+                           "fetch_deadline")}
+        res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **kw)
+    if pad:
+        res = jax.tree.map(
+            lambda x: x[:n] if hasattr(x, "ndim") and x.ndim >= 1 else x,
+            res)
+    return res
+
+
+# --------------------------------------------------------------------------
+# chunk wall-clock budget (the wedge watchdog's per-chunk deadline)
+# --------------------------------------------------------------------------
+def resolve_chunk_budget(chunk_budget_s=None):
+    """THE resolution rule for the per-chunk watchdog budget: explicit
+    seconds (> 0) or ``"auto"`` pass through, ``None`` resolves from the
+    ``BR_CHUNK_BUDGET_S`` env lever (a float, or ``auto``);
+    unset/empty/<= 0 = no budget."""
+    if chunk_budget_s is None:
+        chunk_budget_s = os.environ.get("BR_CHUNK_BUDGET_S", "") or None
+    if chunk_budget_s is None:
+        return None
+    if chunk_budget_s == "auto":
+        return "auto"
+    b = float(chunk_budget_s)
+    if b <= 0:
+        return None
+    return b
+
+
+class _ChunkBudget:
+    """Derive each chunk's wall-clock budget.  Fixed mode returns the
+    configured seconds.  ``"auto"`` mode calibrates from completed
+    chunks: the budget is ``mult x`` the cost-scaled median observed
+    wall (per-unit of the chunk's predicted ``lane_cost`` sum when one
+    was given, per-lane otherwise), floored at ``min_s`` — the first
+    chunk runs unbudgeted (there is nothing honest to derive a deadline
+    from yet).  ``BR_CHUNK_BUDGET_MULT`` / ``BR_CHUNK_BUDGET_MIN_S``
+    tune the margin (defaults 4x / 30 s)."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self.mult = float(os.environ.get("BR_CHUNK_BUDGET_MULT", "4"))
+        self.min_s = float(os.environ.get("BR_CHUNK_BUDGET_MIN_S", "30"))
+        self._ratios = []   # observed wall per unit of relative cost
+
+    def budget_for(self, rel_cost):
+        if self.mode is None:
+            return None
+        if self.mode != "auto":
+            return float(self.mode)
+        if not self._ratios:
+            return None
+        per_unit = float(np.median(self._ratios))
+        return max(self.min_s, self.mult * per_unit * float(rel_cost))
+
+    def observe(self, wall_s, rel_cost):
+        if self.mode == "auto" and rel_cost > 0:
+            self._ratios.append(float(wall_s) / float(rel_cost))
+
+
 def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
                        lane_cost=None, chunk_log=None, recorder=None,
-                       **solve_kw):
+                       retry=None, chunk_budget_s=None, quarantine=None,
+                       oracle=None, **solve_kw):
     """ensemble_solve with chunk-level checkpoint/resume.
 
     Splits the (B, ...) batch into ``chunk_size`` pieces; chunk i's result is
@@ -203,7 +427,11 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     on-disk state is complete whenever the call finishes.  On re-invocation,
     chunks with an existing file are loaded instead of re-solved (the
     manifest pins B/chunk_size so a mismatched resume fails loudly rather
-    than silently mixing sweeps).  Returns the full concatenated SolveResult.
+    than silently mixing sweeps); a chunk file that fails to LOAD —
+    truncated by a disk fault or a pre-atomic writer — is renamed to
+    ``*.corrupt`` and re-solved, with a ``fault`` event and a
+    ``chunks_corrupt`` counter, instead of crashing the resume.  Returns
+    the full concatenated SolveResult.
 
     ``lane_cost`` — optional (B,) array of *predicted* per-lane solve cost
     (any monotone proxy: steps, seconds, stiffness score).  Lanes are
@@ -222,9 +450,9 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     ``ensemble_solve_segmented`` (bounded device launches — the safe mode
     on tunneled TPU runtimes); ``max_steps`` then maps onto the segmented
     path's exact per-lane attempt budget.  The segmented driver's
-    ``pipeline``/``poll_every`` knobs pass straight through, so a
-    checkpointed chunk runs the pipelined gear by default — its
-    background drain thread coexists with this module's async save
+    ``pipeline``/``poll_every``/``fetch_deadline`` knobs pass straight
+    through, so a checkpointed chunk runs the pipelined gear by default
+    — its background drain thread coexists with this module's async save
     worker (each chunk's drain completes before the chunk's save is
     queued, because the drain joins inside ``ensemble_solve_segmented``).
 
@@ -237,25 +465,61 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     (see the normalization above); resuming under a different ladder
     fails loudly.
 
+    Fault tolerance (resilience/ — docs/robustness.md):
+
+    * ``retry=`` (None/True/int/dict/``RetryPolicy``) re-solves a chunk
+      whose solve raised a retryable fault (``resilience.RETRYABLE``:
+      the wedge watchdog's ``WedgeError``, XLA runtime faults, OS I/O
+      errors) up to ``max_retries`` times with exponential backoff,
+      after a best-effort backend reset on a wedge.  Every attempt —
+      failed or not — lands in the per-chunk attempt ledger inside
+      ``manifest.json`` (``attempts``; the pinned resume-identity keys
+      are unaffected).  Retries emit ``fault`` events and a
+      ``chunk_retries`` counter on the recorder.
+    * ``chunk_budget_s=`` (seconds, ``"auto"``, or None -> the
+      ``BR_CHUNK_BUDGET_S`` env lever) arms the wedge watchdog on each
+      chunk's blocking device wait: ``"auto"`` derives the budget from
+      completed chunks scaled by the chunk's ``lane_cost`` share
+      (``BR_CHUNK_BUDGET_MULT``/``BR_CHUNK_BUDGET_MIN_S`` tune the
+      margin).  A breach is a ``WedgeError`` — retryable.
+    * ``quarantine=`` (None/True/dict/``QuarantinePolicy``) re-solves
+      non-success LANES through the escalation ladder (same-settings
+      retry pass -> tighter-tolerance fallback -> optional ``native/``
+      CPU ``oracle``) before the chunk is saved; per-lane provenance
+      persists in the npz (``prov``) and on
+      ``SolveResult.provenance``.  ``oracle=`` overrides the
+      auto-constructed native oracle with any callable matching
+      ``resilience.quarantine.resolve``'s contract.
+
     ``recorder`` (an ``obs.Recorder``) collects the per-chunk telemetry —
     ``chunk_solve`` spans (with lane counts and attempt stats as
     attributes), ``chunk_save`` spans from the background writer thread,
-    ``chunk_loaded`` events for resumed chunks, and (with
-    ``segment_steps > 0``) the segmented driver's per-segment spans and
-    retrace detection — so segmented-sweep save/solve timings land in
-    the same report as everything else (docs/observability.md).  When
-    omitted, a private recorder still drives the ``chunk_log`` lines
-    (unchanged), but segment-level telemetry stays off: a checkpointed
-    sweep is long-running by design, and per-segment spans nobody reads
-    would grow host memory for its whole life.  The recorder is
-    deliberately NOT part of the sweep fingerprint (it describes the
-    observer, not the sweep).
+    ``chunk_loaded`` events for resumed chunks, every ``fault``/retry/
+    quarantine event and counter above, and (with ``segment_steps > 0``)
+    the segmented driver's per-segment spans and retrace detection — so
+    segmented-sweep save/solve timings land in the same report as
+    everything else (docs/observability.md).  When omitted, a private
+    recorder still drives the ``chunk_log`` lines (unchanged), but
+    segment-level telemetry stays off: a checkpointed sweep is
+    long-running by design, and per-segment spans nobody reads would
+    grow host memory for its whole life.  The recorder is deliberately
+    NOT part of the sweep fingerprint (it describes the observer, not
+    the sweep).
     """
+    from ..resilience import inject
+    from ..resilience.policy import (RETRYABLE, fallback_kwargs,
+                                     normalize_quarantine, normalize_retry)
+    from ..resilience.watchdog import (WedgeError, block_with_deadline,
+                                       reset_backend)
+
+    retry = normalize_retry(retry)
+    qpol = normalize_quarantine(quarantine)
+    budget = _ChunkBudget(resolve_chunk_budget(chunk_budget_s))
     if int(solve_kw.get("segment_steps", 0) or 0) <= 0:
-        # up-front, like api.py: the gear knobs configure the segmented
-        # driver only, and the check must fire even when every chunk
-        # resumes from disk (None = library default passes through)
-        explicit = [k for k in ("pipeline", "poll_every")
+        # up-front, like api.py: the gear/watchdog knobs configure the
+        # segmented driver only, and the check must fire even when every
+        # chunk resumes from disk (None = library default passes through)
+        explicit = [k for k in ("pipeline", "poll_every", "fetch_deadline")
                     if solve_kw.get(k) is not None]
         if explicit:
             raise ValueError(
@@ -294,6 +558,7 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
                 _raw_log(msg)
     y0s = jnp.asarray(y0s)
     perm = inv_perm = None
+    cost_sorted = None
     if lane_cost is not None:
         lane_cost = np.asarray(lane_cost)
         if lane_cost.shape != (y0s.shape[0],):
@@ -307,77 +572,73 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
         y0s = y0s[jnp.asarray(perm)]
         cfgs = {k: jnp.asarray(v)[jnp.asarray(perm)]
                 for k, v in cfgs.items()}
+        cost_sorted = lane_cost[perm]
     B = y0s.shape[0]
     os.makedirs(ckpt_dir, exist_ok=True)
-    manifest_path = os.path.join(ckpt_dir, "manifest.json")
-    manifest = {"B": B, "chunk_size": chunk_size,
-                "t0": float(t0), "t1": float(t1),
-                "fingerprint": _sweep_fingerprint(rhs, y0s, cfgs, solve_kw)}
-    if os.path.exists(manifest_path):
-        prev = json.load(open(manifest_path))
-        if prev != manifest:
-            raise ValueError(
-                f"checkpoint dir {ckpt_dir} holds a different sweep "
-                f"({prev} != {manifest}); use a fresh directory")
-    else:
-        with open(manifest_path, "w") as f:
-            json.dump(manifest, f)
+    pinned = {"B": int(B), "chunk_size": chunk_size,
+              "t0": float(t0), "t1": float(t1),
+              "fingerprint": _sweep_fingerprint(rhs, y0s, cfgs, solve_kw)}
+    ledger = _Ledger(ckpt_dir, pinned, ensure_manifest(ckpt_dir, pinned))
 
-    mesh = solve_kw.get("mesh")
+    oracle_fn = oracle
+    if (oracle_fn is None and qpol is not None and qpol.oracle
+            and solve_kw.get("rhs_bundle") is None):
+        from ..resilience.quarantine import native_oracle
 
-    def _solve_chunk(y0c, cfgc):
-        n = y0c.shape[0]
-        pad = 0
-        if mesh is not None:
-            # mesh sharding needs the batch axis to divide the device count;
-            # pad the ragged tail chunk with copies of its last lane and
-            # slice them back off
-            from .sweep import pad_batch
+        oracle_fn = native_oracle(
+            rhs, t0, t1, rtol=float(solve_kw.get("rtol", 1e-6)),
+            atol=float(solve_kw.get("atol", 1e-10)),
+            max_steps=int(solve_kw.get("max_steps", 200_000)))
 
-            pad = pad_batch(n, mesh) - n
-        if pad:
-            y0c = jnp.concatenate([y0c, jnp.repeat(y0c[-1:], pad, axis=0)])
-            cfgc = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
-                    for k, v in cfgc.items()}
-        seg_steps = int(solve_kw.get("segment_steps", 0) or 0)
-        if seg_steps > 0:
-            import inspect
+    def _rel_cost(lo, hi):
+        """Chunk's relative cost share for the auto budget: predicted
+        lane_cost sum when one was given, lane count otherwise."""
+        if cost_sorted is not None:
+            return float(np.sum(cost_sorted[lo:hi]))
+        return float(hi - lo)
 
-            from .sweep import ensemble_solve_segmented
+    def _solve_with_retry(i, lo, hi, y0c, cfgc):
+        attempts = (retry.max_retries if retry is not None else 0) + 1
+        for attempt in range(attempts):
+            try:
+                with rec.span("chunk_solve", chunk=i, lanes=hi - lo,
+                              attempt=attempt) as sp:
+                    res = _solve_chunk(rhs, y0c, t0, t1, cfgc, solve_kw,
+                                       recorder)
+                    b = budget.budget_for(_rel_cost(lo, hi))
+                    if b is not None:
+                        block_with_deadline(res.y, b, rec,
+                                            label=f"chunk{i}")
+                    else:
+                        jax.block_until_ready(res.y)
+                budget.observe(sp["dur"], _rel_cost(lo, hi))
+                ledger.record(i, "ok", attempt)
+                return res, sp, attempt
+            except RETRYABLE as e:
+                ledger.record(i, "error", attempt, e)
+                last = attempt == attempts - 1
+                rec.event("fault", kind="chunk_solve_error", chunk=i,
+                          attempt=attempt, retryable=not last,
+                          error=f"{type(e).__name__}: {str(e)[:200]}")
+                if chunk_log is not None:
+                    chunk_log(f"[ckpt] chunk {i} attempt {attempt} "
+                              f"FAILED ({type(e).__name__}); "
+                              f"{'giving up' if last else 'retrying'}")
+                if last:
+                    raise
+                rec.counter("chunk_retries")
+                if isinstance(e, WedgeError):
+                    # drop cached executables so the retry redispatches
+                    # from scratch (a transient stall recovers; a truly
+                    # wedged device fails the remaining attempts and
+                    # surfaces to the process-level supervisor)
+                    reset_backend()
+                time.sleep(retry.delay(attempt))
 
-            handled = {"segment_steps", "max_steps"}
-            allowed = set(
-                inspect.signature(ensemble_solve_segmented).parameters)
-            unsupported = set(solve_kw) - handled - allowed
-            if unsupported:
-                raise TypeError(
-                    f"solve kwargs {sorted(unsupported)} are not supported "
-                    f"by the segmented sweep path (segment_steps > 0)")
-            kw = {k: v for k, v in solve_kw.items() if k not in handled}
-            ms = int(solve_kw.get("max_steps", 200_000))
-            # the CALLER's recorder, not the private rec: segment-level
-            # spans on a default max_steps sweep are ~200 per chunk, and
-            # recording them into a recorder nobody reads would grow host
-            # memory for the whole (long-running, by design) sweep — the
-            # private rec only drives the chunk_log chunk timings.  With
-            # recorder=None the segmented driver records nothing and arms
-            # no CompileWatch: segment telemetry is opt-in via recorder=.
-            res = ensemble_solve_segmented(
-                rhs, y0c, t0, t1, cfgc, segment_steps=seg_steps,
-                max_segments=max(1, -(-ms // seg_steps)), max_attempts=ms,
-                recorder=recorder, **kw)
-        else:
-            # None-valued gear knobs (library-default pass-through, e.g.
-            # the northstar script) don't exist on the monolithic path —
-            # drop them; explicit values were rejected up front
-            kw = {k: v for k, v in solve_kw.items()
-                  if k not in ("segment_steps", "pipeline", "poll_every")}
-            res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **kw)
-        if pad:
-            res = jax.tree.map(
-                lambda x: x[:n] if hasattr(x, "ndim") and x.ndim >= 1 else x,
-                res)
-        return res
+    def _subset_solve(y0_sub, cfg_sub, pass_name):
+        kw = (solve_kw if pass_name == "retry"
+              else fallback_kwargs(qpol, solve_kw))
+        return _solve_chunk(rhs, y0_sub, t0, t1, cfg_sub, kw, recorder)
 
     parts = []
     pending = []
@@ -411,6 +672,10 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
             # chunk_solve spans (obs/recorder.py thread semantics)
             with rec.span("chunk_save", chunk=i) as sp:
                 save_result(path, res, chunk_cfgs)
+            # test-only: the corrupt-chunk fault simulation tears the
+            # file AFTER the atomic save, modelling the on-disk rot the
+            # resume validation exists for
+            inject.corrupt_path(path, i)
             if chunk_log is not None:
                 chunk_log(f"[ckpt] chunk {i} saved "
                           f"({sp['dur']:.2f}s, async)")
@@ -426,26 +691,54 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
             hi = min(lo + chunk_size, B)
             path = os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
             chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
+            res = None
             if os.path.exists(path):
-                with rec.span("chunk_load", chunk=i):
-                    res, _ = load_result(path)
-                rec.event("chunk_loaded", chunk=i, path=path)
-                if chunk_log is not None:
-                    chunk_log(f"[ckpt] chunk {i} loaded from {path}")
-            else:
-                with rec.span("chunk_solve", chunk=i,
-                              lanes=hi - lo) as sp:
-                    res = _solve_chunk(y0s[lo:hi], chunk_cfgs)
-                    jax.block_until_ready(res.y)
+                try:
+                    with rec.span("chunk_load", chunk=i):
+                        res, _ = load_result(path)
+                    rec.event("chunk_loaded", chunk=i, path=path)
+                    if chunk_log is not None:
+                        chunk_log(f"[ckpt] chunk {i} loaded from {path}")
+                except _CORRUPT_ERRORS as e:
+                    # torn/corrupt file: keep it aside for forensics and
+                    # fall through to a fresh solve — resume survives
+                    # exactly the crash classes the atomic writer cannot
+                    # rule out (disk faults, pre-atomic writers)
+                    rec.event("fault", kind="corrupt_chunk", chunk=i,
+                              path=path,
+                              error=f"{type(e).__name__}: {str(e)[:200]}")
+                    rec.counter("chunks_corrupt")
+                    os.replace(path, path + ".corrupt")
+                    if chunk_log is not None:
+                        chunk_log(f"[ckpt] chunk {i} file corrupt "
+                                  f"({type(e).__name__}) — re-solving")
+                    res = None
+            if res is None:
+                res, sp, attempt = _solve_with_retry(i, lo, hi,
+                                                     y0s[lo:hi],
+                                                     chunk_cfgs)
+                solve_s = sp["dur"]
+                # test-only: NaN-lane fault simulation (global lane
+                # indices in solve order), BEFORE quarantine so the
+                # recovery ladder is what the artifact records
+                res = inject.poison_lanes(res, lo, hi)
+                if qpol is not None:
+                    from ..resilience import quarantine as _quarantine
+
+                    res, _prov = _quarantine.resolve(
+                        res, y0s[lo:hi], chunk_cfgs, _subset_solve,
+                        policy=qpol, recorder=rec, oracle=oracle_fn,
+                        lane_offset=lo)
                 att = (np.asarray(res.n_accepted)
                        + np.asarray(res.n_rejected))
                 sp["attrs"]["attempts_mean"] = float(att.mean())
                 sp["attrs"]["attempts_max"] = int(att.max())
-                solve_s = sp["dur"]
                 if chunk_log is not None:
+                    retry_note = f" (attempt {attempt})" if attempt else ""
                     chunk_log(
                         f"[ckpt] chunk {i} ({hi - lo} lanes): solve "
-                        f"{solve_s:.2f}s ({(hi - lo) / solve_s:.1f} cond/s), "
+                        f"{solve_s:.2f}s ({(hi - lo) / solve_s:.1f} "
+                        f"cond/s){retry_note}, "
                         f"attempts mean {att.mean():.0f} max {att.max()}")
                 _save_async(i, path, res, chunk_cfgs)
             parts.append(res)

@@ -30,6 +30,11 @@ array without any cross-host data movement (each process reads its own
 lanes from its local copy).
 """
 
+import json
+import os
+import threading
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -104,3 +109,386 @@ def ensemble_solve_multihost(rhs, y0s, t0, t1, cfgs, *, mesh=None,
     return jax.tree.map(
         lambda x: gather_batch(x) if (hasattr(x, "ndim") and x.ndim >= 1
                                       and x.shape[:1] == (B,)) else x, res)
+
+
+# --------------------------------------------------------------------------
+# elastic (wedge-resilient) multihost sweeps — resilience/ tier
+# --------------------------------------------------------------------------
+#
+# The collective path above has the classic SPMD failure mode: one dead
+# process hangs every survivor inside the next collective (the Gloo
+# rendezvous just blocks).  The sweep is collective-free data parallelism,
+# so the elastic tier drops collectives entirely and coordinates through
+# the shared checkpoint directory instead: chunks are claimed with atomic
+# O_EXCL files, liveness is a per-process heartbeat file, and a chunk
+# whose claim owner stops heartbeating is REASSIGNED to a survivor.  The
+# chunk artifacts are identical no matter which process solved them, so
+# the resume fingerprint stays honest across reassignment — a later
+# single-process ``checkpointed_sweep`` resume of the same directory
+# validates and serves the same chunks.
+
+def _hosts_dir(ckpt_dir):
+    d = os.path.join(ckpt_dir, "hosts")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _heartbeat_path(ckpt_dir, process_id):
+    return os.path.join(_hosts_dir(ckpt_dir), f"p{int(process_id)}.hb")
+
+
+class _Heartbeat(threading.Thread):
+    """Daemon touching this process's heartbeat file every
+    ``interval_s`` — the liveness signal :func:`host_liveness` reads."""
+
+    def __init__(self, path, interval_s):
+        super().__init__(daemon=True, name="br-elastic-heartbeat")
+        self.path = path
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                with open(self.path, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass   # a missed beat reads as slow, not dead-forever
+            self._stop.wait(self.interval_s)
+
+    def stop(self):
+        self._stop.set()
+
+
+def host_liveness(ckpt_dir, dead_after_s):
+    """Per-process liveness from the heartbeat files:
+    ``{process_id: (age_s, alive)}`` — ``alive`` is heartbeat age <=
+    ``dead_after_s``.  The survivor-side view the reassignment decision
+    (and the operator) reads."""
+    out = {}
+    d = _hosts_dir(ckpt_dir)
+    now = time.time()
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith("p") and name.endswith(".hb")):
+            continue
+        pid = int(name[1:-3])
+        try:
+            age = now - os.path.getmtime(os.path.join(d, name))
+        except OSError:
+            continue
+        out[pid] = (age, age <= dead_after_s)
+    return out
+
+
+def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
+                               process_id, num_processes, chunk_size=512,
+                               heartbeat_s=0.5, dead_after_s=None,
+                               poll_s=0.25, timeout_s=600.0,
+                               retry=None, quarantine=None, oracle=None,
+                               chunk_budget_s=None,
+                               recorder=None, chunk_log=None, **solve_kw):
+    """Wedge-resilient multi-process checkpointed sweep (module section
+    doc): every process runs this with the same arguments and its own
+    ``process_id``; chunks are initially partitioned round-robin, each
+    solve is claimed (atomic ``O_EXCL`` claim file) and saved through
+    the crash-atomic chunk writer, and once a process's own partition is
+    done it scans for missing chunks whose claim owner has stopped
+    heartbeating (``dead_after_s``, default ``6 x heartbeat_s``) — those
+    are STOLEN (claim rewritten atomically), counted on the recorder as
+    ``chunks_reassigned`` with a ``fault`` event, and solved by the
+    survivor.  Two survivors racing to steal the same chunk is benign:
+    both produce the identical artifact and the save is atomic.
+
+    No collectives and no ``jax.distributed`` requirement: coordination
+    is entirely through the shared ``ckpt_dir`` (which must be on a
+    filesystem all processes see), so a dead process can never hang a
+    survivor — the exact failure mode of the collective tier above.
+    ``solve_kw`` is the per-chunk solver configuration
+    (``checkpointed_sweep`` semantics, including ``segment_steps``/
+    ``mesh`` for within-host sharding), and the fault-tolerance knobs
+    are named parameters exactly as there: ``retry=`` (chunk re-solve
+    with backoff after a retryable fault), ``quarantine=``/``oracle=``
+    (lane escalation ladder before the save), and ``chunk_budget_s=``
+    (seconds or ``"auto"`` — the wedge watchdog on each chunk's device
+    wait, THE wedge-detection lever in this tier: a breach exhausts the
+    retries, propagates, and stops this process's heartbeat on the way
+    out, so the surviving peers reassign its chunks; without a budget a
+    wedged solve keeps heartbeating and is indistinguishable from a slow
+    one).  All four stay out of the manifest fingerprint, so the
+    directory interoperates with single-process ``checkpointed_sweep``
+    resume under any knob combination.  Unlike ``checkpointed_sweep``,
+    no per-chunk attempt ledger is written — concurrent manifest
+    rewrites from many processes would race (atomic but last-wins); the
+    claim files carry per-chunk ownership history instead.
+
+    Liveness caveat: ``host_liveness`` compares the heartbeat file's
+    mtime (the shared filesystem's clock) against the local clock.  On
+    NFS-class filesystems, attribute caching and cross-host clock skew
+    can dwarf the CPU-test defaults — set ``heartbeat_s``/
+    ``dead_after_s`` well above both (e.g. 5 s / 60 s), or survivors
+    misread live peers as dead and duplicate their in-flight work
+    (results stay correct — artifacts are identical and saves atomic —
+    but the work partitioning is defeated).
+
+    Returns the full concatenated SolveResult (loaded from the chunk
+    artifacts, so every surviving process returns the same values).
+    Raises after ``timeout_s`` without progress — own, or observed peer
+    progress (the missing-chunk count shrinking), either of which
+    refreshes the deadline — while chunks are still missing: e.g. every
+    remaining chunk is claimed by a live-but-stuck peer, which is an
+    operator decision, not a theft."""
+    from .checkpoint import (_ChunkBudget, _concat_results, _solve_chunk,
+                             _sweep_fingerprint, ensure_manifest,
+                             load_result, resolve_chunk_budget, save_result,
+                             _CORRUPT_ERRORS)
+    from ..resilience import inject
+    from ..resilience import quarantine as _quarantine
+    from ..resilience.policy import (RETRYABLE, fallback_kwargs,
+                                     normalize_quarantine, normalize_retry)
+    from ..resilience.watchdog import (WedgeError, block_with_deadline,
+                                       reset_backend)
+
+    if not (0 <= int(process_id) < int(num_processes)):
+        raise ValueError(f"process_id {process_id} outside "
+                         f"[0, {num_processes})")
+    if int(solve_kw.get("segment_steps", 0) or 0) <= 0:
+        # the checkpointed_sweep loudness convention: these knobs
+        # configure the segmented driver only, and silently dropping
+        # them would report a watchdog/gear that never armed
+        explicit = [k for k in ("pipeline", "poll_every", "fetch_deadline")
+                    if solve_kw.get(k) is not None]
+        if explicit:
+            raise ValueError(
+                f"{'/'.join(explicit)} are segmented-path knobs; set "
+                f"segment_steps > 0 or drop the arguments")
+    if dead_after_s is None:
+        dead_after_s = 6.0 * float(heartbeat_s)
+    retry = normalize_retry(retry)
+    qpol = normalize_quarantine(quarantine)
+    budget = _ChunkBudget(resolve_chunk_budget(chunk_budget_s))
+    y0s = jnp.asarray(y0s)
+    B = int(y0s.shape[0])
+    n_chunks = -(-B // int(chunk_size))
+    os.makedirs(ckpt_dir, exist_ok=True)
+    pinned = {"B": B, "chunk_size": chunk_size,
+              "t0": float(t0), "t1": float(t1),
+              "fingerprint": _sweep_fingerprint(rhs, y0s, cfgs, solve_kw)}
+    ensure_manifest(ckpt_dir, pinned)
+    hb = _Heartbeat(_heartbeat_path(ckpt_dir, process_id), heartbeat_s)
+    hb.start()
+
+    def chunk_path(i):
+        return os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
+
+    def claim_path(i):
+        return chunk_path(i) + ".claim"
+
+    def read_claim(i):
+        try:
+            with open(claim_path(i)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError):
+            # torn claim: the writer died between the O_EXCL create and
+            # the json.dump (or a disk fault truncated it) — exactly the
+            # fault class this tier must survive.  Treat it as a claim
+            # by an unknown owner aged by the file's mtime, so the
+            # normal owner_dead staleness path can steal it instead of
+            # every survivor spinning on an unclaimable chunk forever.
+            try:
+                mtime = os.path.getmtime(claim_path(i))
+            except OSError:
+                return None
+            return {"pid": -1, "time": mtime}
+
+    def try_claim(i):
+        """First-claim via O_CREAT|O_EXCL — exactly one winner."""
+        try:
+            fd = os.open(claim_path(i),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            json.dump({"pid": int(process_id), "time": time.time()}, f)
+        return True
+
+    def steal_claim(i, owner):
+        """Reassign a dead owner's chunk: atomic claim rewrite."""
+        tmp = claim_path(i) + f".steal{process_id}"
+        with open(tmp, "w") as f:
+            json.dump({"pid": int(process_id), "time": time.time(),
+                       "stolen_from": int(owner)}, f)
+        os.replace(tmp, claim_path(i))
+        if recorder is not None:
+            recorder.counter("chunks_reassigned")
+            recorder.event("fault", kind="dead_host_reassign", chunk=i,
+                           dead_process=int(owner),
+                           survivor=int(process_id))
+        if chunk_log is not None:
+            chunk_log(f"[elastic] p{process_id} reassigned chunk {i} "
+                      f"from dead p{owner}")
+
+    oracle_fn = oracle
+    if oracle_fn is None and qpol is not None and qpol.oracle:
+        oracle_fn = _quarantine.native_oracle(
+            rhs, t0, t1, rtol=float(solve_kw.get("rtol", 1e-6)),
+            atol=float(solve_kw.get("atol", 1e-10)),
+            max_steps=int(solve_kw.get("max_steps", 200_000)))
+
+    def _subset_solve(y0_sub, cfg_sub, pass_name):
+        kw = (solve_kw if pass_name == "retry"
+              else fallback_kwargs(qpol, solve_kw))
+        return _solve_chunk(rhs, y0_sub, t0, t1, cfg_sub, kw, recorder)
+
+    def solve_and_save(i):
+        lo = i * int(chunk_size)
+        hi = min(lo + int(chunk_size), B)
+        chunk_cfgs = {k: jnp.asarray(v)[lo:hi] for k, v in cfgs.items()}
+        attempts = (retry.max_retries if retry is not None else 0) + 1
+        for attempt in range(attempts):
+            try:
+                t_start = time.perf_counter()
+                res = _solve_chunk(rhs, y0s[lo:hi], t0, t1, chunk_cfgs,
+                                   solve_kw, recorder)
+                b = budget.budget_for(hi - lo)
+                if b is not None:
+                    block_with_deadline(res.y, b, recorder,
+                                        label=f"elastic-chunk{i}")
+                else:
+                    jax.block_until_ready(res.y)
+                break
+            except RETRYABLE as e:
+                last = attempt == attempts - 1
+                if recorder is not None:
+                    recorder.event(
+                        "fault", kind="chunk_solve_error", chunk=i,
+                        attempt=attempt, retryable=not last,
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
+                if chunk_log is not None:
+                    chunk_log(f"[elastic] p{process_id} chunk {i} attempt "
+                              f"{attempt} FAILED ({type(e).__name__}); "
+                              f"{'giving up' if last else 'retrying'}")
+                if last:
+                    # propagates: the finally below stops the heartbeat,
+                    # so surviving peers reassign this process's chunks
+                    raise
+                if recorder is not None:
+                    recorder.counter("chunk_retries")
+                if isinstance(e, WedgeError):
+                    reset_backend()
+                time.sleep(retry.delay(attempt))
+        wall = time.perf_counter() - t_start
+        budget.observe(wall, hi - lo)
+        # test-only: NaN-lane simulation BEFORE quarantine, so the
+        # recovery ladder is what the artifact records
+        res = inject.poison_lanes(res, lo, hi)
+        if qpol is not None:
+            res, _prov = _quarantine.resolve(
+                res, y0s[lo:hi], chunk_cfgs, _subset_solve, policy=qpol,
+                recorder=recorder, oracle=oracle_fn, lane_offset=lo)
+        # test-only: the killed-process fault simulation exits HERE —
+        # after the solve, before the save — so the chunk file stays
+        # missing and the claim goes stale, the exact state a SIGKILL
+        # leaves behind
+        inject.kill_now(i)
+        save_result(chunk_path(i), res, chunk_cfgs)
+        if chunk_log is not None:
+            chunk_log(f"[elastic] p{process_id} chunk {i} "
+                      f"({hi - lo} lanes) solved+saved in {wall:.2f}s")
+
+    def owner_dead(cl, live):
+        """A claim owner is dead when its heartbeat (or, if it never
+        heartbeat, its claim) is older than ``dead_after_s``.  ``live``
+        is a :func:`host_liveness` snapshot taken once per poll
+        iteration — per-chunk re-scans would issue O(missing x hosts)
+        metadata ops per poll against the shared filesystem the
+        heartbeats live on."""
+        owner = int(cl.get("pid", -1))
+        if owner in live:
+            return not live[owner][1]
+        return (time.time() - float(cl.get("time", 0))) > dead_after_s
+
+    try:
+        # pass 1: this process's own partition (round-robin)
+        for i in range(n_chunks):
+            if i % int(num_processes) != int(process_id):
+                continue
+            if os.path.exists(chunk_path(i)):
+                continue
+            cl = read_claim(i)
+            if cl is not None and int(cl.get("pid", -1)) == int(process_id):
+                # our own stale claim from a previous crashed run
+                solve_and_save(i)
+            elif cl is None and try_claim(i):
+                solve_and_save(i)
+        # pass 2: recovery loop — steal from the dead until all chunks
+        # exist (or a live peer is just slower than us: wait).  The
+        # timeout is NO-PROGRESS time, not total recovery wall: own
+        # progress (a chunk solved here) and observed peer progress (the
+        # missing count shrinking between polls) both refresh the
+        # deadline, so a healthy multi-host run with long per-chunk
+        # solves never times out while anyone is still finishing chunks.
+        deadline = time.time() + float(timeout_s)
+        prev_missing = None
+        while True:
+            missing = [i for i in range(n_chunks)
+                       if not os.path.exists(chunk_path(i))]
+            if not missing:
+                break
+            if prev_missing is not None and len(missing) < prev_missing:
+                deadline = time.time() + float(timeout_s)   # peer progress
+            prev_missing = len(missing)
+            progressed = False
+            live = host_liveness(ckpt_dir, dead_after_s)
+            for i in missing:
+                cl = read_claim(i)
+                if cl is None:
+                    if try_claim(i):
+                        solve_and_save(i)
+                        progressed = True
+                elif int(cl.get("pid", -1)) == int(process_id):
+                    solve_and_save(i)
+                    progressed = True
+                elif owner_dead(cl, live):
+                    steal_claim(i, int(cl.get("pid", -1)))
+                    solve_and_save(i)
+                    progressed = True
+            if progressed:
+                deadline = time.time() + float(timeout_s)
+                continue
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"elastic sweep p{process_id}: {len(missing)} "
+                    f"chunk(s) still missing after {timeout_s:g}s without "
+                    f"progress, every claim held by a live process "
+                    f"({[read_claim(i) for i in missing]})")
+            time.sleep(float(poll_s))
+
+        # collect — still inside the heartbeat's lifetime: a chunk file
+        # that exists but fails to LOAD (torn by a disk fault after a
+        # peer's save, or the injected corrupt class) is set aside as
+        # ``*.corrupt`` and re-solved here, the single-process resume
+        # convention — the previous behavior (raise 're-run to re-solve
+        # it') could never self-heal, because the re-run saw the file
+        # exist and skipped it again forever
+        parts = []
+        for i in range(n_chunks):
+            try:
+                parts.append(load_result(chunk_path(i))[0])
+            except _CORRUPT_ERRORS as e:
+                if recorder is not None:
+                    recorder.event(
+                        "fault", kind="corrupt_chunk", chunk=i,
+                        path=chunk_path(i),
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
+                    recorder.counter("chunks_corrupt")
+                os.replace(chunk_path(i), chunk_path(i) + ".corrupt")
+                if chunk_log is not None:
+                    chunk_log(f"[elastic] p{process_id} chunk {i} file "
+                              f"corrupt ({type(e).__name__}) — re-solving")
+                solve_and_save(i)
+                parts.append(load_result(chunk_path(i))[0])
+    finally:
+        hb.stop()
+    return _concat_results(parts)

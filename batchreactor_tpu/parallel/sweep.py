@@ -66,7 +66,7 @@ def resolve_pipeline_defaults(pipeline=None, poll_every=None):
     return bool(pipeline), int(poll_every)
 
 
-def _host_fetch(x, recorder=None):
+def _host_fetch(x, recorder=None, deadline=None):
     """THE main-thread blocking device->host transfer of the segmented
     drivers.  Every synchronous fetch the host loop performs goes through
     here so (a) the ``blocking_syncs`` counter lands in telemetry reports
@@ -74,9 +74,22 @@ def _host_fetch(x, recorder=None):
     and (b) the tier-1 host-sync regression gate can monkeypatch one name
     to count barriers.  The drainer thread's overlapped transfers do NOT
     use this — they are the non-blocking path this counter exists to
-    contrast with."""
+    contrast with.
+
+    ``deadline`` (seconds; the segmented drivers pass their resolved
+    ``fetch_deadline``) arms the resilience wedge watchdog: a fetch that
+    does not complete inside the deadline marks the device suspect,
+    emits a ``fault`` event + ``fetch_timeouts`` counter, and raises
+    ``resilience.WedgeError`` (docs/robustness.md) — so a wedged chip
+    surfaces as a retryable exception at this one choke point instead of
+    an invisible multi-hour hang."""
     if recorder is not None:
         recorder.counter("blocking_syncs")
+    if deadline is not None:
+        from ..resilience.watchdog import fetch_with_deadline
+
+        return fetch_with_deadline(x, deadline, recorder,
+                                   label="sweep-fetch")
     return jax.device_get(x)
 
 
@@ -352,7 +365,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              newton_tol=0.03, method="bdf",
                              setup_economy=False, stale_tol=0.3,
                              stats=False, recorder=None, watch=None,
-                             pipeline=None, poll_every=None, buckets=None):
+                             pipeline=None, poll_every=None, buckets=None,
+                             fetch_deadline=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -449,10 +463,27 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     padded lane count, which is how ``"lu32p"`` self-selects on TPU at
     large B x n.  ``precond_age`` accumulates across segments by max
     (it is a gauge), in both the host and the on-device accumulators.
+
+    ``fetch_deadline`` (seconds; ``None`` resolves from the
+    ``BR_FETCH_DEADLINE_S`` env lever, unset = off) arms the resilience
+    wedge watchdog on every main-thread blocking fetch (``_host_fetch``):
+    a breach raises ``resilience.WedgeError`` with the device marked
+    suspect and a ``fault`` event on the recorder — the retryable
+    surface ``checkpointed_sweep(retry=...)`` recovers from
+    (docs/robustness.md).  Purely host-side: the traced segment programs
+    are identical with the watchdog armed or off (brlint tier-B
+    ``resilience-noop-fork``).
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
     pipeline, poll_every = resolve_pipeline_defaults(pipeline, poll_every)
+    from ..resilience.watchdog import resolve_fetch_deadline
+
+    fetch_deadline = resolve_fetch_deadline(fetch_deadline)
+    # empty-dict spreading keeps the watchdog-off call signature
+    # byte-compatible with the 2-arg _host_fetch the host-sync gate test
+    # monkeypatches (and with any caller-shimmed fetch)
+    fkw = {} if fetch_deadline is None else {"deadline": fetch_deadline}
     if poll_every < 1:
         raise ValueError(f"poll_every must be >= 1, got {poll_every}")
     y0s = jnp.asarray(y0s)
@@ -515,7 +546,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 newton_tol=newton_tol, method=method,
                 setup_economy=setup_economy, stale_tol=float(stale_tol),
                 stats=stats, recorder=recorder, watch=watch,
-                progress=progress), B_live)
+                progress=progress, fetch_kw=fkw), B_live)
 
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
                                       dt_min_factor, linsolve,
@@ -553,7 +584,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 # addendum)
                 status, seg_acc, seg_rej, seg_t, seg_saved = _host_fetch(
                     (res.status, res.n_accepted, res.n_rejected, res.t,
-                     res.n_saved), recorder)
+                     res.n_saved), recorder, **fkw)
             # only lanes still live this segment contribute step counts:
             # parked lanes re-enter as zero-span solves that burn one
             # rejected attempt
@@ -562,7 +593,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
             n_rej += np.where(running, seg_rej, 0)
             if stats:
                 stats_acc = obs_counters.accumulate(
-                    stats_acc, _host_fetch(res.stats, recorder), running)
+                    stats_acc, _host_fetch(res.stats, recorder, **fkw),
+                    running)
             if n_save:
                 # drain this segment's device buffer into the host trajectory —
                 # vectorized masked scatter, no per-lane Python loop, and the
@@ -573,7 +605,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                                 0)
                 drained_ts = None
                 if take.max() > 0:
-                    seg_ts, seg_ys = _host_fetch((res.ts, res.ys), recorder)
+                    seg_ts, seg_ys = _host_fetch((res.ts, res.ys), recorder,
+                                                 **fkw)
                     col = np.arange(seg_ts.shape[1])
                     src = col[None, :] < take[:, None]           # (B, seg_save)
                     b_idx, c_idx = np.nonzero(src)
@@ -1048,11 +1081,12 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
                              observer, dt_min_factor, n_save, seg_save,
                              bundle_mode, jac_window, newton_tol, method,
                              setup_economy, stale_tol, stats, recorder,
-                             watch, progress):
+                             watch, progress, fetch_kw=None):
     """The pipelined gear of :func:`ensemble_solve_segmented` (module
     docstring): run-ahead dispatch with carry donation, device-resident
     termination/budget logic, strided polling, and the background
     trajectory drain.  Bit-exact against the blocking gear."""
+    fkw = fetch_kw or {}
     B = y0s.shape[0]
     jitted = _cached_vsolve_segmented_ctrl(
         rhs, rtol, atol, segment_steps, dt_min_factor, linsolve, jac,
@@ -1114,7 +1148,8 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
                 ctrl = carry[6]
                 with span_or_null(recorder, "poll", upto=seg) as sp:
                     status_np, acc_np = _host_fetch(
-                        (ctrl["final_status"], ctrl["n_acc"]), recorder)
+                        (ctrl["final_status"], ctrl["n_acc"]), recorder,
+                        **fkw)
                 if recorder is not None and sp["dur"] is not None:
                     # device-ahead attribution: poll wall-clock is the
                     # only time the pipelined host waits on the device
@@ -1130,7 +1165,7 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
     y, t_dev, h, e, obs, _sstate, ctrl = carry
     fs, ft, na, nr, t_np = _host_fetch(
         (ctrl["final_status"], ctrl["final_t"], ctrl["n_acc"],
-         ctrl["n_rej"], t_dev), recorder)
+         ctrl["n_rej"], t_dev), recorder, **fkw)
     flush_progress(fs, na, launched)
     fs = np.array(fs, copy=True)
     ft = np.array(ft, copy=True)

@@ -1,0 +1,80 @@
+"""Fault-tolerant sweep execution (the resilience subsystem).
+
+PERF.md's postmortems are operational, not numerical: a single wedged
+chip or SIGKILLed client has eaten 10+ hour sessions, and until this
+subsystem the mitigations were ad-hoc wrappers copy-pasted across
+``bench.py`` and the probe scripts.  This package makes them a library
+capability, threaded through the sweep/checkpoint/multihost stack
+(docs/robustness.md has the failure model):
+
+* **wedge watchdog** (:mod:`.watchdog`) — every blocking device fetch
+  can carry a deadline (``fetch_with_deadline`` /
+  ``block_with_deadline``; ``parallel/sweep.py``'s ``_host_fetch`` choke
+  point and the checkpointed chunk waits arm it via ``fetch_deadline``/
+  ``chunk_budget_s``).  A breach marks the device *suspect*, emits an
+  ``obs`` ``fault`` event + ``fetch_timeouts`` counter, and raises
+  :class:`~.watchdog.WedgeError` so the retry layer — not the operator's
+  10-hour session — absorbs the wedge.
+* **chunk retry/requeue** (:mod:`.policy` +
+  ``parallel.checkpoint.checkpointed_sweep(retry=...)``) — failed or
+  timed-out chunks re-solve with exponential backoff after a best-effort
+  backend reset, with a per-chunk attempt ledger in the checkpoint
+  manifest; in the multihost tier
+  (``parallel.multihost.elastic_checkpointed_sweep``) a dead process's
+  unfinished chunks are reassigned to survivors via heartbeat liveness.
+* **lane quarantine** (:mod:`.quarantine`) — non-success lanes are
+  re-solved in same-settings then tighter-tolerance fallback passes
+  (optionally cross-checked against the ``native/`` CPU oracle) instead
+  of poisoning the chunk; results carry a per-lane ``provenance`` field.
+* **fault injection** (:mod:`.inject`) — deterministic, test-only
+  simulation of a hung fetch, a killed process, a corrupt chunk file,
+  and a NaN lane, so every recovery path above is exercised in tier-1.
+* **guarded subprocesses** (:mod:`.guard`) — THE SIGTERM-with-grace
+  wrapper (``run_guarded``) the PERF.md postmortems demanded, now one
+  implementation shared by ``bench.py`` and every probe script.
+
+This module (and everything it imports at module scope) is importable
+WITHOUT jax: ``bench.py``'s parent orchestrator deliberately never
+imports jax so a device fault cannot kill it, and it reaches
+``run_guarded`` through the brlint-style lightweight namespace parent.
+All jax use inside the subsystem is lazy, inside functions.
+
+The layer is host-side by contract: with no injection and no faults the
+traced sweep programs are jaxpr-identical to the layer not existing
+(brlint tier-B ``resilience-noop-fork`` audits it, the same invariance
+class as the stats/economy no-op guarantees).
+"""
+
+from . import inject, quarantine  # noqa: F401  (submodule re-exports)
+from .guard import GuardedResult, run_guarded
+from .policy import (QuarantinePolicy, RETRYABLE, RetryPolicy,
+                     fallback_kwargs, normalize_quarantine, normalize_retry)
+from .quarantine import PROVENANCE_NAMES, native_oracle
+from .watchdog import (WedgeError, block_with_deadline, clear_suspects,
+                       fetch_with_deadline, mark_suspect, reset_backend,
+                       resolve_fetch_deadline, suspect_devices,
+                       terminate_self)
+
+__all__ = [
+    "GuardedResult",
+    "run_guarded",
+    "RetryPolicy",
+    "QuarantinePolicy",
+    "RETRYABLE",
+    "normalize_retry",
+    "normalize_quarantine",
+    "fallback_kwargs",
+    "PROVENANCE_NAMES",
+    "native_oracle",
+    "WedgeError",
+    "fetch_with_deadline",
+    "block_with_deadline",
+    "resolve_fetch_deadline",
+    "reset_backend",
+    "terminate_self",
+    "mark_suspect",
+    "suspect_devices",
+    "clear_suspects",
+    "inject",
+    "quarantine",
+]

@@ -1,0 +1,68 @@
+"""THE SIGTERM-with-grace subprocess wrapper.
+
+Round-2/3 postmortems (PERF.md, bench.py docstring): a SIGKILLed TPU
+client wedges the tunneled chip for >30 minutes, so every supervised
+child must get SIGTERM first — letting the runtime close the device
+cleanly — and SIGKILL only after a grace period.  That rule used to be
+copy-pasted (with drifting grace values and capture conventions) across
+``bench.py``, ``scripts/chip_session.py``, and six probe scripts; this
+module is the one implementation they all call now.
+
+Stdlib-only by design: the callers are parent orchestrators that
+deliberately never import jax (a device fault must not kill the
+supervisor), reaching this module through the brlint-style lightweight
+namespace parent instead of the package ``__init__``."""
+
+import dataclasses
+import signal
+import subprocess
+import time
+
+
+@dataclasses.dataclass
+class GuardedResult:
+    """Outcome of :func:`run_guarded`.  ``rc`` is the child's final
+    return code (negative = died to a signal); ``timed_out`` marks a
+    deadline breach (the child was SIGTERM'd, and SIGKILLed only if it
+    ignored the grace window); ``stderr`` is None under
+    ``merge_stderr``."""
+
+    rc: int
+    stdout: str
+    stderr: str
+    timed_out: bool
+    wall_s: float
+
+
+def run_guarded(cmd, timeout, *, grace_s=45.0, env=None, cwd=None,
+                merge_stderr=False, text=True):
+    """Run ``cmd`` with a deadline, enforcing SIGTERM-then-grace-then-
+    SIGKILL teardown (module doc).
+
+    ``timeout`` is the child's wall-clock budget in seconds; ``grace_s``
+    is how long a SIGTERM'd child gets to unwind (45 s default — the
+    measured time a healthy TPU client needs to close the device).
+    ``merge_stderr`` folds stderr into stdout (the chip-session log
+    convention); otherwise both streams return separately (the bench
+    convention).  ``env`` replaces the child environment when given
+    (pass ``{**os.environ, ...}`` to extend)."""
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        cmd, env=env, cwd=cwd, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT if merge_stderr else subprocess.PIPE,
+        text=text)
+    timed_out = False
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.send_signal(signal.SIGTERM)
+        try:
+            stdout, stderr = proc.communicate(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+    return GuardedResult(rc=proc.returncode, stdout=stdout or "",
+                         stderr=None if merge_stderr else (stderr or ""),
+                         timed_out=timed_out,
+                         wall_s=time.perf_counter() - t0)

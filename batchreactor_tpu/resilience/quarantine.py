@@ -1,0 +1,213 @@
+"""Lane quarantine: recover failed lanes instead of poisoning the chunk.
+
+A sweep's per-lane ``status`` array already isolates failures (a
+DT_UNDERFLOW lane never corrupts its neighbours — vmap independence),
+but before this module a failed lane simply STAYED failed in the
+artifact: the operator re-ran whole chunks by hand to chase a single
+NaN blowup.  :func:`resolve` automates the chase with an escalation
+ladder driven by :class:`~.policy.QuarantinePolicy`:
+
+1. **retry pass** — the WHOLE chunk re-solves with UNCHANGED settings
+   and only the quarantined lanes are taken from it.  Same program,
+   same shape, same inputs: transient corruption (an injected NaN, a
+   device glitch) recovers BIT-EXACTLY, because a lane-subset re-solve
+   would change the batch size and XLA's batch-dependent vectorization
+   perturbs results at the ulp level (parallel/sweep.py ``_pad_lanes``).
+2. **fallback pass** — survivors of pass 1 re-solve with tolerances
+   tightened by ``rtol_factor``/``atol_factor`` and the step budget
+   raised by ``max_steps_factor``: smaller steps walk through the
+   stiffness spike that blew up Newton, and exhausted budgets get room.
+3. **oracle pass** (optional) — the residue is handed lane-by-lane to
+   the ``native/`` CPU BDF (:func:`native_oracle`), the CVODE-class
+   cross-implementation this repo already trusts as its parity oracle.
+   A lane only the oracle can solve is a *solver* problem worth a
+   ticket, and the provenance field says exactly that.
+
+Lanes that survive every pass keep their primary-attempt fields and are
+marked ``failed``.  **Live (never-quarantined) lanes are untouched** —
+their results are bit-identical to a quarantine-off run, which is the
+recovery contract the fault-injection tests assert.
+
+Provenance rides ``SolveResult.provenance`` as an int8 per-lane code
+(``PROVENANCE_NAMES`` maps code -> name) and persists through
+checkpoint ``.npz`` artifacts."""
+
+import dataclasses
+
+import numpy as np
+
+#: per-lane provenance codes (int8); index into PROVENANCE_NAMES
+PRIMARY, RETRY, FALLBACK, ORACLE, FAILED = 0, 1, 2, 3, 4
+PROVENANCE_NAMES = ("primary", "retry", "fallback", "oracle", "failed")
+
+
+def _take_lanes(arrs, idx):
+    """Index dict-of-(B,...)-arrays by lane indices."""
+    import jax.numpy as jnp
+
+    ja = jnp.asarray(idx)
+    return {k: jnp.asarray(v)[ja] for k, v in arrs.items()}
+
+
+def _tree_take(res, idx, B):
+    """Lane-subset view of a SolveResult: index every (B,)-leading leaf."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: (x[idx] if hasattr(x, "ndim") and x.ndim >= 1
+                   and x.shape[0] == B else x), res)
+
+
+def merge_lanes(res, sub, idx):
+    """Scatter the subset result ``sub``'s lanes into ``res`` at batch
+    indices ``idx`` (host-side; every (B,)-leading leaf)."""
+    import jax
+    import jax.numpy as jnp
+
+    B = int(np.asarray(res.status).shape[0])
+    ja = jnp.asarray(np.asarray(idx))
+
+    def m(a, b):
+        if (hasattr(a, "ndim") and a.ndim >= 1 and a.shape[0] == B
+                and hasattr(b, "ndim")):
+            return jnp.asarray(a).at[ja].set(jnp.asarray(b))
+        return a
+
+    return jax.tree.map(m, res, sub)
+
+
+def provenance_counts(prov):
+    """``{name: lane count}`` for the non-primary provenance codes."""
+    prov = np.asarray(prov)
+    return {PROVENANCE_NAMES[c]: int((prov == c).sum())
+            for c in (RETRY, FALLBACK, ORACLE, FAILED)
+            if int((prov == c).sum())}
+
+
+def resolve(res, y0s, cfgs, solve_subset, *, policy, recorder=None,
+            oracle=None, lane_offset=0):
+    """Run the quarantine escalation ladder over ``res``'s failed lanes.
+
+    ``solve_subset(y0_sub, cfgs_sub, pass_name)`` re-solves a batch of
+    lanes; ``pass_name`` is ``"retry"`` (unchanged settings — called
+    with the FULL chunk so the re-solve is the primary program
+    bit-for-bit, module doc) or ``"fallback"`` (the quarantined subset
+    only; the caller applies ``policy.fallback_kwargs``).
+    ``oracle(y0_lane, cfg_lane)`` (optional) returns a NativeResult-like
+    object (``.t``/``.y``/``.status``/``.n_accepted``/``.n_rejected``)
+    or None.  ``lane_offset`` labels fault events with global lane
+    indices when resolving one chunk of a larger sweep.
+
+    Returns ``(res, provenance)`` — ``res`` with recovered lanes merged
+    in and ``provenance`` attached (always, even all-primary, so the
+    schema is uniform whenever quarantine is armed)."""
+    import jax.numpy as jnp
+
+    from ..solver.sdirk import SUCCESS
+
+    status0 = np.asarray(res.status)
+    B = int(status0.shape[0])
+    prov = np.zeros(B, dtype=np.int8)
+    bad = np.nonzero(status0 != SUCCESS)[0]
+    if bad.size:
+        if recorder is not None:
+            recorder.counter("lanes_quarantined", int(bad.size))
+            recorder.event("fault", kind="lane_quarantine",
+                           lanes=[int(lane_offset + i) for i in bad],
+                           statuses=[int(s) for s in status0[bad]])
+        y0s = jnp.asarray(y0s)
+        passes = ([("retry", RETRY)] if policy.retry_pass else [])
+        passes.append(("fallback", FALLBACK))
+        pending = bad
+        for pass_name, code in passes:
+            if not pending.size:
+                break
+            if pass_name == "retry":
+                # full-chunk re-solve: identical program on identical
+                # inputs, so a transiently-corrupted lane recovers
+                # BIT-EXACTLY (a subset re-solve would change the batch
+                # size and perturb at the ulp level)
+                full = solve_subset(y0s, cfgs, pass_name)
+                pick = jnp.asarray(pending)
+                sub = _tree_take(full, pick, B)
+            else:
+                sub = solve_subset(y0s[jnp.asarray(pending)],
+                                   _take_lanes(cfgs, pending), pass_name)
+            ok = np.asarray(sub.status) == SUCCESS
+            if ok.any():
+                rec_idx = pending[ok]
+                sub_sel = _tree_take(sub, jnp.asarray(np.nonzero(ok)[0]),
+                                     int(pending.size))
+                res = merge_lanes(res, sub_sel, rec_idx)
+                prov[rec_idx] = code
+            pending = pending[~ok]
+        if oracle is not None and pending.size:
+            for lane in pending.tolist():
+                out = oracle(np.asarray(y0s)[lane],
+                             {k: np.asarray(v)[lane]
+                              for k, v in cfgs.items()})
+                if out is None or out.status != "Success":
+                    continue
+                res = dataclasses.replace(
+                    res,
+                    t=jnp.asarray(res.t).at[lane].set(float(out.t)),
+                    y=jnp.asarray(res.y).at[lane].set(
+                        jnp.asarray(np.asarray(out.y))),
+                    status=jnp.asarray(res.status).at[lane].set(SUCCESS),
+                    n_accepted=jnp.asarray(res.n_accepted).at[lane].set(
+                        int(out.n_accepted)),
+                    n_rejected=jnp.asarray(res.n_rejected).at[lane].set(
+                        int(out.n_rejected)))
+                prov[lane] = ORACLE
+            pending = pending[prov[pending] != ORACLE]
+        prov[pending] = FAILED
+        if recorder is not None:
+            recovered = int(bad.size - pending.size)
+            if recovered:
+                recorder.counter("lanes_recovered", recovered)
+            if pending.size:
+                recorder.counter("lanes_unrecovered", int(pending.size))
+                recorder.event(
+                    "fault", kind="lane_unrecovered",
+                    lanes=[int(lane_offset + i) for i in pending])
+    res = dataclasses.replace(res, provenance=jnp.asarray(prov))
+    return res, prov
+
+
+def native_oracle(rhs, t0, t1, *, rtol=1e-6, atol=1e-10,
+                  max_steps=200_000):
+    """Per-lane CPU cross-check oracle over the generic native BDF
+    (``native.bindings.solve_bdf`` — the CVODE-class runtime this repo
+    uses as its parity baseline).  ``rhs(t, y, cfg)`` is the sweep's JAX
+    RHS; the returned callable matches :func:`resolve`'s ``oracle``
+    contract.  Returns None (with a warning) when the native runtime
+    cannot be built/loaded — quarantine then simply skips the oracle
+    pass instead of failing the sweep."""
+    try:
+        from ..native import bindings
+        bindings.load_library()
+    except Exception as e:  # noqa: BLE001 — oracle is best-effort
+        import warnings
+
+        warnings.warn(f"native oracle unavailable ({e}); quarantine "
+                      f"residue will not be cross-checked", RuntimeWarning,
+                      stacklevel=2)
+        return None
+
+    def oracle(y0_lane, cfg_lane):
+        import jax.numpy as jnp
+
+        cfg_j = {k: jnp.asarray(v) for k, v in cfg_lane.items()}
+
+        def f(t, y):
+            return np.asarray(rhs(t, jnp.asarray(y), cfg_j),
+                              dtype=np.float64)
+
+        try:
+            return bindings.solve_bdf(f, np.asarray(y0_lane), float(t0),
+                                      float(t1), rtol=rtol, atol=atol,
+                                      max_steps=max_steps)
+        except Exception:  # noqa: BLE001 — a failing oracle is "no answer"
+            return None
+
+    return oracle

@@ -1,0 +1,205 @@
+"""Wedge watchdog: deadline-bounded blocking device waits.
+
+The PERF.md chip postmortems share one shape: a blocking device->host
+wait (a fetch, a ``block_until_ready``) that never returns, invisible to
+the host until an operator kills the session hours later.  This module
+bounds every such wait with a wall-clock deadline: the wait runs on a
+watchdog worker thread, and if it does not complete inside the deadline
+the calling thread
+
+1. marks the devices involved *suspect* (:func:`mark_suspect` — a
+   process-wide registry the operator/driver can consult before
+   dispatching more work),
+2. emits an ``obs`` ``fault`` event (``kind="hung_fetch"``) and a
+   ``fetch_timeouts`` counter on the recorder when one is wired, and
+3. raises :class:`WedgeError` — so the retry layer
+   (``parallel.checkpoint.checkpointed_sweep(retry=...)``) can reset and
+   re-solve instead of the whole session dying with the chip.
+
+The abandoned worker thread keeps waiting on the wedged transfer (a
+Python thread cannot be killed); it is a daemon and costs one idle
+thread per wedge — the bounded price of turning an unbounded hang into
+an exception.  A process that wants the PERF.md teardown rule instead of
+an exception calls :func:`terminate_self` (SIGTERM-with-grace, so the
+TPU runtime closes the device cleanly — a SIGKILLed client wedges the
+tunneled chip for >30 min); subprocess clients get the same rule from
+:func:`~batchreactor_tpu.resilience.guard.run_guarded`.
+
+Deadlines are off by default (``None``): :func:`resolve_fetch_deadline`
+is THE resolution rule (the ``resolve_jac_window`` convention) — an
+explicit value passes through validated, ``None`` resolves from the
+``BR_FETCH_DEADLINE_S`` env lever (unset/empty/<=0 = watchdog off).
+jax imports are lazy so this module stays importable on jax-free hosts.
+"""
+
+import os
+import signal
+import threading
+import time
+
+
+class WedgeError(RuntimeError):
+    """A blocking device wait exceeded its watchdog deadline.
+
+    The device(s) involved are marked suspect (:func:`suspect_devices`)
+    before this is raised; ``elapsed_s``/``deadline_s``/``devices``
+    carry the breach details for ledgers and fault events."""
+
+    def __init__(self, message, *, elapsed_s=None, deadline_s=None,
+                 devices=()):
+        super().__init__(message)
+        self.elapsed_s = elapsed_s
+        self.deadline_s = deadline_s
+        self.devices = tuple(devices)
+
+
+_suspect_lock = threading.Lock()
+_SUSPECT = {}   # device repr -> unix time first marked
+
+
+def mark_suspect(device):
+    """Record ``device`` (any object; stored by ``str``) as suspect."""
+    with _suspect_lock:
+        _SUSPECT.setdefault(str(device), time.time())
+
+
+def suspect_devices():
+    """``{device_repr: unix_time_marked}`` snapshot of the registry."""
+    with _suspect_lock:
+        return dict(_SUSPECT)
+
+
+def clear_suspects():
+    """Empty the suspect registry (after a verified-healthy probe)."""
+    with _suspect_lock:
+        _SUSPECT.clear()
+
+
+def resolve_fetch_deadline(deadline=None):
+    """THE resolution rule for the per-fetch watchdog deadline: explicit
+    seconds pass through validated (> 0), ``None`` resolves from the
+    ``BR_FETCH_DEADLINE_S`` env lever; unset/empty/<= 0 means no
+    watchdog (the zero-overhead default)."""
+    if deadline is not None:
+        d = float(deadline)
+        if d <= 0:
+            raise ValueError(f"fetch deadline must be > 0 s, got {deadline}")
+        return d
+    env = os.environ.get("BR_FETCH_DEADLINE_S", "")
+    if not env:
+        return None
+    d = float(env)
+    return d if d > 0 else None
+
+
+def _devices_of(x):
+    """Best-effort device set of a pytree of jax arrays (for the suspect
+    registry and the fault event); empty on plain host values."""
+    devs = set()
+    try:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(x):
+            get = getattr(leaf, "devices", None)
+            if callable(get):
+                devs.update(str(d) for d in get())
+    except Exception:  # noqa: BLE001 — diagnostics must never mask the wedge
+        pass
+    return sorted(devs)
+
+
+def _guarded_wait(x, deadline_s, wait, recorder, label):
+    """Run ``wait(x)`` on a watchdog thread, bounded by ``deadline_s``.
+
+    One fresh thread per guarded wait, by design: a persistent worker
+    would be permanently lost to the first wedge (the abandoned wait
+    blocks it forever) and need respawning anyway, and the ~0.1 ms
+    create/join cost is noise against the 25-77 ms dispatch+sync floor
+    PERF.md measures per device round trip on the tunneled runtime —
+    and zero in the default (deadline-off) configuration."""
+    from . import inject
+
+    # test-only hook: the fault-injection harness simulates a hung fetch
+    # by delaying the wait INSIDE the worker, so the deadline machinery
+    # below fires exactly as it would on a real wedge
+    delay = inject.fetch_hang_delay()
+    out, exc = [], []
+
+    def work():
+        try:
+            if delay:
+                time.sleep(delay)
+            out.append(wait(x))
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            exc.append(e)
+
+    t0 = time.perf_counter()
+    worker = threading.Thread(target=work, daemon=True,
+                              name="br-watchdog-wait")
+    worker.start()
+    worker.join(deadline_s)
+    if worker.is_alive():
+        elapsed = time.perf_counter() - t0
+        devices = _devices_of(x)
+        for d in devices:
+            mark_suspect(d)
+        if recorder is not None:
+            recorder.counter("fetch_timeouts")
+            recorder.event("fault", kind="hung_fetch", label=label,
+                           deadline_s=float(deadline_s),
+                           elapsed_s=round(elapsed, 3), devices=devices)
+        raise WedgeError(
+            f"blocking device wait [{label}] exceeded its "
+            f"{deadline_s:g} s deadline ({elapsed:.1f} s elapsed); "
+            f"device(s) marked suspect: {devices or 'unknown'}",
+            elapsed_s=elapsed, deadline_s=deadline_s, devices=devices)
+    if exc:
+        raise exc[0]
+    return out[0]
+
+
+def fetch_with_deadline(x, deadline_s, recorder=None, *, label="fetch"):
+    """``jax.device_get(x)`` bounded by ``deadline_s`` (module doc)."""
+    import jax
+
+    return _guarded_wait(x, deadline_s, jax.device_get, recorder, label)
+
+
+def block_with_deadline(x, deadline_s, recorder=None, *, label="block"):
+    """``jax.block_until_ready(x)`` bounded by ``deadline_s`` — the
+    whole-chunk form the checkpointed sweep uses (``chunk_budget_s``)."""
+    import jax
+
+    return _guarded_wait(x, deadline_s, jax.block_until_ready, recorder,
+                         label)
+
+
+def reset_backend():
+    """Best-effort in-process recovery between chunk retries after a
+    wedge: drop every cached compiled program so the retry redispatches
+    from scratch.  A truly wedged device cannot be revived in-process —
+    that is what process-level supervision (:func:`terminate_self`,
+    ``guard.run_guarded``) is for — but transient stalls (tunnel hiccup,
+    runtime queue jam) recover here for the price of a re-trace."""
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001 — reset is advisory, retry decides
+        pass
+
+
+def terminate_self(grace_s=45.0):
+    """Enforce the PERF.md teardown rule on the CURRENT process: SIGTERM
+    self (letting the runtime close the device cleanly), escalating to
+    SIGKILL after ``grace_s`` if the graceful path itself wedges.  For
+    long-running drivers that prefer supervised replacement over
+    in-process retry; never called by the library itself."""
+
+    def _escalate():
+        time.sleep(grace_s)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    threading.Thread(target=_escalate, daemon=True,
+                     name="br-watchdog-sigkill").start()
+    os.kill(os.getpid(), signal.SIGTERM)

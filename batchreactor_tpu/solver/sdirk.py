@@ -81,6 +81,12 @@ class SolveResult:
     #                         per lane; None on default solves so the
     #                         pytree structure is unchanged when telemetry
     #                         is off
+    provenance: object = None  # (B,) int8 per-lane recovery provenance
+    #                            (resilience/quarantine.py codes: primary/
+    #                            retry/fallback/oracle/failed) — set HOST-
+    #                            side by the quarantine layer only; always
+    #                            None inside traced programs, so solver
+    #                            jaxprs are unchanged
 
 
 def _scaled_norm(e, y, rtol, atol):

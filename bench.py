@@ -33,13 +33,30 @@ Diagnostics go to stderr.
 """
 
 import contextlib
+import importlib.util
 import json
 import os
-import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_run_guarded():
+    """Load resilience/guard.py (stdlib-only by design) straight from its
+    file, WITHOUT importing the batchreactor_tpu package: the parent
+    orchestrator must never import jax (the package __init__ does), and a
+    namespace-parent shim would leak into the re-exec'd children and
+    shadow the real package init there."""
+    spec = importlib.util.spec_from_file_location(
+        "_br_resilience_guard",
+        os.path.join(REPO, "batchreactor_tpu", "resilience", "guard.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_guarded
+
+
+run_guarded = _load_run_guarded()
 # persistent XLA compilation cache: the sweep program at GRI scale takes
 # minutes to compile; entries survive across processes so the ladder's rungs
 # (and repeat bench runs) pay tracing once per program shape.  Pre-bake the
@@ -70,36 +87,24 @@ def _child(mode, timeout, extra_env=None):
     """Run this file in a subprocess with BENCH_MODE=mode; return
     (rc, parsed-last-json-line-or-None, stderr-tail).
 
-    On timeout the child gets SIGTERM and a 45 s grace period before
+    Teardown is ``resilience.run_guarded``'s SIGTERM + 45 s grace before
     SIGKILL: a SIGKILLed TPU client wedges the tunneled chip for >30 min
     (round-2/3 postmortem — the round-2 end-of-round probe failure was this
     bench's own earlier rung kill), while SIGTERM lets the runtime close
     the device cleanly."""
     env = {**os.environ, "BENCH_MODE": mode, **(extra_env or {})}
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
-                            env=env, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
-    timed_out = False
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        proc.terminate()
-        try:
-            stdout, stderr = proc.communicate(timeout=45)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            stdout, stderr = proc.communicate()
-    if timed_out:
-        return 124, None, (stderr or "")[-2000:]
+    r = run_guarded([sys.executable, os.path.abspath(__file__)], timeout,
+                    env=env)
+    if r.timed_out:
+        return 124, None, (r.stderr or "")[-2000:]
     parsed = None
-    for ln in reversed((stdout or "").strip().splitlines() or [""]):
+    for ln in reversed((r.stdout or "").strip().splitlines() or [""]):
         try:
             parsed = json.loads(ln)
             break
         except (json.JSONDecodeError, ValueError):
             continue
-    return proc.returncode, parsed, (stderr or "")[-2000:]
+    return r.rc, parsed, (r.stderr or "")[-2000:]
 
 
 # ----------------------------------------------------------------- children
@@ -353,7 +358,24 @@ def cpu_seconds_per_lane():
     return float(d["mean_wall_s"])
 
 
+_ROTATED = False
+
+
 def save_partial(state):
+    """Persist the per-rung progress artifact.  The FIRST write of a run
+    rotates any previous file to ``*.prev.json`` instead of clobbering
+    it — a bare re-invocation used to silently destroy the banked-rung
+    crash-recovery record of the last round (the artifact this file
+    exists to preserve); within a run, later writes update in place."""
+    global _ROTATED
+    if not _ROTATED:
+        if os.path.exists(PARTIAL):
+            prev = (PARTIAL[:-5] if PARTIAL.endswith(".json")
+                    else PARTIAL) + ".prev.json"
+            os.replace(PARTIAL, prev)
+            log(f"rotated previous {os.path.basename(PARTIAL)} -> "
+                f"{os.path.basename(prev)}")
+        _ROTATED = True
     with open(PARTIAL, "w") as f:
         json.dump(state, f, indent=1)
 

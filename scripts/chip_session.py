@@ -29,42 +29,37 @@ Usage (ALWAYS as a background task):
 Writes CHIP_SESSION.json progress after every step.
 """
 
+import importlib.util
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "CHIP_SESSION.json")
 
+# SIGTERM-with-grace teardown now lives in the library (resilience/
+# guard.py, stdlib-only by design) — loaded straight from its file so
+# this orchestrator keeps its no-jax-import guarantee (a wedged chip
+# must not be able to hang the supervisor)
+_spec = importlib.util.spec_from_file_location(
+    "_br_resilience_guard",
+    os.path.join(REPO, "batchreactor_tpu", "resilience", "guard.py"))
+_guard = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_guard)
+run_guarded = _guard.run_guarded
+
 
 def run(cmd, timeout, extra_env=None, label=""):
     env = {**os.environ, **(extra_env or {})}
-    t0 = time.time()
     print(f"=== {label or cmd}: start (timeout {timeout}s)",
           file=sys.stderr, flush=True)
-    proc = subprocess.Popen(cmd, env=env, cwd=REPO,
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-        timed_out = False
-    except subprocess.TimeoutExpired:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            out, _ = proc.communicate(timeout=45)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            out, _ = proc.communicate()
-        timed_out = True
-    wall = time.time() - t0
-    print((out or "")[-1500:], file=sys.stderr, flush=True)
-    print(f"=== {label}: rc={proc.returncode} timed_out={timed_out} "
-          f"{wall:.0f}s", file=sys.stderr, flush=True)
-    return {"label": label, "rc": proc.returncode, "timed_out": timed_out,
-            "wall_s": round(wall, 1), "tail": (out or "")[-1200:]}
+    r = run_guarded(cmd, timeout, env=env, cwd=REPO, merge_stderr=True)
+    print((r.stdout or "")[-1500:], file=sys.stderr, flush=True)
+    print(f"=== {label}: rc={r.rc} timed_out={r.timed_out} "
+          f"{r.wall_s:.0f}s", file=sys.stderr, flush=True)
+    return {"label": label, "rc": r.rc, "timed_out": r.timed_out,
+            "wall_s": round(r.wall_s, 1), "tail": (r.stdout or "")[-1200:]}
 
 
 def probe():

@@ -12,11 +12,21 @@ Usage (background task):  python scripts/chip_watch.py
   CW_INTERVAL=600 CW_MAX_S=39600 CW_PROBE_TIMEOUT=120 ...
 """
 
+import importlib.util
 import os
-import signal
-import subprocess
 import sys
 import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# THE SIGTERM-with-grace rule lives in resilience/guard.py (stdlib-only);
+# loaded from its file so this watcher never imports jax itself
+_spec = importlib.util.spec_from_file_location(
+    "_br_resilience_guard",
+    os.path.join(REPO, "batchreactor_tpu", "resilience", "guard.py"))
+_guard = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_guard)
+run_guarded = _guard.run_guarded
 
 PROBE = (
     "import jax, jax.numpy as jnp;"
@@ -27,20 +37,10 @@ PROBE = (
 
 
 def probe_once(timeout):
-    proc = subprocess.Popen([sys.executable, "-c", PROBE],
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.DEVNULL, text=True)
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-        return proc.returncode == 0 and "healthy" in (out or ""), out
-    except subprocess.TimeoutExpired:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.communicate(timeout=30)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.communicate()
+    r = run_guarded([sys.executable, "-c", PROBE], timeout)
+    if r.timed_out:
         return False, "timeout"
+    return r.rc == 0 and "healthy" in (r.stdout or ""), r.stdout
 
 
 def main():

@@ -28,15 +28,23 @@ stages still run (each is independent).  Usage:
   CCP_B=16 ...                                          # smaller batch
 """
 
+import importlib.util
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# THE SIGTERM-with-grace rule lives in resilience/guard.py (stdlib-only);
+# loaded from its file so the parent ladder never imports jax
+_spec = importlib.util.spec_from_file_location(
+    "_br_resilience_guard",
+    os.path.join(REPO, "batchreactor_tpu", "resilience", "guard.py"))
+_guard = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_guard)
+run_guarded = _guard.run_guarded
 
 LIB = os.environ.get("BR_LIB", "/root/reference/test/lib")
 if not os.path.isdir(LIB):
@@ -150,40 +158,26 @@ def main():
         print(f"--- {stage} (timeout {timeout}s)", file=sys.stderr,
               flush=True)
         env = {**os.environ, "CCP_STAGE": stage}
-        t0 = time.time()
-        proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
-                                env=env, stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE, text=True)
-        try:
-            stdout, stderr = proc.communicate(timeout=timeout)
-            timed_out = False
-        except subprocess.TimeoutExpired:
-            proc.send_signal(signal.SIGTERM)
-            try:
-                stdout, stderr = proc.communicate(timeout=45)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                stdout, stderr = proc.communicate()
-            timed_out = True
-        rec = {"stage": stage, "rc": proc.returncode,
-               "timed_out": timed_out,
-               "wall_s": round(time.time() - t0, 1)}
-        for line in (stdout or "").splitlines():
+        r = run_guarded([sys.executable, os.path.abspath(__file__)],
+                        timeout, env=env)
+        rec = {"stage": stage, "rc": r.rc, "timed_out": r.timed_out,
+               "wall_s": round(r.wall_s, 1)}
+        for line in (r.stdout or "").splitlines():
             try:
                 rec.update(json.loads(line))
                 break
             except json.JSONDecodeError:
                 continue
         if not rec.get("ok"):
-            rec["stderr_tail"] = (stderr or "")[-800:]
+            rec["stderr_tail"] = (r.stderr or "")[-800:]
         results.append(rec)
         print(json.dumps(rec), file=sys.stderr, flush=True)
         with open(out_path, "w") as fh:
             json.dump({"stages": results, "lib": LIB}, fh, indent=1)
-        if stage == "s0_probe" and (timed_out or proc.returncode != 0):
+        if stage == "s0_probe" and (r.timed_out or r.rc != 0):
             print("chip unreachable; aborting ladder", file=sys.stderr)
             break
-        if timed_out and os.environ.get("CCP_ABORT_ON_TIMEOUT") == "1":
+        if r.timed_out and os.environ.get("CCP_ABORT_ON_TIMEOUT") == "1":
             # round-4 lesson: the SIGTERM'd mid-compile client likely
             # wedged the tunnel, so every later stage would measure the
             # wedge, not the program — stop and leave the chip alone

@@ -41,7 +41,31 @@ SECTIONS = [
       "ignition_delay", "sweep_report", "save_result", "load_result"]),
     ("Multi-host (DCN) tier", "batchreactor_tpu.parallel.multihost",
      ["initialize", "global_mesh", "scatter_batch", "gather_batch",
-      "ensemble_solve_multihost"]),
+      "ensemble_solve_multihost", "elastic_checkpointed_sweep",
+      "host_liveness"]),
+    # the intro carries the knob/fault table — docstring first paragraphs
+    # are prose-wrapped, so tables live here
+    ("Fault tolerance", "batchreactor_tpu.resilience",
+     ["run_guarded", "RetryPolicy", "QuarantinePolicy", "normalize_retry",
+      "normalize_quarantine", "WedgeError", "fetch_with_deadline",
+      "block_with_deadline", "resolve_fetch_deadline", "reset_backend",
+      "terminate_self", "mark_suspect", "suspect_devices",
+      "clear_suspects", "native_oracle"],
+     """\
+The resilience layer (failure model, recovery semantics and the
+fault-injection harness: docs/robustness.md) turns the four postmortem
+fault classes into recoverable events:
+
+| fault            | detection                                  | recovery |
+|------------------|--------------------------------------------|----------|
+| wedged fetch     | watchdog deadline (`fetch_deadline=`, `chunk_budget_s=`) | `WedgeError` -> chunk `retry=` with backend reset |
+| killed process   | heartbeat liveness (`elastic_checkpointed_sweep`) | survivor steals + re-solves the dead owner's chunks |
+| corrupt chunk    | load validation on resume                  | file set aside as `*.corrupt`, chunk re-solved |
+| failed lane      | per-lane `status` (`quarantine=`)          | same-settings retry -> tighter-tol fallback -> optional native oracle |
+
+Every recovery path emits `fault` events and counters on the `obs`
+recorder and is exercised in tier-1 by the deterministic injection hooks
+in `resilience.inject` (`BR_FAULT_INJECT`)."""),
     ("Observability", "batchreactor_tpu.obs",
      ["Recorder", "CompileWatch", "build_report", "render", "diff",
       "stats_totals", "to_jsonl", "from_jsonl", "to_prometheus",

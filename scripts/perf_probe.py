@@ -17,15 +17,24 @@ shifts are ~2.5e-5 at worst, PERF.md).  Results land in PERF_PROBE.json.
 Run only on a healthy chip (the probe pre-flights like bench.py).
 """
 
+import importlib.util
 import json
 import os
-import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 OUT = os.path.join(REPO, "PERF_PROBE.json")
+
+# THE SIGTERM-with-grace rule lives in resilience/guard.py (stdlib-only);
+# loaded from its file so this orchestrator never imports jax
+_spec = importlib.util.spec_from_file_location(
+    "_br_resilience_guard",
+    os.path.join(REPO, "batchreactor_tpu", "resilience", "guard.py"))
+_guard = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_guard)
+run_guarded = _guard.run_guarded
 
 # every variant pins BENCH_METHOD, BR_EXP32 and BENCH_LINSOLVE explicitly:
 # bench.py's rung mode now DEFAULTS to the winning config (method=bdf,
@@ -84,27 +93,17 @@ def log(msg):
 
 def child(mode, timeout, extra_env):
     env = {**os.environ, "BENCH_MODE": mode, **extra_env}
-    proc = subprocess.Popen([sys.executable, BENCH], env=env,
-                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                            text=True)
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.terminate()  # SIGTERM — a SIGKILLed TPU client wedges the chip
-        try:
-            stdout, stderr = proc.communicate(timeout=45)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            stdout, stderr = proc.communicate()
-        return 124, None, (stderr or "")[-1500:]
+    r = run_guarded([sys.executable, BENCH], timeout, env=env)
+    if r.timed_out:
+        return 124, None, (r.stderr or "")[-1500:]
     parsed = None
-    for ln in reversed((stdout or "").strip().splitlines() or [""]):
+    for ln in reversed((r.stdout or "").strip().splitlines() or [""]):
         try:
             parsed = json.loads(ln)
             break
         except (json.JSONDecodeError, ValueError):
             continue
-    return proc.returncode, parsed, (stderr or "")[-1500:]
+    return r.rc, parsed, (r.stderr or "")[-1500:]
 
 
 def main():

@@ -15,15 +15,22 @@ Usage:
   TPU_SMOKE_TIMEOUT=900 TPU_SMOKE_K=file_driven python scripts/tpu_smoke.py
 """
 
+import importlib.util
 import json
 import os
 import re
-import signal
-import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the SIGTERM-with-grace rule lives in resilience/guard.py (stdlib-only);
+# loaded from its file so this runner never imports jax
+_spec = importlib.util.spec_from_file_location(
+    "_br_resilience_guard",
+    os.path.join(REPO, "batchreactor_tpu", "resilience", "guard.py"))
+_guard = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_guard)
+run_guarded = _guard.run_guarded
 
 
 def main():
@@ -38,22 +45,8 @@ def main():
     if os.environ.get("TPU_SMOKE_K"):
         cmd += ["-k", os.environ["TPU_SMOKE_K"]]
 
-    t0 = time.time()
-    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
-    try:
-        stdout, _ = proc.communicate(timeout=timeout)
-        timed_out = False
-    except subprocess.TimeoutExpired:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            stdout, _ = proc.communicate(timeout=45)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            stdout, _ = proc.communicate()
-        timed_out = True
-    wall = time.time() - t0
+    r = run_guarded(cmd, timeout, env=env, cwd=REPO, merge_stderr=True)
+    stdout = r.stdout
 
     counts = {}
     m = re.search(r"(\d+) passed", stdout or "")
@@ -67,11 +60,11 @@ def main():
 
     rec = {
         "tier": "tpu-smoke (-m tpu)",
-        "rc": proc.returncode,
-        "timed_out": timed_out,
-        "wall_s": round(wall, 1),
+        "rc": r.rc,
+        "timed_out": r.timed_out,
+        "wall_s": round(r.wall_s, 1),
         "counts": counts,
-        "ok": (not timed_out and proc.returncode == 0
+        "ok": (not r.timed_out and r.rc == 0
                and counts["passed"] > 0 and counts["failed"] == 0),
         "output_tail": (stdout or "")[-3000:],
     }

@@ -6,7 +6,12 @@ reference has nothing here; our scaling surface must).
 The test spawns both processes from a child script (jax.distributed cannot
 re-initialize inside a pytest process that already has a backend), waits
 for both, and asserts the multihost sweep result matches a single-process
-reference solve bit-for-tolerance."""
+reference solve bit-for-tolerance.
+
+The elastic (wedge-resilient) tier below it has the opposite topology:
+NO collectives, coordination through the shared checkpoint dir only
+(``multihost.elastic_checkpointed_sweep``), which is exactly what lets
+its dead-process test kill one process mid-sweep and still finish."""
 
 import json
 import os
@@ -120,3 +125,122 @@ def test_two_process_global_mesh_matches_single(tmp_path, lib_dir):
                                rtol=1e-9, atol=1e-14)
     np.testing.assert_allclose(np.asarray(got["t"]), np.asarray(ref.t),
                                rtol=1e-12)
+
+
+# --------------------------------------------------------------------------
+# elastic tier: dead-process chunk reassignment (resilience/)
+# --------------------------------------------------------------------------
+ELASTIC_CHILD = r"""
+import json, os, sys
+pid, n, ckpt = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+from batchreactor_tpu.obs.recorder import Recorder
+from batchreactor_tpu.parallel import multihost as mh
+from batchreactor_tpu.solver.sdirk import SUCCESS
+
+
+def rhs(t, y, cfg):
+    return -cfg["k"] * y
+
+
+B = 16
+y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (B, 2))
+cfgs = {"k": jnp.logspace(1.0, 2.0, B)}
+rec = Recorder()
+res = mh.elastic_checkpointed_sweep(
+    rhs, y0s, 0.0, 1.0, cfgs, ckpt, process_id=pid, num_processes=n,
+    chunk_size=4, heartbeat_s=0.2, timeout_s=120.0, recorder=rec,
+    chunk_log=lambda m: print(m, file=sys.stderr, flush=True))
+assert np.all(np.asarray(res.status) == SUCCESS), res.status
+_s, _e, counters = rec.snapshot()
+print("RESULT " + json.dumps({"pid": pid,
+                              "y": np.asarray(res.y).tolist(),
+                              "t": np.asarray(res.t).tolist(),
+                              "counters": counters}))
+"""
+
+
+def rhs(t, y, cfg):
+    """Module-level so its qualname matches ELASTIC_CHILD's ``rhs`` —
+    the sweep fingerprint hashes qualname + bytecode, and the in-test
+    resume below must land in the children's checkpoint dir."""
+    return -cfg["k"] * y
+
+
+@pytest.mark.slow
+def test_elastic_sweep_survivor_completes_dead_process_chunks(tmp_path):
+    """Satellite: one process is killed mid-sweep (injected SIGKILL-class
+    exit before its chunk save — file missing, claim stale); the survivor
+    detects the dead heartbeat, steals the chunk, and completes the sweep
+    with results bit-exact vs a single-process run."""
+    child = tmp_path / "elastic_child.py"
+    child.write_text(ELASTIC_CHILD)
+    ckpt = tmp_path / "ck"
+    env = {**os.environ, "PYTHONPATH": str(REPO)}
+    # chunks round-robin over 2 processes: p1 owns chunks 1 and 3.  The
+    # injected kill fires before chunk 1's save — p1's FIRST chunk, whose
+    # claim lands at startup, so the faster p0 cannot legitimately claim
+    # it first (its other chunk 3 may be picked up as ordinary idle work
+    # stealing before p1 dies; chunk 1 forces the dead-owner path)
+    env_victim = {**env, "BR_FAULT_INJECT": "kill:chunk=1"}
+    procs = [
+        subprocess.Popen([sys.executable, str(child), "0", "2", str(ckpt)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True,
+                         cwd=str(tmp_path)),
+        subprocess.Popen([sys.executable, str(child), "1", "2", str(ckpt)],
+                         env=env_victim, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True,
+                         cwd=str(tmp_path)),
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    # the victim died to the injected kill (os._exit(137), the SIGKILL rc)
+    assert procs[1].returncode == 137, outs[1][-3000:]
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    payload = next(line for line in outs[0].splitlines()
+                   if line.startswith("RESULT "))
+    got = json.loads(payload[len("RESULT "):])
+    # the survivor recorded the reassignment (counter + log line)
+    assert got["counters"].get("chunks_reassigned") == 1
+    assert "reassigned chunk 1 from dead p1" in outs[0]
+    # claim file records the theft for forensics
+    claim = json.load(open(ckpt / "chunk_00001.npz.claim"))
+    assert claim == {"pid": 0, "time": claim["time"], "stolen_from": 1}
+
+    # single-process reference: bit-exact (same CPU program, any host)
+    import jax.numpy as jnp
+
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    B = 16
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (B, 2))
+    cfgs = {"k": jnp.logspace(1.0, 2.0, B)}
+    ref = checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs,
+                             str(tmp_path / "ref"), chunk_size=4)
+    np.testing.assert_array_equal(np.asarray(got["y"]), np.asarray(ref.y))
+    np.testing.assert_array_equal(np.asarray(got["t"]), np.asarray(ref.t))
+
+    # the directory interoperates with single-process resume: every chunk
+    # loads, nothing re-solves (honest fingerprint across reassignment)
+    from batchreactor_tpu.obs.recorder import Recorder
+
+    rec = Recorder()
+    resumed = checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs, str(ckpt),
+                                 chunk_size=4, recorder=rec)
+    _spans, events, _ctrs = rec.snapshot()
+    assert sum(e["name"] == "chunk_loaded" for e in events) == 4
+    np.testing.assert_array_equal(np.asarray(resumed.y), np.asarray(ref.y))

@@ -1,0 +1,606 @@
+"""Fault-tolerance layer (resilience/ — docs/robustness.md).
+
+Every recovery path is exercised in tier-1 on a tiny stiff decay ODE via
+the deterministic fault-injection harness (resilience/inject.py): a hung
+fetch, a corrupt/truncated chunk file, and a NaN lane here, plus the
+killed-process path in tests/test_multihost.py.  The recovery contract
+asserted throughout: live (never-faulted) lanes are BIT-EXACT against an
+uninjected run — recovery may never perturb healthy results.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchreactor_tpu.obs.recorder import Recorder
+from batchreactor_tpu.resilience import (GuardedResult, QuarantinePolicy,
+                                         RetryPolicy, WedgeError,
+                                         clear_suspects, inject,
+                                         normalize_quarantine,
+                                         normalize_retry,
+                                         resolve_fetch_deadline, run_guarded,
+                                         suspect_devices)
+from batchreactor_tpu.solver.sdirk import (DT_UNDERFLOW,
+                                           MAX_STEPS_REACHED, SUCCESS)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injection():
+    """No armed plan (or suspect registry entry) may leak across tests."""
+    inject.disarm()
+    clear_suspects()
+    yield
+    inject.disarm()
+    clear_suspects()
+
+
+def _decay_rhs(t, y, cfg):
+    return -cfg["k"] * y
+
+
+def _decay_setup(B=8):
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (B, 2))
+    cfgs = {"k": jnp.logspace(1.0, 2.0, B)}
+    return y0s, cfgs
+
+
+def _ckpt_sweep(ckpt_dir, B=8, **kw):
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    y0s, cfgs = _decay_setup(B)
+    return checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                              str(ckpt_dir), chunk_size=4, **kw)
+
+
+def _assert_lanes_bit_exact(a, b, lanes=None):
+    """Bit-exact comparison of every value field, optionally lane-subset."""
+    for f in ("t", "y", "status", "n_accepted", "n_rejected"):
+        va, vb = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        if lanes is not None:
+            va, vb = va[lanes], vb[lanes]
+        np.testing.assert_array_equal(va, vb, err_msg=f"field {f}")
+
+
+# ------------------------------------------------------------------ policies
+def test_retry_policy_normalization_and_validation():
+    assert normalize_retry(None) is None
+    assert normalize_retry(False) is None
+    assert normalize_retry(True) == RetryPolicy()
+    assert normalize_retry(3).max_retries == 3
+    p = normalize_retry({"max_retries": 1, "backoff_s": 0.0})
+    assert (p.max_retries, p.backoff_s) == (1, 0.0)
+    assert normalize_retry(p) is p
+    assert p.delay(0) == 0.0
+    assert RetryPolicy(backoff_s=1.0).delay(2) == 4.0
+    with pytest.raises(ValueError, match="max_retries"):
+        normalize_retry(-1)
+    with pytest.raises(ValueError, match="bad retry policy"):
+        normalize_retry({"nope": 1})
+    with pytest.raises(ValueError, match="retry must be"):
+        normalize_retry("yes")
+
+
+def test_quarantine_policy_normalization_and_validation():
+    assert normalize_quarantine(None) is None
+    assert normalize_quarantine(True) == QuarantinePolicy()
+    q = normalize_quarantine({"oracle": True, "rtol_factor": 0.5})
+    assert q.oracle and q.rtol_factor == 0.5
+    assert normalize_quarantine(q) is q
+    with pytest.raises(ValueError, match="TIGHTENS"):
+        normalize_quarantine({"rtol_factor": 2.0})
+    with pytest.raises(ValueError, match="max_steps_factor"):
+        normalize_quarantine({"max_steps_factor": 0.5})
+    with pytest.raises(ValueError, match="bad quarantine policy"):
+        normalize_quarantine({"nope": 1})
+
+
+def test_resolve_fetch_deadline(monkeypatch):
+    assert resolve_fetch_deadline(5.0) == 5.0
+    with pytest.raises(ValueError, match="> 0"):
+        resolve_fetch_deadline(0)
+    monkeypatch.delenv("BR_FETCH_DEADLINE_S", raising=False)
+    assert resolve_fetch_deadline(None) is None
+    monkeypatch.setenv("BR_FETCH_DEADLINE_S", "7.5")
+    assert resolve_fetch_deadline(None) == 7.5
+    monkeypatch.setenv("BR_FETCH_DEADLINE_S", "0")
+    assert resolve_fetch_deadline(None) is None
+
+
+# ------------------------------------------------------------------ injection
+def test_inject_spec_parsing_and_firing_counts():
+    inject.arm("hang_fetch:delay=2,count=2;nan_lane:lane=3")
+    assert inject.active()
+    assert inject.fetch_hang_delay() == 2.0
+    assert inject.fetch_hang_delay() == 2.0
+    assert inject.fetch_hang_delay() == 0.0   # count exhausted
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        inject.arm("melt_chip")
+    with pytest.raises(ValueError, match="malformed fault param"):
+        inject.arm("kill:chunk")
+
+
+# ------------------------------------------------------------------ watchdog
+def test_watchdog_hung_fetch_raises_and_marks_suspect():
+    from batchreactor_tpu.resilience.watchdog import fetch_with_deadline
+
+    x = jnp.arange(4.0)
+    # un-delayed wait completes inside the deadline
+    np.testing.assert_array_equal(fetch_with_deadline(x, 30.0),
+                                  np.arange(4.0))
+    inject.arm("hang_fetch:delay=10")
+    rec = Recorder()
+    t0 = time.perf_counter()
+    with pytest.raises(WedgeError) as ei:
+        fetch_with_deadline(x, 0.3, rec, label="test-fetch")
+    assert time.perf_counter() - t0 < 5.0   # deadline, not the hang
+    assert ei.value.deadline_s == 0.3
+    assert suspect_devices()                # device registry populated
+    _spans, events, counters = rec.snapshot()
+    assert counters.get("fetch_timeouts") == 1
+    fault = next(e for e in events if e["name"] == "fault")
+    assert fault["attrs"]["kind"] == "hung_fetch"
+
+
+def test_segmented_fetch_deadline_surfaces_wedge():
+    from batchreactor_tpu.parallel import ensemble_solve_segmented
+
+    y0s, cfgs = _decay_setup(4)
+    inject.arm("hang_fetch:delay=10")
+    with pytest.raises(WedgeError):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 segment_steps=64, max_segments=50,
+                                 fetch_deadline=0.3)
+
+
+# ------------------------------------------------------------------ guard
+def test_run_guarded_clean_child():
+    r = run_guarded([sys.executable, "-c",
+                     "import sys; print('out'); "
+                     "print('err', file=sys.stderr)"], timeout=60)
+    assert isinstance(r, GuardedResult)
+    assert (r.rc, r.timed_out) == (0, False)
+    assert r.stdout.strip() == "out" and r.stderr.strip() == "err"
+    m = run_guarded([sys.executable, "-c",
+                     "import sys; print('both', file=sys.stderr)"],
+                    timeout=60, merge_stderr=True)
+    assert m.stderr is None and "both" in m.stdout
+
+
+def test_run_guarded_timeout_sigterm_then_grace():
+    # the child prints on SIGTERM and exits cleanly inside the grace
+    # window — proving the guard sent SIGTERM first, not SIGKILL
+    child = ("import signal, sys, time\n"
+             "signal.signal(signal.SIGTERM,"
+             " lambda *a: (print('terml'), sys.exit(3)))\n"
+             "print('up', flush=True)\n"
+             "time.sleep(60)\n")
+    r = run_guarded([sys.executable, "-c", child], timeout=1.5, grace_s=30)
+    assert r.timed_out
+    assert r.rc == 3                       # SIGTERM handler ran
+    assert "terml" in r.stdout
+    assert r.wall_s < 30                   # did not burn the grace window
+
+
+def test_run_guarded_sigkill_after_grace():
+    # child ignores SIGTERM -> the guard escalates to SIGKILL after grace
+    child = ("import signal, time\n"
+             "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+             "time.sleep(60)\n")
+    r = run_guarded([sys.executable, "-c", child], timeout=1.0, grace_s=1.0)
+    assert r.timed_out and r.rc == -signal.SIGKILL
+
+
+# ------------------------------------------------------ crash-atomic chunks
+def test_chunk_save_is_atomic_no_tmp_residue(tmp_path):
+    res = _ckpt_sweep(tmp_path / "ck")
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    names = sorted(os.listdir(tmp_path / "ck"))
+    assert "chunk_00000.npz" in names and "chunk_00001.npz" in names
+    assert not any(n.endswith(".tmp.npz") or n.endswith(".tmp")
+                   for n in names)
+
+
+def test_resume_resolves_truncated_chunk(tmp_path):
+    """Satellite regression: truncate one chunk mid-manifest; resume must
+    re-solve it (not crash) and reproduce the clean result bit-exactly."""
+    ck = tmp_path / "ck"
+    clean = _ckpt_sweep(ck)
+    victim = ck / "chunk_00001.npz"
+    size = victim.stat().st_size
+    with open(victim, "r+b") as fh:
+        fh.truncate(size // 2)
+    rec = Recorder()
+    resumed = _ckpt_sweep(ck, recorder=rec)
+    _assert_lanes_bit_exact(clean, resumed)
+    assert (ck / "chunk_00001.npz.corrupt").exists()   # kept for forensics
+    assert (ck / "chunk_00001.npz").exists()           # re-solved + saved
+    _spans, events, counters = rec.snapshot()
+    assert counters.get("chunks_corrupt") == 1
+    kinds = [e["attrs"].get("kind") for e in events if e["name"] == "fault"]
+    assert "corrupt_chunk" in kinds
+
+
+def test_injected_corrupt_chunk_recovers_bit_exact(tmp_path):
+    clean = _ckpt_sweep(tmp_path / "clean")
+    inject.arm("corrupt_chunk:chunk=0")
+    _ckpt_sweep(tmp_path / "faulted")            # tears chunk 0 post-save
+    resumed = _ckpt_sweep(tmp_path / "faulted")  # resume re-solves it
+    _assert_lanes_bit_exact(clean, resumed)
+
+
+# ------------------------------------------------------------ chunk retry
+def test_hung_chunk_retries_and_recovers_bit_exact(tmp_path):
+    clean = _ckpt_sweep(tmp_path / "clean")
+    inject.arm("hang_fetch:delay=10")
+    rec = Recorder()
+    res = _ckpt_sweep(tmp_path / "faulted", chunk_budget_s=0.3,
+                      retry={"max_retries": 2, "backoff_s": 0.0},
+                      recorder=rec)
+    _assert_lanes_bit_exact(clean, res)
+    _spans, events, counters = rec.snapshot()
+    assert counters.get("fetch_timeouts") == 1
+    assert counters.get("chunk_retries") == 1
+    # the attempt ledger records the failed attempt AND the recovery
+    attempts = json.load(open(tmp_path / "faulted" / "manifest.json"))[
+        "attempts"]
+    rows = attempts["0"]
+    assert [r["outcome"] for r in rows] == ["error", "ok"]
+    assert rows[0]["kind"] == "WedgeError"
+
+
+def test_wedge_without_retry_raises(tmp_path):
+    inject.arm("hang_fetch:delay=10")
+    with pytest.raises(WedgeError):
+        _ckpt_sweep(tmp_path / "ck", chunk_budget_s=0.3)
+
+
+def test_chunk_budget_resolution(monkeypatch):
+    from batchreactor_tpu.parallel.checkpoint import resolve_chunk_budget
+
+    assert resolve_chunk_budget(12.5) == 12.5
+    assert resolve_chunk_budget("auto") == "auto"
+    monkeypatch.delenv("BR_CHUNK_BUDGET_S", raising=False)
+    assert resolve_chunk_budget(None) is None
+    monkeypatch.setenv("BR_CHUNK_BUDGET_S", "42")
+    assert resolve_chunk_budget(None) == 42.0
+    monkeypatch.setenv("BR_CHUNK_BUDGET_S", "auto")
+    assert resolve_chunk_budget(None) == "auto"
+
+
+# --------------------------------------------------------- lane quarantine
+def test_nan_lane_quarantine_recovers_bit_exact(tmp_path):
+    from batchreactor_tpu.resilience.quarantine import PRIMARY, RETRY
+
+    clean = _ckpt_sweep(tmp_path / "clean")
+    inject.arm("nan_lane:lane=3")
+    rec = Recorder()
+    res = _ckpt_sweep(tmp_path / "faulted", quarantine=True, recorder=rec)
+    # the whole sweep — poisoned lane included — matches the clean run
+    # bit-exactly: the retry pass re-solves the full chunk with unchanged
+    # settings, so transient corruption recovers exactly
+    _assert_lanes_bit_exact(clean, res)
+    prov = np.asarray(res.provenance)
+    assert prov[3] == RETRY
+    assert np.all(np.delete(prov, 3) == PRIMARY)
+    _spans, events, counters = rec.snapshot()
+    assert counters.get("lanes_quarantined") == 1
+    assert counters.get("lanes_recovered") == 1
+    assert "lanes_unrecovered" not in counters
+    fault = next(e for e in events if e["name"] == "fault")
+    assert fault["attrs"] == {"kind": "lane_quarantine", "lanes": [3],
+                              "statuses": [int(DT_UNDERFLOW)]}
+
+
+def test_quarantine_provenance_persists_in_checkpoint(tmp_path):
+    from batchreactor_tpu.parallel.checkpoint import load_result
+    from batchreactor_tpu.resilience.quarantine import RETRY
+
+    inject.arm("nan_lane:lane=1")
+    _ckpt_sweep(tmp_path / "ck", quarantine=True)
+    chunk0, _cfgs = load_result(str(tmp_path / "ck" / "chunk_00000.npz"))
+    assert chunk0.provenance is not None
+    assert np.asarray(chunk0.provenance)[1] == RETRY
+    # resume serves the persisted provenance through concatenation
+    res = _ckpt_sweep(tmp_path / "ck", quarantine=True)
+    assert np.asarray(res.provenance)[1] == RETRY
+
+
+def test_quarantine_fallback_pass_raises_budget(tmp_path):
+    """A lane that exhausts max_steps is NOT transient: the same-settings
+    retry pass reproduces the failure, and the fallback pass (step budget
+    x max_steps_factor) is what recovers it."""
+    from batchreactor_tpu.resilience.quarantine import FALLBACK, PRIMARY
+
+    # the stiffest lanes need more than 40 attempts at these tolerances
+    clean = _ckpt_sweep(tmp_path / "clean", max_steps=2000)
+    failing = _ckpt_sweep(tmp_path / "low", max_steps=40)
+    bad = np.asarray(failing.status) != SUCCESS
+    assert bad.any(), "expected max_steps=40 to exhaust some lane"
+    rec = Recorder()
+    res = _ckpt_sweep(tmp_path / "faulted", max_steps=40,
+                      quarantine={"max_steps_factor": 50.0}, recorder=rec)
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    prov = np.asarray(res.provenance)
+    assert np.all(prov[bad] == FALLBACK)
+    assert np.all(prov[~bad] == PRIMARY)
+    # live lanes bit-exact against the SAME-settings clean run
+    _assert_lanes_bit_exact(_ckpt_sweep(tmp_path / "low2", max_steps=40),
+                            res, lanes=np.nonzero(~bad)[0])
+    np.testing.assert_array_equal(np.asarray(failing.status)[bad],
+                                  MAX_STEPS_REACHED)
+    # the recovered values come from a bigger-budget solve of the same
+    # lanes: tolerance-level agreement with the unconstrained clean run
+    np.testing.assert_allclose(np.asarray(res.y)[bad],
+                               np.asarray(clean.y)[bad],
+                               rtol=1e-4, atol=1e-9)
+
+
+def test_quarantine_residue_marked_failed(tmp_path):
+    """A lane nothing recovers keeps its primary fields, provenance
+    FAILED — graceful degradation, not an exception."""
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+    from batchreactor_tpu.resilience.quarantine import FAILED
+
+    y0s, cfgs = _decay_setup(4)
+    y0s = y0s.at[2, 0].set(jnp.nan)    # permanently poisoned input
+    rec = Recorder()
+    res = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                             str(tmp_path / "ck"), chunk_size=4,
+                             quarantine={"max_steps_factor": 1.0},
+                             recorder=rec)
+    assert np.asarray(res.status)[2] != SUCCESS
+    assert np.asarray(res.provenance)[2] == FAILED
+    _spans, events, counters = rec.snapshot()
+    assert counters.get("lanes_unrecovered") == 1
+    kinds = [e["attrs"].get("kind") for e in events if e["name"] == "fault"]
+    assert "lane_unrecovered" in kinds
+
+
+# ----------------------------------------------------- elastic tier knobs
+def test_elastic_sweep_retry_budget_quarantine(tmp_path):
+    """The elastic tier supports the checkpointed_sweep fault knobs
+    in-process (the dead-process path is tests/test_multihost.py): an
+    injected hung wait breaches the chunk budget, retries, and recovers;
+    an injected NaN lane quarantines; the knobs stay out of the
+    fingerprint so single-process checkpointed_sweep resume serves the
+    same directory."""
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+    from batchreactor_tpu.parallel.multihost import \
+        elastic_checkpointed_sweep
+
+    y0s, cfgs = _decay_setup(8)
+    clean = _ckpt_sweep(tmp_path / "clean")
+    inject.arm("hang_fetch:delay=10;nan_lane:lane=3")
+    rec = Recorder()
+    res = elastic_checkpointed_sweep(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, str(tmp_path / "el"),
+        process_id=0, num_processes=1, chunk_size=4,
+        chunk_budget_s=0.3, retry={"max_retries": 2, "backoff_s": 0.0},
+        quarantine=True, recorder=rec)
+    _assert_lanes_bit_exact(clean, res)
+    _spans, _events, counters = rec.snapshot()
+    assert counters.get("fetch_timeouts") == 1
+    assert counters.get("chunk_retries") == 1
+    assert counters.get("lanes_recovered") == 1
+    # fingerprint interop: a knob-free single-process resume loads every
+    # chunk from the elastic directory instead of re-solving
+    rec2 = Recorder()
+    resumed = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 str(tmp_path / "el"), chunk_size=4,
+                                 recorder=rec2)
+    _spans2, events2, _c2 = rec2.snapshot()
+    assert sum(e["name"] == "chunk_loaded" for e in events2) == 2
+    _assert_lanes_bit_exact(clean, resumed)
+
+
+def test_elastic_sweep_steals_torn_claim(tmp_path):
+    """A claim file torn between its O_EXCL create and the json.dump
+    (writer killed mid-claim) must age out like a dead owner's claim and
+    be stolen — not stall every survivor until timeout."""
+    from batchreactor_tpu.parallel.multihost import \
+        elastic_checkpointed_sweep
+
+    y0s, cfgs = _decay_setup(8)
+    ck = tmp_path / "el"
+    ck.mkdir()
+    torn = ck / "chunk_00000.npz.claim"
+    torn.write_text("")                      # unparsable: owner unknown
+    old = time.time() - 60.0
+    os.utime(torn, (old, old))               # already stale
+    rec = Recorder()
+    res = elastic_checkpointed_sweep(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, str(ck), process_id=0,
+        num_processes=1, chunk_size=4, heartbeat_s=0.2, timeout_s=60.0,
+        recorder=rec)
+    _assert_lanes_bit_exact(_ckpt_sweep(tmp_path / "clean"), res)
+    _spans, events, counters = rec.snapshot()
+    assert counters.get("chunks_reassigned") == 1
+    ev = next(e for e in events
+              if e["attrs"].get("kind") == "dead_host_reassign")
+    assert ev["attrs"]["dead_process"] == -1     # unknown torn-claim owner
+
+
+def test_elastic_sweep_resolves_corrupt_chunk(tmp_path):
+    """An existing-but-torn chunk file in an elastic checkpoint dir must
+    be set aside and re-solved (single-process resume convention) — the
+    exists() gate alone would treat it as complete forever."""
+    from batchreactor_tpu.parallel.multihost import \
+        elastic_checkpointed_sweep
+
+    y0s, cfgs = _decay_setup(8)
+    clean = _ckpt_sweep(tmp_path / "clean")
+    ck = tmp_path / "el"
+
+    def run(rec=None):
+        return elastic_checkpointed_sweep(
+            _decay_rhs, y0s, 0.0, 1.0, cfgs, str(ck), process_id=0,
+            num_processes=1, chunk_size=4, recorder=rec)
+
+    run()
+    victim = ck / "chunk_00001.npz"
+    with open(victim, "r+b") as fh:
+        fh.truncate(victim.stat().st_size // 2)
+    rec = Recorder()
+    res = run(rec)
+    _assert_lanes_bit_exact(clean, res)
+    assert (ck / "chunk_00001.npz.corrupt").exists()
+    _spans, _events, counters = rec.snapshot()
+    assert counters.get("chunks_corrupt") == 1
+
+
+def test_elastic_rejects_segmented_knobs_on_monolithic_path(tmp_path):
+    from batchreactor_tpu.parallel.multihost import \
+        elastic_checkpointed_sweep
+
+    y0s, cfgs = _decay_setup(4)
+    with pytest.raises(ValueError, match="segmented-path knobs"):
+        elastic_checkpointed_sweep(
+            _decay_rhs, y0s, 0.0, 1.0, cfgs, str(tmp_path / "el"),
+            process_id=0, num_processes=1, chunk_size=4,
+            fetch_deadline=30.0)
+
+
+# ------------------------------------------------------------- obs plumbing
+def test_fault_events_flow_through_exports(tmp_path):
+    from batchreactor_tpu.obs import export, report
+
+    inject.arm("nan_lane:lane=3")
+    rec = Recorder()
+    _ckpt_sweep(tmp_path / "ck", quarantine=True, recorder=rec)
+    rep = report.build_report(recorder=rec)
+    # JSONL round-trips the fault events exactly
+    rt = export.from_jsonl(export.to_jsonl(rep))
+    faults = [e for e in rt["events"] if e["name"] == "fault"]
+    assert faults and faults[0]["attrs"]["kind"] == "lane_quarantine"
+    # Prometheus aggregates them by kind
+    prom = export.to_prometheus(rep)
+    assert 'br_fault_events_total{kind="lane_quarantine"} 1' in prom
+    assert 'br_counter_total{name="lanes_recovered"} 1' in prom
+
+
+def test_diff_maps_missing_fault_counters_to_zero(tmp_path):
+    """Schema convention (the setup_reuses/cache_* rule): a fault-free
+    report has NO fault counters; diffing it against a faulted report
+    must read 0 -> n, and two fault-free reports must not differ."""
+    from batchreactor_tpu.obs import report
+
+    rec_clean = Recorder()
+    _ckpt_sweep(tmp_path / "clean", recorder=rec_clean)
+    inject.arm("nan_lane:lane=3")
+    rec_fault = Recorder()
+    _ckpt_sweep(tmp_path / "faulted", quarantine=True, recorder=rec_fault)
+    a = report.build_report(recorder=rec_clean)
+    b = report.build_report(recorder=rec_fault)
+    d = report.diff(a, b)
+    assert "lanes_quarantined: 0 -> 1" in d
+    assert "lanes_recovered: 0 -> 1" in d
+    assert "counter lanes_unrecovered" not in d    # 0 == 0: suppressed
+
+
+# --------------------------------------------------------------- api knobs
+def test_api_validates_resilience_knobs(h2o2_bundle):
+    import batchreactor_tpu as br
+
+    gm, thermo = h2o2_bundle
+    kw = dict(chem=br.Chemistry(gaschem=True), thermo_obj=thermo, md=gm)
+    comp = {"H2": 0.25, "O2": 0.25, "N2": 0.5}
+    with pytest.raises(ValueError, match="segmented-path knobs"):
+        br.batch_reactor_sweep(comp, [1200.0], 1e5, 1e-5,
+                               fetch_deadline=5.0, **kw)
+    with pytest.raises(ValueError, match="quarantine must be"):
+        br.batch_reactor_sweep(comp, [1200.0], 1e5, 1e-5,
+                               quarantine="yes", **kw)
+    with pytest.raises(ValueError, match="TIGHTENS"):
+        br.batch_reactor_sweep(comp, [1200.0], 1e5, 1e-5,
+                               quarantine={"rtol_factor": 3.0}, **kw)
+
+
+@pytest.fixture(scope="module")
+def h2o2_bundle(lib_dir):
+    import batchreactor_tpu as br
+
+    gm = br.compile_gaschemistry(f"{lib_dir}/h2o2.dat")
+    thermo = br.create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+    return gm, thermo
+
+
+@pytest.mark.slow
+def test_api_sweep_quarantine_provenance(h2o2_bundle):
+    """End-to-end: a healthy sweep under quarantine=True reports all-
+    primary provenance and an empty quarantine section — and is bit-exact
+    against quarantine=None (the zero-fault no-op contract).
+
+    slow: real-chemistry api drive (CI's unfiltered run executes it);
+    the decay-ODE tests above carry the tier-1 recovery contract — the
+    870 s tier-1 budget has ~no headroom for h2o2 compiles."""
+    import batchreactor_tpu as br
+
+    gm, thermo = h2o2_bundle
+    kw = dict(chem=br.Chemistry(gaschem=True), thermo_obj=thermo, md=gm)
+    comp = {"H2": 0.25, "O2": 0.25, "N2": 0.5}
+    base = br.batch_reactor_sweep(comp, [1150.0, 1250.0], 1e5, 1e-5, **kw)
+    out = br.batch_reactor_sweep(comp, [1150.0, 1250.0], 1e5, 1e-5,
+                                 quarantine=True, **kw)
+    assert np.all(out["provenance"] == 0)
+    assert out["report"]["quarantine"] == {}
+    for sp in base["x"]:
+        np.testing.assert_array_equal(out["x"][sp], base["x"][sp],
+                                      err_msg=f"species {sp}")
+    np.testing.assert_array_equal(out["status"], base["status"])
+    assert "provenance" not in base
+
+
+@pytest.mark.slow
+def test_api_sweep_quarantine_fallback_under_buckets(h2o2_bundle):
+    """The quarantine passes must honor the primary's execution config:
+    the retry pass re-runs the PRIMARY program (bucket padding included)
+    and the fallback pass recovers a budget-exhausted lane; live lanes
+    stay bit-exact against a same-settings quarantine-off run.
+
+    slow: real-chemistry api drive, see the provenance test's note."""
+    import batchreactor_tpu as br
+    from batchreactor_tpu.resilience.quarantine import FALLBACK
+    from batchreactor_tpu.solver.sdirk import SUCCESS
+
+    gm, thermo = h2o2_bundle
+    kw = dict(chem=br.Chemistry(gaschem=True), thermo_obj=thermo, md=gm,
+              buckets=(4,), max_steps=40)   # B=3 pads onto the 4-bucket
+    comp = {"H2": 0.25, "O2": 0.25, "N2": 0.5}
+    T = [1150.0, 1250.0, 1350.0]
+    base = br.batch_reactor_sweep(comp, T, 1e5, 1e-5, **kw)
+    bad = np.asarray(base["status"]) != SUCCESS
+    assert bad.any(), "expected max_steps=40 to exhaust some lane"
+    out = br.batch_reactor_sweep(comp, T, 1e5, 1e-5,
+                                 quarantine={"max_steps_factor": 100.0},
+                                 **kw)
+    assert np.all(np.asarray(out["status"]) == SUCCESS)
+    prov = np.asarray(out["provenance"])
+    assert np.all(prov[bad] == FALLBACK) and np.all(prov[~bad] == 0)
+    for sp in base["x"]:
+        np.testing.assert_array_equal(
+            np.asarray(out["x"][sp])[~bad], np.asarray(base["x"][sp])[~bad],
+            err_msg=f"live lanes, species {sp}")
+
+
+# ------------------------------------------------------------ bench rotation
+def test_bench_partial_rotation(tmp_path, monkeypatch):
+    import bench
+
+    partial = tmp_path / "bench_partial.json"
+    monkeypatch.setattr(bench, "PARTIAL", str(partial))
+    monkeypatch.setattr(bench, "_ROTATED", False)
+    partial.write_text('{"round": "previous"}')
+    bench.save_partial({"round": "current"})
+    prev = tmp_path / "bench_partial.prev.json"
+    assert json.load(open(prev)) == {"round": "previous"}
+    assert json.load(open(partial)) == {"round": "current"}
+    # second write of the SAME run updates in place, no double rotation
+    bench.save_partial({"round": "current2"})
+    assert json.load(open(prev)) == {"round": "previous"}
+    assert json.load(open(partial)) == {"round": "current2"}
