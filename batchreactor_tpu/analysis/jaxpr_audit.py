@@ -49,6 +49,19 @@ A seventh audit backs the fault-tolerance layer (``resilience/``):
   retry/quarantine machinery are host-side by contract; tracing the
   segment program with the layer fully armed (injection plan +
   ``BR_FETCH_DEADLINE_S``) must yield a byte-identical jaxpr.
+
+Two more back the continuous-batching admission layer
+(``parallel/sweep.py`` ``admission=``):
+
+* the compaction/admission program (``_compact_admit``) meets the same
+  purity contract as every traced program — gathers and selects only,
+  no callbacks, no in-loop staging;
+* **admission-noop-fork** — admission off must leave the segment
+  program byte-identical to the admission-less (PR-7) driver: the
+  segment program is re-traced after the admission machinery has been
+  built and must match the earlier trace byte-for-byte, guarding
+  against a future slot map or occupancy counter leaking into the
+  shared segment carry.
 """
 
 import functools
@@ -392,4 +405,50 @@ def run_audit(fixtures_dir=None):
             "deadline) changed the traced segment program: the fault-"
             "tolerance plumbing leaked into the trace (resilience/ "
             "host-side contract, docs/robustness.md)"))
+
+    # continuous batching (parallel/sweep.py admission=): (1) the traced
+    # compaction/admission program is pure gathers + selects — the same
+    # no-callback/no-staging contract as the solver programs; (2) the
+    # segment program re-traced AFTER the admission machinery has been
+    # built AND EXECUTED (a real streaming sweep runs below, so carry
+    # construction, compaction, harvest, and refill all actually
+    # happen) must stay byte-identical to the pre-admission trace
+    # (j_unarmed above) — the admission-off program IS the admission-
+    # less driver's by construction, and this audit pins that against a
+    # future slot map or occupancy counter leaking into the shared
+    # segment program or its carry builder.
+    carry_c = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
+                                         False, 0)
+    fresh_c = _sweep._init_segment_carry(jnp.zeros_like(y0b), 0.0, "bdf",
+                                         None, None, False, 0)
+    order_c = jnp.arange(2, dtype=jnp.int32)
+
+    def run_compact(c):
+        return _sweep._compact_admit(
+            c, cfgb, order_c, y0b, cfgb, fresh_c,
+            jnp.asarray(1, dtype=jnp.int32), jnp.asarray(1,
+                                                         dtype=jnp.int32))
+
+    jaxpr = jax.make_jaxpr(run_compact)(carry_c)
+    findings.extend(_audit_jaxpr("sweep-compact-admit", jaxpr,
+                                 check_dtype=False))
+    # tiny linear-decay streaming sweep: exercises the whole admission
+    # path (seed, poll, harvest, compact/refill) in well under a second
+    stream_res = _sweep.ensemble_solve_segmented(
+        lambda t, y, cfg: -cfg["k"] * y,
+        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (4, 2)), 0.0, 1.0,
+        {"k": jnp.asarray([10.0, 20.0, 40.0, 80.0])}, segment_steps=8,
+        max_segments=80, pipeline=True, admission=2, refill=1,
+        poll_every=1, method="bdf")
+    assert int(stream_res.status.sum()) == 4  # 4 lanes, all SUCCESS(=1)
+    j_post = str(jax.make_jaxpr(_run_seg(plain_seg_fn, cfgb))(carry_r))
+    if j_post != j_unarmed:
+        findings.append(Finding(
+            "admission-noop-fork", "<jaxpr:segment-admission-noop>",
+            0, 0,
+            "the segment program traced after building and running the "
+            "admission machinery differs from the admission-less "
+            "trace: the continuous-batching plumbing leaked into the "
+            "shared segment program (parallel/sweep.py admission-off "
+            "byte-identity contract)"))
     return findings

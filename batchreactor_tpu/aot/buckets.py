@@ -120,6 +120,28 @@ def resolve_bucket(B, buckets, *, mesh_size=1):
     return bucket
 
 
+def downshift_bucket(n_live, buckets, current, *, mesh_size=1):
+    """The smaller ladder rung a draining sweep can down-shift onto, or
+    ``None`` when no down-shift applies.
+
+    The streaming admission driver (``parallel/sweep.py``, ``admission=``)
+    calls this when its backlog is empty and ``n_live`` lanes remain
+    resident in a ``current``-lane program: if the canonical bucket for
+    ``n_live`` is strictly below ``current``, the carry is compacted and
+    sliced onto that smaller program — under a warmed AOT cache
+    (:func:`aot.warmup`) a zero-compile executable switch, since the
+    smaller rung is part of the same ladder the cache was baked for.
+    ``n_live=0`` is treated as 1 (the shape a last-lane program needs);
+    ``buckets=None`` (bucketing off) never down-shifts — there is no
+    canonical ladder to land on.
+    """
+    if buckets is None:
+        return None
+    target = resolve_bucket(max(int(n_live), 1), buckets,
+                            mesh_size=mesh_size)
+    return target if target < int(current) else None
+
+
 def bucket_ladder(lanes, buckets):
     """The deduplicated, sorted bucket set covering the given lane
     counts — what :func:`aot.warmup` compiles and ``scripts/

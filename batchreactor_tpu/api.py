@@ -777,7 +777,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         linsolve="auto", setup_economy=False, stale_tol=0.3,
                         analytic_jac=True, telemetry=False, pipeline=None,
                         poll_every=None, buckets=None, fetch_deadline=None,
-                        quarantine=None):
+                        quarantine=None, admission=None, refill=None):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -880,6 +880,25 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     (docs/robustness.md); ``None`` resolves from ``BR_FETCH_DEADLINE_S``
     (unset = off).
 
+    ``admission``/``refill`` (segmented runs only — explicit values with
+    ``segment_steps=0`` raise, the pipeline/poll_every loudness
+    convention; grammar ``parallel.sweep.resolve_admission``) turn on
+    continuous batching (docs/performance.md "Continuous batching"):
+    ``admission=k`` streams the B conditions through a ``k``-slot
+    resident program whose freed slots refill from the backlog once
+    ``refill`` of them park, with finished lanes harvested — and
+    un-shuffled back to caller lane order — between segment relaunches,
+    and a bucket down-shift onto the smaller warmed ``buckets`` rung
+    when the backlog drains.  ``admission=True`` keeps every lane
+    resident (compaction/down-shift only).  Incompatible with ``mesh=``
+    (loud error); results are positionally identical to the
+    admission-off sweep, bit-exact on the tier-1 matrix, with the
+    bucket-shape ulp caveat on down-shifted tails
+    (parallel/sweep.py).  Occupancy lands in the telemetry counters
+    (``lane_attempts``/``lane_capacity``, ``compactions``,
+    ``admitted_lanes``, ``bucket_downshifts`` —
+    docs/observability.md).
+
     ``quarantine`` (None/True/dict/``resilience.QuarantinePolicy``)
     recovers non-success lanes instead of reporting them failed: a
     same-settings full-batch retry pass (bit-exact for transient
@@ -902,15 +921,29 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         raise TypeError("batch_reactor_sweep needs chem= and thermo_obj=")
     if segment_steps <= 0 and (pipeline is not None
                                or poll_every is not None
-                               or fetch_deadline is not None):
+                               or fetch_deadline is not None
+                               or admission not in (None, False)
+                               or refill is not None):
         # loudness convention (cf. jac_window with backend='cpu'): these
         # knobs shape the segmented driver only — silently ignoring them
         # on the monolithic path would report a configuration that never
         # ran.  Checked up front with the other argument validation, so
         # the error fires before any mechanism parsing happens.
         raise ValueError(
-            "pipeline/poll_every/fetch_deadline are segmented-path knobs; "
-            "set segment_steps > 0 or drop the arguments")
+            "pipeline/poll_every/fetch_deadline/admission/refill are "
+            "segmented-path knobs; set segment_steps > 0 or drop the "
+            "arguments")
+    # admission grammar + mesh incompatibility validated up front too
+    # (resolve_admission is the one validation point; n_lanes is not
+    # known yet, so admission=True resolves later in the sweep driver)
+    from .parallel.sweep import resolve_admission
+
+    if admission is not True:
+        resolve_admission(admission, refill, n_lanes=1)
+    if admission not in (None, False) and mesh is not None:
+        raise ValueError(
+            "admission= is incompatible with mesh= (parallel/sweep.py "
+            "admission contract); drop one of them")
     # normalize the quarantine policy up front (loud ValueError on a bad
     # spec — resilience/policy.py is the one validation point), before
     # any mechanism parsing happens
@@ -1081,6 +1114,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                                            pipeline=pipeline,
                                            poll_every=poll_every,
                                            fetch_deadline=fetch_deadline,
+                                           admission=admission,
+                                           refill=refill,
                                            watch=watch if telemetry
                                            else None, **common)
         else:
@@ -1113,7 +1148,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                     rhs, y0s, 0.0, float(time), cfgs_padded,
                     segment_steps=segment_steps, pipeline=pipeline,
                     poll_every=poll_every, fetch_deadline=fetch_deadline,
-                    **common)
+                    admission=admission, refill=refill, **common)
             else:
                 r = ensemble_solve(rhs, y0s, 0.0, float(time),
                                    cfgs_padded, max_steps=max_steps,
@@ -1178,7 +1213,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
             recorder=rec, solver_stats=res.stats, watch=watch,
             meta={"entry": "batch_reactor_sweep", "mode": mode,
                   "method": method, "lanes": B, "bucket": bucket,
-                  "segmented": bool(segment_steps > 0)})
+                  "segmented": bool(segment_steps > 0),
+                  "admission": admission not in (None, False)})
     return out
 
 

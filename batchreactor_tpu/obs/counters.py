@@ -71,8 +71,35 @@ GAUGE_KEYS = ("precond_age",)
 FAULT_KEYS = ("fetch_timeouts", "chunk_retries", "chunks_corrupt",
               "chunks_reassigned", "lanes_quarantined", "lanes_recovered",
               "lanes_unrecovered")
+#: continuous-batching counters (parallel/sweep.py ``admission=`` —
+#: docs/performance.md "Continuous batching"): Recorder counters, not
+#: device stats.  ``compactions``/``admitted_lanes``/``bucket_downshifts``
+#: count the streaming driver's queue events and appear only when
+#: admission ran; ``lane_attempts``/``lane_capacity`` are the occupancy
+#: pair — useful LIVE-lane step attempts vs the device's attempt
+#: capacity (padded B x segments x segment_steps) — recorded by the
+#: pipelined driver whenever a recorder is armed, admission on OR off
+#: (that is the A/B surface), additive across sweeps/chunks so
+#: consumers derive occupancy = lane_attempts / lane_capacity
+#: (report.render, the ``br_sweep_occupancy`` Prometheus gauge).  A
+#: missing key means that surface didn't run (no recorder, blocking
+#: gear, or admission off for the queue counters) — ``obs.diff`` maps
+#: it to 0 (the FAULT_KEYS convention).
+ADMISSION_KEYS = ("compactions", "admitted_lanes", "bucket_downshifts",
+                  "lane_attempts", "lane_capacity")
+
 #: step_audit payloads folded into stats (not counters; excluded from sums)
 AUDIT_KEYS = ("accept_ring", "it_matrix")
+
+
+def occupancy(counters):
+    """Derived occupancy gauge: ``lane_attempts / lane_capacity`` from a
+    report's counter dict, or ``None`` when the pair is absent/zero (the
+    sweep did not run a segmented driver that records capacity)."""
+    cap = (counters or {}).get("lane_capacity")
+    if not cap:
+        return None
+    return float((counters or {}).get("lane_attempts", 0)) / float(cap)
 
 
 def masked_add(acc, seg, live):

@@ -128,6 +128,20 @@ def to_prometheus(report):
             [({"name": k}, v) for k, v in
              sorted((report.get("counters") or {}).items())])
 
+    # continuous batching (parallel/sweep.py admission=): occupancy is a
+    # DERIVED ratio of the additive lane_attempts/lane_capacity pair —
+    # a gauge, its own family (summing ratios across scrapes would be
+    # meaningless; the raw pair stays in br_counter_total)
+    from .counters import occupancy as _occupancy
+
+    occ = _occupancy(report.get("counters"))
+    if occ is not None:
+        _metric(lines, "br_sweep_occupancy", "gauge",
+                "Sweep step-attempt occupancy: useful per-lane attempts "
+                "/ device attempt capacity (continuous-batching "
+                "admission surface).",
+                [({}, round(occ, 6))])
+
     # fault/recovery events (resilience/ — docs/robustness.md) aggregate
     # by kind: the alerting surface for wedges, retries, reassignments,
     # and quarantines (the per-event detail stays in the JSONL export)

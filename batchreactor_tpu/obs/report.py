@@ -121,6 +121,10 @@ def render(report):
         lines.append("counters:")
         for k in sorted(ctrs):
             lines.append(f"  {k}: {ctrs[k]}")
+        occ = C.occupancy(ctrs)
+        if occ is not None:
+            lines.append(f"  occupancy: {occ:.4f} "
+                         f"(lane_attempts / lane_capacity)")
 
     st = (report.get("solver_stats") or {}).get("totals")
     if st:
@@ -207,14 +211,23 @@ def diff(a, b):
     ka, kb = a.get("counters") or {}, b.get("counters") or {}
     for k in sorted(set(ka) | set(kb)):
         va, vb = ka.get(k), kb.get(k)
-        if k in C.FAULT_KEYS:
-            # fault counters are absent from fault-free reports: missing
-            # is 0, not a difference (the setup_reuses/cache_* convention)
+        if k in C.FAULT_KEYS or k in C.ADMISSION_KEYS:
+            # fault/admission counters are absent from fault-free /
+            # admission-less reports: missing is 0, not a difference
+            # (the setup_reuses/cache_* convention)
             va, vb = va or 0, vb or 0
             if va == vb:
                 continue
         if va != vb:
             lines.append(f"  counter {k}: {_fmt_ctr(va)} -> {_fmt_ctr(vb)}")
+    # derived occupancy gauge (continuous batching): shown whenever either
+    # side recorded capacity, so an admission A/B reads as one ratio
+    # instead of two raw counter deltas
+    oa, ob = C.occupancy(ka), C.occupancy(kb)
+    if (oa is not None or ob is not None) and oa != ob:
+        lines.append(f"  occupancy: "
+                     f"{'-' if oa is None else f'{oa:.4f}'} -> "
+                     f"{'-' if ob is None else f'{ob:.4f}'}")
 
     ta = (a.get("solver_stats") or {}).get("totals") or {}
     tb = (b.get("solver_stats") or {}).get("totals") or {}

@@ -10,6 +10,7 @@ from .sweep import (
     make_mesh,
     pad_batch,
     pad_to_bucket,
+    resolve_admission,
     sweep_report,
     temperature_sweep,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "pad_batch",
     "pad_to_bucket",
     "premixed_mole_fracs",
+    "resolve_admission",
     "save_result",
     "sweep_report",
     "sweep_solution_vectors",

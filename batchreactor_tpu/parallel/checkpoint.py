@@ -199,14 +199,19 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
             # too would make an explicit method="bdf" fingerprint differ
             # from the identical default-resolved configuration
             continue
-        if k in ("pipeline", "poll_every", "fetch_deadline"):
+        if k in ("pipeline", "poll_every", "fetch_deadline", "admission",
+                 "refill"):
             # segmented execution-GEAR / watchdog knobs, contractually
             # results-neutral (parallel/sweep.py): they change how
             # segments are driven or how long the host waits, never the
             # results, so a resume under a different gear or deadline —
             # or a pre-knob checkpoint dir resumed after the knobs
             # existed — must serve the same chunks, not raise a manifest
-            # mismatch
+            # mismatch.  admission/refill (continuous batching) are in
+            # the same class: the permutation is un-shuffled on harvest,
+            # so chunk artifacts are position-identical; the admission
+            # ORDER is recorded in the manifest as operational metadata
+            # (``admission`` block), never pinned.
             continue
         v = solve_kw[k]
         h.update(k.encode())
@@ -279,7 +284,17 @@ class _Ledger:
         self._path = os.path.join(ckpt_dir, "manifest.json")
         self._pinned = pinned
         self.attempts = attempts
+        self.extra = {}
         self._lock = threading.Lock()
+
+    def annotate(self, **extra):
+        """Attach operational (non-pinned) metadata to the manifest —
+        e.g. the admission block recording how the backlog was streamed.
+        Free to differ between runs; never part of the resume-mismatch
+        check."""
+        with self._lock:
+            self.extra.update(extra)
+            self._write()
 
     def record(self, chunk, outcome, attempt, error=None):
         with self._lock:
@@ -291,8 +306,11 @@ class _Ledger:
             rows = self.attempts.setdefault(str(int(chunk)), [])
             rows.append(entry)
             del rows[:-_LEDGER_CAP]
-            _write_manifest_atomic(self._path, {**self._pinned,
-                                                "attempts": self.attempts})
+            self._write()
+
+    def _write(self):
+        _write_manifest_atomic(self._path, {**self._pinned, **self.extra,
+                                            "attempts": self.attempts})
 
 
 # --------------------------------------------------------------------------
@@ -350,9 +368,11 @@ def _solve_chunk(rhs, y0c, t0, t1, cfgc, solve_kw, recorder=None):
         # None-valued gear knobs (library-default pass-through, e.g.
         # the northstar script) don't exist on the monolithic path —
         # drop them; explicit values were rejected up front
+        # admission/refill appear here only via the elastic tier's
+        # solve_kw (checkpointed_sweep binds them as named kwargs)
         kw = {k: v for k, v in solve_kw.items()
               if k not in ("segment_steps", "pipeline", "poll_every",
-                           "fetch_deadline")}
+                           "fetch_deadline", "admission", "refill")}
         res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **kw)
     if pad:
         res = jax.tree.map(
@@ -412,11 +432,234 @@ class _ChunkBudget:
             self._ratios.append(float(wall_s) / float(rel_cost))
 
 
+def _stream_pending_chunks(rhs, y0s, t0, t1, cfgs, ckpt_dir, parts, *,
+                           chunk_size, resident, refill, refill_spec,
+                           solve_kw, rec, recorder, chunk_log, retry, qpol,
+                           oracle_fn, ledger, load_chunk, save_async,
+                           subset_solve):
+    """``checkpointed_sweep``'s admission backlog mode: every pending
+    (not-on-disk) chunk's lanes form ONE backlog streamed through the
+    resident admission program (``parallel.sweep`` ``admission=``), and
+    a chunk's ``.npz`` is written the moment its last lane is harvested
+    — chunks become completion units instead of execution units, so the
+    per-chunk halo (fixed-shape dispatch, blocking fetch, parked-lane
+    stepping until the chunk drains) is paid once per sweep instead of
+    once per ``chunk_size`` lanes, while incremental resume is
+    preserved.  Harvested rows arrive in caller lane order (the
+    admission permutation is un-shuffled by the driver), so chunk
+    artifacts are position-identical to the chunked path's.
+
+    ``retry=`` wraps the whole streaming pass: chunks finalized before a
+    retryable fault stay on disk, and the retry re-streams only the
+    still-pending lanes (the same crash-resume arithmetic a process
+    restart would perform).  Fills ``parts`` with the per-chunk results
+    in chunk order."""
+    from ..resilience import inject
+    from ..resilience.policy import RETRYABLE
+    from ..resilience.watchdog import WedgeError, reset_backend
+    from .sweep import ensemble_solve_segmented
+
+    B = int(y0s.shape[0])
+    tail = tuple(y0s.shape[1:])
+    dtype = y0s.dtype
+    chunks = [(i, lo, min(lo + chunk_size, B))
+              for i, lo in enumerate(range(0, B, chunk_size))]
+    ledger.annotate(admission={
+        "resident": int(resident), "refill": refill_spec,
+        "order": "backlog-sequential (chunk-major; lane_cost-sorted "
+                 "lane order when given)"})
+    done = {}
+    for i, lo, hi in chunks:
+        path = os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
+        if os.path.exists(path):
+            r = load_chunk(i, path)
+            if r is not None:
+                done[i] = r
+    seg_steps = int(solve_kw["segment_steps"])
+    ms = int(solve_kw.get("max_steps", 200_000))
+    kw = {k: v for k, v in solve_kw.items()
+          if k not in ("segment_steps", "max_steps")}
+    per_lane_segs = max(1, -(-ms // seg_steps))
+
+    def finalize(i, lo, hi, buf, attempt):
+        n = hi - lo
+        chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
+        res = SolveResult(
+            t=jnp.asarray(buf["t"], dtype=dtype),
+            y=jnp.asarray(buf["y"]),
+            status=jnp.asarray(buf["status"]),
+            n_accepted=jnp.asarray(buf["n_accepted"]),
+            n_rejected=jnp.asarray(buf["n_rejected"]),
+            # n_save=0 placeholders (the solvers' (1,)-buffer convention)
+            ts=jnp.full((n, 1), jnp.inf, dtype=dtype),
+            ys=jnp.zeros((n, 1) + tail, dtype=dtype),
+            n_saved=jnp.zeros((n,), dtype=jnp.int32),
+            h=jnp.asarray(buf["h"], dtype=dtype),
+            observed=(jax.tree.map(jnp.asarray, buf["observed"])
+                      if "observed" in buf else None),
+            stats=({k: jnp.asarray(v) for k, v in buf["stats"].items()}
+                   if "stats" in buf else None))
+        # same post-solve ladder as the chunked path: fault injection
+        # (global lane indices in solve order) BEFORE quarantine, so the
+        # recovery provenance maps through the admission permutation
+        # exactly like it maps through chunking
+        res = inject.poison_lanes(res, lo, hi)
+        if qpol is not None:
+            from ..resilience import quarantine as _quarantine
+
+            res, _prov = _quarantine.resolve(
+                res, y0s[lo:hi], chunk_cfgs, subset_solve,
+                policy=qpol, recorder=rec, oracle=oracle_fn,
+                lane_offset=lo)
+        att = np.asarray(res.n_accepted) + np.asarray(res.n_rejected)
+        if chunk_log is not None:
+            retry_note = f" (attempt {attempt})" if attempt else ""
+            chunk_log(f"[ckpt] chunk {i} ({n} lanes): streamed"
+                      f"{retry_note}, attempts mean {att.mean():.0f} "
+                      f"max {att.max()}")
+        ledger.record(i, "ok", attempt)
+        save_async(i, os.path.join(ckpt_dir, f"chunk_{i:05d}.npz"), res,
+                   chunk_cfgs)
+        done[i] = res
+
+    attempts = (retry.max_retries if retry is not None else 0) + 1
+    for attempt in range(attempts):
+        pend = [c for c in chunks if c[0] not in done]
+        if not pend:
+            break
+        backlog = np.concatenate([np.arange(lo, hi)
+                                  for _, lo, hi in pend])
+        bl_chunk = np.concatenate([np.full((hi - lo,), i)
+                                   for i, lo, hi in pend])
+        bl_local = np.concatenate([np.arange(hi - lo)
+                                   for _, lo, hi in pend])
+        spans = {i: (lo, hi) for i, lo, hi in pend}
+        bufs, counts = {}, {i: 0 for i, _, _ in pend}
+
+        def alloc(n, payload):
+            b = {"t": np.zeros((n,)), "y": np.zeros((n,) + tail),
+                 "status": np.zeros((n,), np.int32),
+                 "n_accepted": np.zeros((n,), np.int64),
+                 "n_rejected": np.zeros((n,), np.int64),
+                 "h": np.zeros((n,))}
+            if "stats" in payload:
+                b["stats"] = {
+                    k: np.zeros((n,) + np.asarray(v).shape[1:],
+                                np.asarray(v).dtype)
+                    for k, v in payload["stats"].items()}
+            if "observed" in payload:
+                b["observed"] = jax.tree.map(
+                    lambda v: np.zeros((n,) + np.asarray(v).shape[1:],
+                                       np.asarray(v).dtype),
+                    payload["observed"])
+            return b
+
+        def on_harvest(gids, payload):
+            for ci in np.unique(bl_chunk[gids]):
+                ci = int(ci)
+                sel = np.nonzero(bl_chunk[gids] == ci)[0]
+                lo, hi = spans[ci]
+                buf = bufs.get(ci)
+                if buf is None:
+                    buf = bufs[ci] = alloc(hi - lo, payload)
+                rows = bl_local[gids[sel]]
+                for f in ("t", "y", "status", "n_accepted",
+                          "n_rejected", "h"):
+                    buf[f][rows] = payload[f][sel]
+                if "stats" in buf:
+                    for k in buf["stats"]:
+                        buf["stats"][k][rows] = payload["stats"][k][sel]
+                if "observed" in buf:
+                    jax.tree.map(
+                        lambda d, s: d.__setitem__(rows,
+                                                   np.asarray(s)[sel]),
+                        buf["observed"], payload["observed"])
+                counts[ci] += sel.size
+                if counts[ci] == hi - lo:
+                    finalize(ci, lo, hi, bufs.pop(ci), attempt)
+
+        y0_b = jnp.asarray(np.asarray(y0s)[backlog])
+        cfg_b = {k: jnp.asarray(np.asarray(v)[backlog])
+                 for k, v in cfgs.items()}
+        # admitted lanes park within per_lane_segs segments of admission
+        # (the exact max_attempts budget), but refills only happen at
+        # POLL boundaries, so each generation costs up to per_lane_segs
+        # + poll_every extra segments of admission latency; +1
+        # generation of slack on top
+        from .sweep import resolve_pipeline_defaults
+
+        _, poll = resolve_pipeline_defaults(kw.get("pipeline"),
+                                            kw.get("poll_every"))
+        n_seg = ((per_lane_segs + int(poll))
+                 * (-(-backlog.size // int(resident)) + 1))
+        try:
+            with rec.span("stream_solve", lanes=int(backlog.size),
+                          chunks=len(pend), attempt=attempt):
+                ensemble_solve_segmented(
+                    rhs, y0_b, t0, t1, cfg_b, segment_steps=seg_steps,
+                    max_segments=n_seg, max_attempts=ms,
+                    admission=int(resident), refill=refill,
+                    recorder=recorder, _on_harvest=on_harvest, **kw)
+            break
+        except RETRYABLE as e:
+            last = attempt == attempts - 1
+            for i, _, _ in pend:
+                if i not in done:
+                    ledger.record(i, "error", attempt, e)
+            rec.event("fault", kind="stream_solve_error", attempt=attempt,
+                      retryable=not last,
+                      error=f"{type(e).__name__}: {str(e)[:200]}")
+            if chunk_log is not None:
+                chunk_log(f"[ckpt] streamed pass attempt {attempt} "
+                          f"FAILED ({type(e).__name__}); "
+                          f"{'giving up' if last else 'retrying'}")
+            if last:
+                raise
+            rec.counter("chunk_retries")
+            if isinstance(e, WedgeError):
+                reset_backend()
+            time.sleep(retry.delay(attempt))
+    leftover = [i for i, _, _ in chunks if i not in done]
+    if leftover:
+        raise RuntimeError(
+            f"streamed sweep left chunks {leftover} incomplete (lanes "
+            f"never admitted — the segment budget under-covered the "
+            f"backlog)")
+    parts.extend(done[i] for i, _, _ in chunks)
+
+
 def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
                        lane_cost=None, chunk_log=None, recorder=None,
                        retry=None, chunk_budget_s=None, quarantine=None,
-                       oracle=None, **solve_kw):
+                       oracle=None, admission=None, refill=None,
+                       **solve_kw):
     """ensemble_solve with chunk-level checkpoint/resume.
+
+    ``admission=``/``refill=`` (docs/performance.md "Continuous
+    batching"; grammar ``parallel.sweep.resolve_admission``) switch the
+    chunks from execution units to COMPLETION units: instead of one
+    fixed-shape solve + save per chunk — each paying the per-chunk halo
+    (program dispatch, result fetch, npz write) and stepping its parked
+    lanes until the whole chunk drains — the pending chunks form one
+    backlog that streams through a single resident program
+    (``admission=True`` sizes it at ``chunk_size``; an int picks the
+    resident lane count), with freed slots refilled mid-flight and each
+    chunk's ``.npz`` written the moment its last lane is harvested, so
+    incremental resume is preserved.  Requires ``segment_steps > 0``,
+    no ``mesh``, ``n_save=0``, and no explicit ``chunk_budget_s`` (the
+    chunk is no longer the execution unit — arm ``fetch_deadline``
+    instead); each violation is a loud error.  Results are
+    position-identical to the chunked driver (the admission permutation
+    un-shuffles on harvest): chunk artifacts, resume, per-lane stats and
+    quarantine provenance all match, bit-exactly on the tier-1 matrix.
+    The knobs are results-neutral and exempt from the resume
+    fingerprint; the manifest's non-pinned ``admission`` block records
+    the resident size, refill threshold, and admission order of the run
+    that wrote it.  Quarantine's same-settings retry pass re-solves the
+    chunk through the per-chunk program (the streaming companion set is
+    not reproducible slot-for-slot), so under admission its transient-
+    fault recovery is tolerance-level rather than bit-exact — the
+    fallback and oracle rungs are unchanged.
 
     Splits the (B, ...) batch into ``chunk_size`` pieces; chunk i's result is
     persisted to ``ckpt_dir/chunk_{i:05d}.npz`` as soon as it finishes.  The
@@ -512,13 +755,41 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     from ..resilience.watchdog import (WedgeError, block_with_deadline,
                                        reset_backend)
 
+    from .sweep import resolve_admission
+
     retry = normalize_retry(retry)
     qpol = normalize_quarantine(quarantine)
-    budget = _ChunkBudget(resolve_chunk_budget(chunk_budget_s))
+    resident_req, refill_spec = resolve_admission(
+        admission, refill, n_lanes=int(jnp.asarray(y0s).shape[0]))
+    if resident_req is not None:
+        if int(solve_kw.get("segment_steps", 0) or 0) <= 0:
+            raise ValueError(
+                "admission= streams chunks through the segmented driver; "
+                "set segment_steps > 0 or drop the admission knobs")
+        if solve_kw.get("mesh") is not None:
+            raise ValueError(
+                "admission= is incompatible with mesh= (parallel/sweep.py "
+                "admission contract); drop one of them")
+        if solve_kw.get("n_save"):
+            raise ValueError(
+                "admission= requires n_save=0; stream reductions through "
+                "observer= instead")
+        if chunk_budget_s is not None:
+            raise ValueError(
+                "chunk_budget_s is a per-chunk watchdog and admission= "
+                "dissolves the chunk as execution unit; use "
+                "fetch_deadline= (the streaming driver's wedge "
+                "surface) instead")
+    budget = _ChunkBudget(resolve_chunk_budget(
+        None if resident_req is not None else chunk_budget_s))
     if int(solve_kw.get("segment_steps", 0) or 0) <= 0:
         # up-front, like api.py: the gear/watchdog knobs configure the
         # segmented driver only, and the check must fire even when every
         # chunk resumes from disk (None = library default passes through)
+        # admission/refill are NAMED kwargs here (they can never reach
+        # solve_kw) — their segment_steps guard lives in the admission
+        # validation above; the elastic tier's copy of this list keeps
+        # them because there they DO travel via solve_kw
         explicit = [k for k in ("pipeline", "poll_every", "fetch_deadline")
                     if solve_kw.get(k) is not None]
         if explicit:
@@ -686,62 +957,80 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
             _await_last()
         pending.append(executor.submit(job))
 
-    try:
-        for i, lo in enumerate(range(0, B, chunk_size)):
-            hi = min(lo + chunk_size, B)
-            path = os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
-            chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
-            res = None
-            if os.path.exists(path):
-                try:
-                    with rec.span("chunk_load", chunk=i):
-                        res, _ = load_result(path)
-                    rec.event("chunk_loaded", chunk=i, path=path)
-                    if chunk_log is not None:
-                        chunk_log(f"[ckpt] chunk {i} loaded from {path}")
-                except _CORRUPT_ERRORS as e:
-                    # torn/corrupt file: keep it aside for forensics and
-                    # fall through to a fresh solve — resume survives
-                    # exactly the crash classes the atomic writer cannot
-                    # rule out (disk faults, pre-atomic writers)
-                    rec.event("fault", kind="corrupt_chunk", chunk=i,
-                              path=path,
-                              error=f"{type(e).__name__}: {str(e)[:200]}")
-                    rec.counter("chunks_corrupt")
-                    os.replace(path, path + ".corrupt")
-                    if chunk_log is not None:
-                        chunk_log(f"[ckpt] chunk {i} file corrupt "
-                                  f"({type(e).__name__}) — re-solving")
-                    res = None
-            if res is None:
-                res, sp, attempt = _solve_with_retry(i, lo, hi,
-                                                     y0s[lo:hi],
-                                                     chunk_cfgs)
-                solve_s = sp["dur"]
-                # test-only: NaN-lane fault simulation (global lane
-                # indices in solve order), BEFORE quarantine so the
-                # recovery ladder is what the artifact records
-                res = inject.poison_lanes(res, lo, hi)
-                if qpol is not None:
-                    from ..resilience import quarantine as _quarantine
+    def _load_chunk(i, path):
+        """Load an existing chunk file; a torn/corrupt file is kept
+        aside for forensics (``*.corrupt``) and ``None`` is returned so
+        the caller re-solves — resume survives exactly the crash classes
+        the atomic writer cannot rule out (disk faults, pre-atomic
+        writers)."""
+        try:
+            with rec.span("chunk_load", chunk=i):
+                res, _ = load_result(path)
+            rec.event("chunk_loaded", chunk=i, path=path)
+            if chunk_log is not None:
+                chunk_log(f"[ckpt] chunk {i} loaded from {path}")
+            return res
+        except _CORRUPT_ERRORS as e:
+            rec.event("fault", kind="corrupt_chunk", chunk=i, path=path,
+                      error=f"{type(e).__name__}: {str(e)[:200]}")
+            rec.counter("chunks_corrupt")
+            os.replace(path, path + ".corrupt")
+            if chunk_log is not None:
+                chunk_log(f"[ckpt] chunk {i} file corrupt "
+                          f"({type(e).__name__}) — re-solving")
+            return None
 
-                    res, _prov = _quarantine.resolve(
-                        res, y0s[lo:hi], chunk_cfgs, _subset_solve,
-                        policy=qpol, recorder=rec, oracle=oracle_fn,
-                        lane_offset=lo)
-                att = (np.asarray(res.n_accepted)
-                       + np.asarray(res.n_rejected))
-                sp["attrs"]["attempts_mean"] = float(att.mean())
-                sp["attrs"]["attempts_max"] = int(att.max())
-                if chunk_log is not None:
-                    retry_note = f" (attempt {attempt})" if attempt else ""
-                    chunk_log(
-                        f"[ckpt] chunk {i} ({hi - lo} lanes): solve "
-                        f"{solve_s:.2f}s ({(hi - lo) / solve_s:.1f} "
-                        f"cond/s){retry_note}, "
-                        f"attempts mean {att.mean():.0f} max {att.max()}")
-                _save_async(i, path, res, chunk_cfgs)
-            parts.append(res)
+    try:
+        if resident_req is not None:
+            _stream_pending_chunks(
+                rhs, y0s, t0, t1, cfgs, ckpt_dir, parts,
+                chunk_size=chunk_size,
+                resident=(chunk_size if admission is True
+                          else resident_req),
+                refill=refill, refill_spec=refill_spec,
+                solve_kw=solve_kw, rec=rec, recorder=recorder,
+                chunk_log=chunk_log, retry=retry, qpol=qpol,
+                oracle_fn=oracle_fn, ledger=ledger,
+                load_chunk=_load_chunk, save_async=_save_async,
+                subset_solve=_subset_solve)
+        else:
+            for i, lo in enumerate(range(0, B, chunk_size)):
+                hi = min(lo + chunk_size, B)
+                path = os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
+                chunk_cfgs = {k: v[lo:hi] for k, v in cfgs.items()}
+                res = (_load_chunk(i, path) if os.path.exists(path)
+                       else None)
+                if res is None:
+                    res, sp, attempt = _solve_with_retry(i, lo, hi,
+                                                         y0s[lo:hi],
+                                                         chunk_cfgs)
+                    solve_s = sp["dur"]
+                    # test-only: NaN-lane fault simulation (global lane
+                    # indices in solve order), BEFORE quarantine so the
+                    # recovery ladder is what the artifact records
+                    res = inject.poison_lanes(res, lo, hi)
+                    if qpol is not None:
+                        from ..resilience import quarantine as _quarantine
+
+                        res, _prov = _quarantine.resolve(
+                            res, y0s[lo:hi], chunk_cfgs, _subset_solve,
+                            policy=qpol, recorder=rec, oracle=oracle_fn,
+                            lane_offset=lo)
+                    att = (np.asarray(res.n_accepted)
+                           + np.asarray(res.n_rejected))
+                    sp["attrs"]["attempts_mean"] = float(att.mean())
+                    sp["attrs"]["attempts_max"] = int(att.max())
+                    if chunk_log is not None:
+                        retry_note = (f" (attempt {attempt})" if attempt
+                                      else "")
+                        chunk_log(
+                            f"[ckpt] chunk {i} ({hi - lo} lanes): solve "
+                            f"{solve_s:.2f}s ({(hi - lo) / solve_s:.1f} "
+                            f"cond/s){retry_note}, "
+                            f"attempts mean {att.mean():.0f} "
+                            f"max {att.max()}")
+                    _save_async(i, path, res, chunk_cfgs)
+                parts.append(res)
         # durability barrier: a failed/unfinished save must fail the sweep
         # call, not surface later as a missing chunk on resume
         while pending:

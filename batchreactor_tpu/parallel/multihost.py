@@ -255,7 +255,8 @@ def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
         # the checkpointed_sweep loudness convention: these knobs
         # configure the segmented driver only, and silently dropping
         # them would report a watchdog/gear that never armed
-        explicit = [k for k in ("pipeline", "poll_every", "fetch_deadline")
+        explicit = [k for k in ("pipeline", "poll_every", "fetch_deadline",
+                                "admission", "refill")
                     if solve_kw.get(k) is not None]
         if explicit:
             raise ValueError(

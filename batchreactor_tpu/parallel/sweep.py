@@ -66,6 +66,86 @@ def resolve_pipeline_defaults(pipeline=None, poll_every=None):
     return bool(pipeline), int(poll_every)
 
 
+def resolve_admission(admission=None, refill=None, *, n_lanes=None):
+    """THE validation/resolution rule for the continuous-batching knobs
+    (``admission``, ``refill``) shared by the segmented sweep driver,
+    ``checkpointed_sweep``'s backlog mode, and ``api.py``.
+
+    Grammar (loud ``ValueError`` on anything else):
+
+    * ``admission=None``/``False`` — continuous batching off (the
+      default).  ``refill`` must then be ``None`` too: a refill threshold
+      with no admission queue would silently configure nothing.
+    * ``admission=True`` — resident slots = the full lane count (no
+      backlog to admit; enables the compaction/bucket-down-shift path
+      alone, e.g. to shrink the program as a ragged sweep drains).
+    * ``admission=int k >= 1`` — ``k`` resident lane slots; lanes beyond
+      the resident set form the backlog the admission queue streams in.
+    * ``refill=None`` — default threshold 0.25 (compact/refill once a
+      quarter of the resident slots have freed).
+    * ``refill=float in (0, 1]`` — threshold as a fraction of the
+      resident slot count.
+    * ``refill=int >= 1`` — absolute freed-slot threshold.
+
+    Returns ``(resident, refill_spec)`` with ``resident=None`` when
+    admission is off.  ``refill_spec`` stays a fraction-or-int: the
+    driver converts to slots AFTER bucket-padding the resident count
+    (:func:`_refill_slots`), so a fraction means what it says about the
+    program shape that actually runs.
+    """
+    if admission is None or admission is False:
+        if refill is not None:
+            raise ValueError(
+                "refill= tunes the admission queue; pass admission= "
+                "(resident lane count, or True) or drop the argument")
+        return None, None
+    if admission is True:
+        if not n_lanes:
+            raise ValueError("admission=True needs a known lane count")
+        resident = int(n_lanes)
+    elif isinstance(admission, bool) or not isinstance(
+            admission, (int, np.integer)):
+        raise ValueError(
+            f"admission must be None/False (off), True (resident = all "
+            f"lanes), or a positive int resident lane count; got "
+            f"{admission!r}")
+    else:
+        resident = int(admission)
+        if resident < 1:
+            raise ValueError(
+                f"admission resident lane count must be >= 1, got "
+                f"{resident}")
+    if refill is None:
+        refill_spec = 0.25
+    elif isinstance(refill, bool):
+        raise ValueError(
+            f"refill must be a fraction in (0, 1] or a positive int "
+            f"freed-slot count; got {refill!r}")
+    elif isinstance(refill, (int, np.integer)):
+        if refill < 1:
+            raise ValueError(
+                f"refill slot count must be >= 1, got {refill}")
+        refill_spec = int(refill)
+    elif isinstance(refill, float):
+        if not 0.0 < refill <= 1.0:
+            raise ValueError(
+                f"refill fraction must be in (0, 1], got {refill}")
+        refill_spec = float(refill)
+    else:
+        raise ValueError(
+            f"refill must be a fraction in (0, 1] or a positive int "
+            f"freed-slot count; got {refill!r}")
+    return resident, refill_spec
+
+
+def _refill_slots(refill_spec, B):
+    """Freed-slot threshold for a ``B``-slot resident program (fractions
+    round up; thresholds clamp to [1, B])."""
+    if isinstance(refill_spec, int):
+        return max(1, min(refill_spec, B))
+    return max(1, min(B, int(np.ceil(refill_spec * B))))
+
+
 def _host_fetch(x, recorder=None, deadline=None):
     """THE main-thread blocking device->host transfer of the segmented
     drivers.  Every synchronous fetch the host loop performs goes through
@@ -366,7 +446,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              setup_economy=False, stale_tol=0.3,
                              stats=False, recorder=None, watch=None,
                              pipeline=None, poll_every=None, buckets=None,
-                             fetch_deadline=None):
+                             fetch_deadline=None, admission=None,
+                             refill=None, _on_harvest=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -473,6 +554,36 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     (docs/robustness.md).  Purely host-side: the traced segment programs
     are identical with the watchdog armed or off (brlint tier-B
     ``resilience-noop-fork``).
+
+    ``admission``/``refill`` (docs/performance.md "Continuous
+    batching"; grammar :func:`resolve_admission`) turn the pipelined
+    driver occupancy-aware: a ``resident``-slot program streams through
+    the full lane set — between segment relaunches a traced compaction
+    step (:func:`_compact_admit`) permutes the carry (state, BDF
+    history, observer fold, control block) so live lanes are
+    contiguous, finished lanes are harvested to host, and freed slots
+    refill with pending lanes from the backlog once ``refill`` of them
+    have parked.  Results are un-shuffled back to caller lane order on
+    harvest (the slot->lane map inverts the admission permutation), so
+    per-lane results, telemetry arrays, and provenance are positionally
+    identical to the non-admission driver — and bit-exact on the tier-1
+    matrix (lanes are independent; companion-set sensitivity is the
+    documented <=2 ulp of bucket padding).  When the backlog is empty
+    and live lanes fit a smaller bucket of the ``buckets`` ladder, the
+    driver DOWN-SHIFTS to the smaller (warmed) bucket executable —
+    under a warmed AOT cache a zero-compile program switch
+    (CompileWatch ``program_key`` marks it expected).  Admission
+    requires the pipelined gear, ``mesh=None`` (the compaction gather
+    would insert cross-shard movement into a collective-free program),
+    and ``n_save=0`` (stream trajectories through ``observer`` folds;
+    a trajectory buffer does not survive slot reuse) — each violation
+    is a loud error.  Admission off leaves every traced program
+    byte-identical to the admission-less driver (brlint tier-B
+    ``admission-noop-fork``); the knobs are results-neutral and exempt
+    from the checkpoint resume fingerprint like ``pipeline``/
+    ``poll_every``.  Counters: ``compactions``, ``admitted_lanes``,
+    ``bucket_downshifts``, and the occupancy pair ``lane_attempts`` /
+    ``lane_capacity`` (docs/observability.md).
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
@@ -487,6 +598,56 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     if poll_every < 1:
         raise ValueError(f"poll_every must be >= 1, got {poll_every}")
     y0s = jnp.asarray(y0s)
+    resident, refill_spec = resolve_admission(admission, refill,
+                                              n_lanes=y0s.shape[0])
+    if resident is not None:
+        # continuous batching (docstring above): the streaming driver
+        # owns its own resident-set padding — the full backlog must NOT
+        # be bucket-padded, that is the fixed-shape cost it replaces
+        if not pipeline:
+            raise ValueError(
+                "admission= needs the pipelined gear (the compaction/"
+                "refill step rides the run-ahead dispatch); drop "
+                "pipeline=False or the admission knobs")
+        if mesh is not None:
+            raise ValueError(
+                "admission= is single-mesh-free: the traced compaction "
+                "gather would insert cross-shard data movement into a "
+                "collective-free program; drop mesh= or the admission "
+                "knobs")
+        if n_save:
+            raise ValueError(
+                "admission= requires n_save=0 (a per-lane trajectory "
+                "buffer does not survive slot reuse); stream reductions "
+                "through observer= instead")
+        _check_method(method, newton_tol)
+        if setup_economy and method != "bdf":
+            raise ValueError(
+                f"setup_economy is a bdf-only knob; method={method!r}")
+        own_watch = None
+        if watch is None and recorder is not None:
+            own_watch = CompileWatch(recorder=recorder,
+                                     default_label="sweep-host")
+            watch = own_watch
+        with (own_watch if own_watch is not None
+              else contextlib.nullcontext()):
+            return _run_segmented_streaming(
+                rhs, y0s, t0, jnp.asarray(t1, dtype=y0s.dtype), cfgs,
+                rhs_bundle if rhs_bundle is not None else 0.0,
+                resident=resident, refill_spec=refill_spec,
+                buckets=buckets, segment_steps=segment_steps,
+                max_segments=max_segments, max_attempts=max_attempts,
+                poll_every=poll_every, rtol=rtol, atol=atol,
+                linsolve=linsolve,
+                jac=None if rhs_bundle is not None else jac,
+                observer=observer, observer_init=observer_init,
+                dt_min_factor=dt_min_factor,
+                bundle_mode=rhs_bundle is not None, jac_window=jac_window,
+                newton_tol=newton_tol, method=method,
+                setup_economy=setup_economy, stale_tol=float(stale_tol),
+                stats=stats, recorder=recorder, watch=watch,
+                progress=progress, fetch_kw=fkw,
+                on_harvest=_on_harvest)
     B_live = y0s.shape[0]
     bucket = resolve_bucket(
         B_live, buckets,
@@ -546,7 +707,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 newton_tol=newton_tol, method=method,
                 setup_economy=setup_economy, stale_tol=float(stale_tol),
                 stats=stats, recorder=recorder, watch=watch,
-                progress=progress, fetch_kw=fkw), B_live)
+                progress=progress, fetch_kw=fkw, n_live_lanes=B_live),
+                B_live)
 
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
                                       dt_min_factor, linsolve,
@@ -1081,7 +1243,8 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
                              observer, dt_min_factor, n_save, seg_save,
                              bundle_mode, jac_window, newton_tol, method,
                              setup_economy, stale_tol, stats, recorder,
-                             watch, progress, fetch_kw=None):
+                             watch, progress, fetch_kw=None,
+                             n_live_lanes=None):
     """The pipelined gear of :func:`ensemble_solve_segmented` (module
     docstring): run-ahead dispatch with carry donation, device-resident
     termination/budget logic, strided polling, and the background
@@ -1177,6 +1340,20 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
     # RUNNING the carried t IS the last segment's res.t — parking never
     # touched it)
     ft = np.where(np.isnan(ft), t_np, ft)
+    if recorder is not None and launched:
+        # occupancy pair (docs/observability.md): useful step attempts
+        # vs the device's attempt capacity — parked lanes stepped until
+        # the next poll, early finishers inside a segment, AND dead
+        # bucket-pad lanes all read as idle capacity.  The numerator
+        # slices to the LIVE lanes (pad copies append at the end), the
+        # denominator keeps the padded B the device actually runs.
+        # Additive across sweeps/chunks; consumers derive occupancy =
+        # lane_attempts / lane_capacity.
+        nl = int(B if n_live_lanes is None else n_live_lanes)
+        recorder.counter("lane_attempts",
+                         int(na[:nl].sum() + nr[:nl].sum()))
+        recorder.counter("lane_capacity",
+                         int(launched) * int(B) * int(segment_steps))
 
     if n_save:
         ts_out = jnp.asarray(drainer.all_ts, dtype=y0s.dtype)
@@ -1191,6 +1368,383 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
         ts=ts_out, ys=ys_out, n_saved=n_saved_out, h=h,
         observed=obs if observer is not None else None,
         stats=(dict(ctrl["stats"]) if stats else None))
+
+
+def _compact_admit(carry, cfgs, order, new_y0, new_cfgs, fresh, n_live,
+                   n_new):
+    """The streaming driver's traced compaction + admission step (brlint
+    tier B audits it through here): permute every leading-B leaf of the
+    segment carry and the resident condition block by ``order`` (live
+    lanes first — the host computes the stable permutation from the
+    status vector it already fetched at the poll), then overwrite the
+    ``n_new`` slots starting at ``n_live`` with freshly-admitted lanes:
+    ``new_y0`` rows for the state, ``fresh`` (a cold
+    :func:`_init_segment_carry` pytree) for everything else — cold BDF
+    history, reset control block/stats, fresh observer fold — and
+    ``new_cfgs`` rows for the per-lane conditions.  Pure gathers and
+    selects: no callback, no host staging, nothing shape-dependent on
+    the admit count (``n_live``/``n_new`` are traced scalars, so every
+    compaction of a bucket reuses ONE compiled program).
+
+    Slots at or past ``n_live + n_new`` keep their (permuted) parked
+    carry: they re-enter the next segment as the zero-span no-ops the
+    segmented drivers already rely on for parked lanes."""
+
+    def perm(x):
+        return jnp.take(x, order, axis=0)
+
+    permuted = jax.tree.map(perm, carry)
+    cfgs_p = jax.tree.map(perm, cfgs)
+    idx = jnp.arange(order.shape[0], dtype=jnp.int32)
+    admit = (idx >= n_live) & (idx < n_live + n_new)
+
+    def sel(f, p):
+        m = admit.reshape(admit.shape + (1,) * (p.ndim - 1))
+        return jnp.where(m, f, p)
+
+    fresh = (new_y0,) + tuple(fresh[1:])
+    return (jax.tree.map(sel, fresh, permuted),
+            jax.tree.map(sel, new_cfgs, cfgs_p))
+
+
+# the compaction program donates the carry AND the resident condition
+# block: both are replaced wholesale, and at GRI scale the (B, MAXORD+3,
+# S) BDF history is the buffer the donation exists to alias in place
+_COMPACT_ADMIT = jax.jit(_compact_admit, donate_argnums=(0, 1))
+
+
+def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
+                             resident, refill_spec, buckets, segment_steps,
+                             max_segments, max_attempts, poll_every, rtol,
+                             atol, linsolve, jac, observer, observer_init,
+                             dt_min_factor, bundle_mode, jac_window,
+                             newton_tol, method, setup_economy, stale_tol,
+                             stats, recorder, watch, progress, fetch_kw,
+                             on_harvest=None):
+    """Continuous batching: one resident B-lane segment program streams
+    through an N-lane backlog (``ensemble_solve_segmented`` docstring,
+    ``admission=``).  The loop structure is the pipelined driver's —
+    run-ahead segment dispatch with carry donation, strided polling —
+    plus, at poll boundaries, the occupancy machinery:
+
+    1. **harvest** — finished lanes' final state/stats/observer rows are
+       fetched (one ``_host_fetch``) and scattered into the N-lane
+       output arrays at their original lane index (the permutation
+       un-shuffle: ``slot_gid`` maps resident slots to caller lanes);
+    2. **compact + admit** — once ``refill`` slots have parked, the
+       traced :func:`_compact_admit` program permutes live lanes to the
+       front and refills freed slots from the backlog;
+    3. **down-shift** — backlog empty and live lanes fitting a smaller
+       ``buckets`` rung: the carry is compacted and sliced onto the
+       smaller (warmed) bucket program — an expected compile under its
+       new CompileWatch ``program_key``, a cache load under a warmed
+       AOT store.
+
+    ``on_harvest(gids, payload)`` (the ``checkpointed_sweep`` backlog
+    hook) is called from the driver thread at each harvest with the
+    finished lanes' global indices and their per-lane field rows —
+    chunk completion units for incremental checkpointing."""
+    fkw = fetch_kw or {}
+    RUN = int(sdirk.RUNNING)
+    N = int(y0s.shape[0])
+    dtype = y0s.dtype
+    tail = y0s.shape[1:]
+    # OWNED host copies of the backlog: on the CPU backend np.asarray of
+    # a jax array can be a zero-copy VIEW of the device buffer, and both
+    # the segment relaunch and the compaction program DONATE their
+    # resident blocks — without the .copy() the donated outputs scribble
+    # over the caller's y0s/cfgs memory (observed: a later sweep reading
+    # the same arrays saw the previous run's final resident block).  The
+    # same hazard class as the pipelined driver's explicit carry[0] copy.
+    y0_np = np.asarray(y0s).copy()
+    cfg_np = jax.tree.map(lambda v: np.asarray(v).copy(), cfgs)
+    n0 = min(int(resident), N)
+    B = resolve_bucket(n0, buckets)
+    refill_n = _refill_slots(refill_spec, B)
+    economy = bool(setup_economy) and jac_window > 1 and method == "bdf"
+    linsolve = resolve_linsolve(linsolve, method=method,
+                                platform=jax.default_backend(),
+                                batch=B, n=int(y0s.shape[1]))
+    jitted = _cached_vsolve_segmented_ctrl(
+        rhs, rtol, atol, segment_steps, dt_min_factor, linsolve, jac,
+        observer, 0, bundle_mode, jac_window, newton_tol, method, stats,
+        max_attempts is not None, 0, True, setup_economy, stale_tol)
+    budget = jnp.asarray(int(max_attempts) if max_attempts is not None
+                         else 0, dtype=jnp.int64)
+
+    # resident block 0: the bucket is the shape the device pays for, so
+    # every slot that CAN carry a backlog lane does from segment 0 —
+    # seed min(B, N) lanes (the requested resident count only picks the
+    # bucket); only a bucket larger than the whole backlog pads with
+    # dead copy-lanes (gid -1: wall-clock no-ops, never harvested — the
+    # standard bucket-padding discipline).  jnp.array (copy=True), NOT
+    # asarray: these blocks are donated, and a zero-copy device buffer
+    # over y0_np would let the donation corrupt the host backlog the
+    # admissions are gathered from
+    n_seed = min(B, N)
+    y0_blk = jnp.array(y0_np[:n_seed])
+    cfg_blk = jax.tree.map(lambda v: jnp.array(v[:n_seed]), cfg_np)
+    y0_blk, cfg_blk = _pad_lanes(y0_blk, cfg_blk, B - n_seed)
+    slot_gid = np.concatenate([np.arange(n_seed, dtype=np.int64),
+                               np.full((B - n_seed,), -1, dtype=np.int64)])
+    next_gid = n_seed
+    carry = _init_segment_carry(y0_blk, t0, method, observer,
+                                observer_init, stats, 0, economy=economy,
+                                linsolve=linsolve)
+    cfgs_res = cfg_blk
+    # cold per-slot template for admissions (the y slot is replaced by
+    # the admitted rows inside the traced program); NOT donated — reused
+    # by every compaction
+    fresh = _init_segment_carry(jnp.zeros((B,) + tail, dtype=dtype), t0,
+                                method, observer, observer_init, stats, 0,
+                                economy=economy, linsolve=linsolve)
+
+    # N-lane output accumulators, caller order (the un-shuffle target)
+    out_t = np.full((N,), np.nan)
+    out_status = np.full((N,), RUN, dtype=np.int32)
+    out_y = np.array(y0_np, copy=True)
+    out_h = np.full((N,), -1.0)
+    out_acc = np.zeros((N,), dtype=np.int64)
+    out_rej = np.zeros((N,), dtype=np.int64)
+    out_stats = None
+    if stats:
+        st0 = carry[6]["stats"]
+        out_stats = {k: np.zeros((N,) + tuple(v.shape[1:]), dtype=np.int32)
+                     for k, v in st0.items()}
+    out_obs = None
+    if observer is not None:
+        # never-admitted lanes (max_segments exhaustion) report the
+        # observer INIT values, like a lane that accepted zero steps
+        out_obs = jax.tree.map(
+            lambda a: np.broadcast_to(
+                np.asarray(a[:1]), (N,) + tuple(a.shape[1:])).copy(),
+            fresh[4])
+    harvested = 0
+    admitted_total = 0
+    compactions = 0
+    downshifts = 0
+    capacity_lane_segs = 0
+    launched = 0
+
+    def _harvest(status_np, force=False):
+        """Fetch finished slots' payload, scatter to caller lane order,
+        retire their gids.  ``force`` additionally harvests
+        still-running slots as MAX_STEPS_REACHED at their current t
+        (the max_segments-exhaustion fallback, blocking-driver
+        semantics)."""
+        nonlocal harvested
+        parked = status_np != RUN
+        rows = np.nonzero((parked | force) & (slot_gid >= 0))[0]
+        if rows.size == 0:
+            return
+        ctrl = carry[6]
+        y_f, h_f, t_f, ft_f, na_f, nr_f, st_f, ob_f = _host_fetch(
+            (carry[0], carry[2], carry[1], ctrl["final_t"], ctrl["n_acc"],
+             ctrl["n_rej"], ctrl["stats"] if stats else 0.0,
+             carry[4] if observer is not None else 0.0), recorder, **fkw)
+        gids = slot_gid[rows]
+        st_rows = np.where(parked[rows], status_np[rows],
+                           np.int32(sdirk.MAX_STEPS_REACHED))
+        ft_rows = np.asarray(ft_f)[rows]
+        # a forced (never-terminated) lane reports its current t — the
+        # same fallback the pipelined driver applies at exhaustion
+        ft_rows = np.where(np.isnan(ft_rows), np.asarray(t_f)[rows],
+                           ft_rows)
+        out_status[gids] = st_rows
+        out_t[gids] = ft_rows
+        out_y[gids] = np.asarray(y_f)[rows]
+        out_h[gids] = np.asarray(h_f)[rows]
+        out_acc[gids] = np.asarray(na_f)[rows]
+        out_rej[gids] = np.asarray(nr_f)[rows]
+        if stats:
+            for k, v in st_f.items():
+                out_stats[k][gids] = np.asarray(v)[rows]
+        if observer is not None:
+            flat, _ = jax.tree_util.tree_flatten(ob_f)
+            oflat, otree = jax.tree_util.tree_flatten(out_obs)
+            for dst, src in zip(oflat, flat):
+                dst[gids] = np.asarray(src)[rows]
+        slot_gid[rows] = -1
+        harvested += rows.size
+        if on_harvest is not None:
+            payload = {"t": ft_rows, "y": np.asarray(y_f)[rows],
+                       "status": st_rows, "h": np.asarray(h_f)[rows],
+                       "n_accepted": np.asarray(na_f)[rows],
+                       "n_rejected": np.asarray(nr_f)[rows]}
+            if stats:
+                payload["stats"] = {k: np.asarray(v)[rows]
+                                    for k, v in st_f.items()}
+            if observer is not None:
+                payload["observed"] = jax.tree.map(
+                    lambda a: np.asarray(a)[rows], ob_f)
+            on_harvest(gids, payload)
+
+    def _compact(status_np, n_new):
+        """Launch the traced compaction/admission program and mirror the
+        permutation on the host-side slot->lane map."""
+        nonlocal carry, cfgs_res, slot_gid, next_gid, admitted_total
+        nonlocal compactions
+        parked = status_np != RUN
+        order_np = np.argsort(parked, kind="stable")
+        n_live = int((~parked).sum())
+        new_y0 = np.zeros((B,) + tail, dtype=dtype)
+        new_cfg = jax.tree.map(
+            lambda v: np.zeros((B,) + tuple(np.asarray(v).shape[1:]),
+                               dtype=np.asarray(v).dtype), cfg_np)
+        if n_new:
+            sel = slice(next_gid, next_gid + n_new)
+            new_y0[n_live:n_live + n_new] = y0_np[sel]
+            jax.tree.map(
+                lambda d, s: d.__setitem__(
+                    slice(n_live, n_live + n_new), s[sel]),
+                new_cfg, cfg_np)
+        # stage the operands BEFORE the armed region (the conversions
+        # compile tiny one-off put/convert programs that must not
+        # masquerade as compaction retraces), with owning copies
+        # (jnp.array) so no device buffer views host scratch memory
+        order_d = jnp.array(order_np, dtype=jnp.int32)
+        new_y0_d = jnp.array(new_y0)
+        new_cfg_d = jax.tree.map(jnp.array, new_cfg)
+        n_live_d = jnp.asarray(n_live, dtype=jnp.int32)
+        n_new_d = jnp.asarray(n_new, dtype=jnp.int32)
+        region = (watch.region("sweep-compact", single_program=True,
+                               program_key=f"b{B}")
+                  if watch is not None else contextlib.nullcontext())
+        with span_or_null(recorder, "compact", admitted=n_new), region:
+            carry, cfgs_res = _COMPACT_ADMIT(
+                carry, cfgs_res, order_d, new_y0_d, new_cfg_d, fresh,
+                n_live_d, n_new_d)
+        slot_gid = slot_gid[order_np]
+        if n_new:
+            slot_gid[n_live:n_live + n_new] = np.arange(
+                next_gid, next_gid + n_new, dtype=np.int64)
+            next_gid += n_new
+            admitted_total += n_new
+        compactions += 1
+        if recorder is not None:
+            recorder.counter("compactions")
+            if n_new:
+                recorder.counter("admitted_lanes", n_new)
+
+    def _downshift(status_np):
+        """Backlog empty: if the live lanes fit a smaller bucket of the
+        ladder, compact live-first and slice the carry onto the smaller
+        warmed program (aot.buckets.downshift_bucket)."""
+        nonlocal B, carry, cfgs_res, fresh, slot_gid, refill_n, downshifts
+        from ..aot.buckets import downshift_bucket
+
+        n_live = int((status_np == RUN).sum())
+        B2 = downshift_bucket(n_live, buckets, B)
+        if B2 is None:
+            return
+        _compact(status_np, 0)
+        carry = jax.tree.map(lambda x: x[:B2], carry)
+        cfgs_res = jax.tree.map(lambda x: x[:B2], cfgs_res)
+        fresh = jax.tree.map(lambda x: x[:B2], fresh)
+        slot_gid = slot_gid[:B2]
+        B = B2
+        refill_n = _refill_slots(refill_spec, B)
+        downshifts += 1
+        if recorder is not None:
+            recorder.counter("bucket_downshifts")
+            recorder.event("bucket_downshift", bucket=B, live=n_live)
+
+    def _progress(seg, status_np, acc_np):
+        if progress is None:
+            return
+        live_rows = slot_gid >= 0
+        progress({"segment": seg,
+                  "lanes_done": harvested + int(
+                      ((status_np != RUN) & live_rows).sum()),
+                  "n_lanes": N,
+                  "accepted_total": int(out_acc.sum()
+                                        + acc_np[live_rows].sum()),
+                  "admitted_total": n_seed + admitted_total})
+
+    done = False
+    for seg in range(max_segments):
+        region = (watch.region("sweep-segment", single_program=True,
+                               program_key=f"b{B}")
+                  if watch is not None else contextlib.nullcontext())
+        with span_or_null(recorder, "segment", index=seg), region:
+            carry, _aux = jitted(bundle_arg, t1, cfgs_res, budget, carry)
+        launched += 1
+        capacity_lane_segs += B
+        if launched % poll_every and launched != max_segments:
+            continue
+        ctrl = carry[6]
+        with span_or_null(recorder, "poll", upto=seg) as sp:
+            status_np, acc_np = _host_fetch(
+                (ctrl["final_status"], ctrl["n_acc"]), recorder, **fkw)
+        if recorder is not None and sp["dur"] is not None:
+            recorder.counter("poll_wait_s", sp["dur"])
+        status_np = np.asarray(status_np)
+        acc_np = np.asarray(acc_np)
+        # emit BEFORE harvest/compaction: the payload reads slot_gid,
+        # which the compaction permutes out from under status_np
+        _progress(seg, status_np, acc_np)
+        running = status_np == RUN
+        n_parked = int(B - running.sum())
+        if next_gid < N:
+            if n_parked >= refill_n or not running.any():
+                _harvest(status_np)
+                _compact(status_np, min(n_parked, N - next_gid))
+        elif not running.any():
+            _harvest(status_np)
+            done = True
+            break
+        elif buckets is not None and n_parked:
+            _harvest(status_np)
+            _downshift(status_np)
+    if not done:
+        # max_segments exhausted: park still-running lanes as MaxSteps at
+        # their current t (blocking-driver for-else semantics), harvest
+        # everything still resident
+        ctrl = carry[6]
+        status_np = np.asarray(_host_fetch(ctrl["final_status"], recorder,
+                                           **fkw))
+        _harvest(status_np, force=True)
+        # backlog lanes never admitted: no work was done on them — they
+        # report MaxSteps at t0 with their initial state, zero counters.
+        # That is a SEGMENT-ceiling artifact, not a solver verdict, and
+        # indistinguishable from real budget exhaustion downstream — be
+        # loud about it: max_segments bounds the TOTAL stream, so large
+        # backlogs need it scaled by ~ceil(N / resident) generations
+        # (checkpointed_sweep's backlog mode sizes it automatically)
+        never = out_status == RUN
+        if never.any():
+            import warnings
+
+            warnings.warn(
+                f"streamed sweep exhausted max_segments with "
+                f"{int(never.sum())}/{N} backlog lanes never admitted; "
+                f"they report MAX_STEPS_REACHED at t0 having done NO "
+                f"work — scale max_segments by the generation count "
+                f"(~ceil(N/resident) x per-lane segments)",
+                RuntimeWarning, stacklevel=2)
+            if recorder is not None:
+                recorder.event("fault", kind="admission_starved",
+                               lanes=int(never.sum()), n_lanes=N)
+        out_status[never] = int(sdirk.MAX_STEPS_REACHED)
+        out_t[never] = float(t0)
+    if recorder is not None and launched:
+        recorder.counter("lane_attempts", int(out_acc.sum()
+                                              + out_rej.sum()))
+        recorder.counter("lane_capacity",
+                         int(capacity_lane_segs) * int(segment_steps))
+    return sdirk.SolveResult(
+        t=jnp.asarray(out_t, dtype=dtype), y=jnp.asarray(out_y),
+        status=jnp.asarray(out_status),
+        n_accepted=jnp.asarray(out_acc), n_rejected=jnp.asarray(out_rej),
+        # n_save=0 placeholders, the solvers' (1,)-buffer convention
+        ts=jnp.full((N, 1), jnp.inf, dtype=dtype),
+        ys=jnp.zeros((N, 1) + tail, dtype=dtype),
+        n_saved=jnp.zeros((N,), dtype=jnp.int32),
+        h=jnp.asarray(out_h, dtype=dtype),
+        observed=(None if observer is None
+                  else jax.tree.map(jnp.asarray, out_obs)),
+        stats=(None if out_stats is None
+               else {k: jnp.asarray(v) for k, v in out_stats.items()}))
 
 
 def sweep_report(res, cfgs=None):

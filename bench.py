@@ -204,6 +204,22 @@ def rung_main():
     watch = CompileWatch(recorder=rec, default_label="bench-sweep")
     B = int(os.environ.get("BENCH_B", "64"))
     method = os.environ.get("BENCH_METHOD", "bdf")
+    # continuous batching (parallel/sweep.py admission=; the --ragged
+    # preset's standing A/B surface): BENCH_ADMISSION = resident lane
+    # count (0/unset = off; the ragged preset defaults to B//2 so half
+    # the grid streams through freed slots), BENCH_REFILL the queue
+    # threshold.  The rung json records admission + the occupancy split
+    # either way, so ragged-horizon rounds can cite uplift per rung.
+    ragged = os.environ.get("BENCH_RAGGED") == "1"
+    adm_env = os.environ.get("BENCH_ADMISSION", "")
+    if adm_env in ("", "0"):
+        admission = max(1, B // 2) if ragged and adm_env == "" else None
+    else:
+        admission = int(adm_env)
+    refill = None
+    if os.environ.get("BENCH_REFILL"):
+        raw = os.environ["BENCH_REFILL"]
+        refill = float(raw) if "." in raw else int(raw)
     # jac_window=8 (BDF only): one analytic Jacobian serves 8 step attempts
     # (CVODE's quasi-constant iteration matrix, which reuses J far longer).
     # Measured on TPU at B=384/512: +68-72% throughput over jac_window=1,
@@ -256,7 +272,12 @@ def rung_main():
             linsolve=os.environ.get("BENCH_LINSOLVE", "auto"),
             method=method, **solver_kw,
             observer=obs, observer_init=obs0,
-            stats=obs_on, recorder=rec if obs_on else None,
+            admission=admission, refill=refill,
+            stats=obs_on,
+            # the recorder rides along whenever admission is on too: the
+            # occupancy split (lane_attempts/lane_capacity) is recorded
+            # there, and the rung json cites it
+            recorder=rec if (obs_on or admission is not None) else None,
             watch=watch if obs_on else None,
             progress=lambda p: log(f"  segment {p['segment']}: "
                                    f"{p['lanes_done']}/{p['n_lanes']} lanes"))
@@ -287,12 +308,19 @@ def rung_main():
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     trace_ctx = (device_trace(trace_dir) if trace_dir
                  else contextlib.nullcontext())
+    # counter snapshot so the occupancy split cites the TIMED sweep only
+    # (the warm-up sweep accumulated onto the same recorder)
+    ctr0 = dict(rec.snapshot()[2])
     t0 = time.perf_counter()
     with trace_ctx, (watch if obs_on else contextlib.nullcontext()), \
             ph("solve"):
         res = sweep()
         jax.block_until_ready(res.y)
     wall = time.perf_counter() - t0
+    ctr1 = rec.snapshot()[2]
+    ctr_delta = {k: ctr1[k] - ctr0.get(k, 0) for k in ctr1}
+    occ = (round(ctr_delta["lane_attempts"] / ctr_delta["lane_capacity"], 6)
+           if ctr_delta.get("lane_capacity") else None)
     log(f"[rung B={B}] phases:\n{rec.pretty()}")
     if obs_on:
         report = build_report(
@@ -320,6 +348,15 @@ def rung_main():
         "pipeline": gear, "poll_every": stride,
         "linsolve": linsolve_resolved,
         "economy": economy if method == "bdf" else False,
+        # continuous batching (admission=): resident lane count (null =
+        # off), timed-sweep occupancy split, and queue counters — the
+        # ragged-preset A/B surface (null occupancy = no recorder ran)
+        "admission": admission,
+        "ragged": ragged,
+        "occupancy": occ,
+        "admitted_lanes": ctr_delta.get("admitted_lanes", 0),
+        "compactions": ctr_delta.get("compactions", 0),
+        "bucket_downshifts": ctr_delta.get("bucket_downshifts", 0),
         "n_ok": n_ok,
         "warm_s": round(t_warm, 1),
         # compile economy split (aot/ program store): true XLA compiles
@@ -552,6 +589,16 @@ def parse_args(argv):
                    help=f"path for the per-rung progress artifact "
                         f"(default {os.path.basename(PARTIAL)} next to "
                         f"this file)")
+    p.add_argument("--ragged", action="store_true",
+                   help="ragged-horizon rung preset: widens the T window "
+                        "to 1100-2000 K (a stratified spread of per-lane "
+                        "step horizons — cold lanes finish in a fraction "
+                        "of the hot lanes' attempts) and turns on "
+                        "continuous batching with a B/2-slot resident "
+                        "program (BENCH_ADMISSION/BENCH_REFILL override; "
+                        "BENCH_ADMISSION=0 keeps the preset's workload "
+                        "with admission off — the A/B pair).  Rung json "
+                        "records occupancy + admitted_lanes either way")
     return p.parse_args(argv)
 
 
@@ -567,6 +614,14 @@ if __name__ == "__main__":
         args = parse_args(sys.argv[1:])
         if args.rungs:
             os.environ["BENCH_LADDER"] = args.rungs  # main() reads it
+        if args.ragged:
+            # explicit T_LO so the parent's workload fingerprint and the
+            # rung children agree on the measured window (the banked-rung
+            # cache must never serve a differently-shaped workload);
+            # T_LO was already read at import — refresh it
+            os.environ.setdefault("BENCH_T_LO", "1100")
+            os.environ["BENCH_RAGGED"] = "1"
+            T_LO = float(os.environ["BENCH_T_LO"])
         if args.out:
             PARTIAL = os.path.abspath(args.out)
         main()

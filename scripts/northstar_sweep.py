@@ -99,7 +99,8 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
               phi_hi=1.6, t1=8e-4, p=1e5, ckpt_dir=None, chunk_size=512,
               segment_steps=256, mesh=None, rtol=1e-6, atol=1e-10,
               n_spot=8, method="bdf", jac_window=8, sort_lanes=True,
-              pipeline=None, poll_every=None, log=print):
+              pipeline=None, poll_every=None, admission=None, refill=None,
+              record_occupancy=False, log=print):
     """Run the T x phi GRI ignition map; return the result record dict."""
     import jax
     import jax.numpy as jnp
@@ -142,6 +143,14 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
                     observer_init=obs0, mesh=mesh, method=method,
                     segment_steps=segment_steps, jac_window=jac_window,
                     pipeline=pipeline, poll_every=poll_every)
+    # continuous batching (NORTHSTAR_ADMISSION): an obs Recorder rides
+    # along so the occupancy split lands in the record either way the
+    # knob is set — that pair is the A/B evidence the map-vs-rung gap
+    # analysis needs (PERF.md)
+    from batchreactor_tpu.obs import Recorder
+
+    obs_rec = (Recorder() if (admission is not None or record_occupancy)
+               else None)
     lane_cost = None
     if sort_lanes and ckpt_dir:
         # cost-sorted chunking only changes anything when the sweep is
@@ -153,13 +162,24 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
             res = checkpointed_sweep(rhs, y0s, 0.0, t1, cfgs, ckpt_dir,
                                      chunk_size=chunk_size,
                                      lane_cost=lane_cost, chunk_log=log,
-                                     **solve_kw)
+                                     admission=admission, refill=refill,
+                                     recorder=obs_rec, **solve_kw)
         else:
             kw = {k: v for k, v in solve_kw.items() if k != "segment_steps"}
             res = ensemble_solve_segmented(rhs, y0s, 0.0, t1, cfgs,
-                                           segment_steps=segment_steps, **kw)
+                                           segment_steps=segment_steps,
+                                           admission=admission,
+                                           refill=refill,
+                                           recorder=obs_rec, **kw)
         jax.block_until_ready(res.y)
     wall = time.perf_counter() - t_start
+    occ = None
+    adm_ctrs = {}
+    if obs_rec is not None:
+        from batchreactor_tpu.obs import counters as _C
+
+        adm_ctrs = obs_rec.snapshot()[2]
+        occ = _C.occupancy(adm_ctrs)
 
     tau = np.asarray(res.observed["tau"])
     status = np.asarray(res.status)
@@ -233,6 +253,14 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
         # the ONE library rule, so the record can't drift from reality)
         "pipeline": gear_run,
         "poll_every": stride_run,
+        # continuous batching (NORTHSTAR_ADMISSION=0/1/N): resident knob
+        # + the occupancy split of this run — the A/B pair for the
+        # map-vs-rung gap (null occupancy = no recorder armed)
+        "admission": (admission if not isinstance(admission, bool)
+                      else "chunk"),
+        "occupancy": None if occ is None else round(occ, 6),
+        "admitted_lanes": int(adm_ctrs.get("admitted_lanes", 0)),
+        "bucket_downshifts": int(adm_ctrs.get("bucket_downshifts", 0)),
         "lane_cost_sorted": lane_cost is not None,
         "B": int(B),
         "wall_s": round(wall, 2),
@@ -273,6 +301,16 @@ def main():
                               else os.environ["NORTHSTAR_PIPELINE"] != "0"),
                     poll_every=(None if "NORTHSTAR_POLL" not in os.environ
                                 else int(os.environ["NORTHSTAR_POLL"])),
+                    # NORTHSTAR_ADMISSION: 0/unset = off, 1 = on with the
+                    # chunk-sized resident program (checkpointed backlog
+                    # mode), N > 1 = explicit resident lane count.  The
+                    # env present at all (either side) arms the occupancy
+                    # recorder, so A/B rounds diff one ratio.
+                    admission=(None if os.environ.get(
+                        "NORTHSTAR_ADMISSION", "0") == "0"
+                        else True if os.environ["NORTHSTAR_ADMISSION"] == "1"
+                        else int(os.environ["NORTHSTAR_ADMISSION"])),
+                    record_occupancy="NORTHSTAR_ADMISSION" in os.environ,
                     log=lambda m: print(m, file=sys.stderr, flush=True))
     out = os.environ.get("NORTHSTAR_OUT", os.path.join(REPO,
                                                        "NORTHSTAR.json"))

@@ -19,6 +19,21 @@ if not _TPU_TIER:
         + " --xla_force_host_platform_device_count=8"
     )
 
+# The aot/ compile-economy discipline applied to the LOCAL tier-1 run,
+# matching what CI has done since PR 5 (ci.yml restores/saves
+# /tmp/jax_cache around the suite): warmed executables from a previous
+# run are cache-served instead of recompiled — compile cost dominates
+# the suite wall.  setdefault so CI's own dir (and any operator
+# override) wins; min-compile-time 0 is the established cache
+# discipline (bench.py).  Tests that assert TRUE compiles/retraces pin
+# the cache off via the cold_compile_cache fixture below — the same
+# contract that already holds under CI's warm cache.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
 import pathlib
 
 import jax

@@ -1,0 +1,378 @@
+"""Continuous batching (parallel/sweep.py ``admission=``): device-side
+lane compaction + streaming admission queue.
+
+The equivalence contract under test: per-lane results, telemetry lane
+arrays, provenance codes, and checkpoint artifacts from the streaming
+admission driver must be BIT-EXACT against the admission-less pipelined
+driver, with the permutation un-shuffled back to caller lane order.
+Like the pipelined-vs-blocking tests these run a cheap stiff decay ODE
+(tiny traced programs, tier-1 budget) — the drivers are results-neutral
+regardless of RHS.
+
+Shape discipline: XLA CPU vectorizes some batch shapes differently
+(the documented <=2-ulp bucket-padding caveat, parallel/sweep.py
+``_pad_lanes``), so the bit-exact matrix pins resident/chunk shapes to
+one equality class; the bucket DOWN-SHIFT test, whose whole point is a
+mid-lane program-shape switch, asserts exact step counts/statuses/stats
+and tolerance-level state instead.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchreactor_tpu.parallel import ensemble_solve_segmented
+from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+from batchreactor_tpu.parallel.sweep import (make_mesh, resolve_admission,
+                                             _refill_slots)
+from batchreactor_tpu.solver.sdirk import (DT_UNDERFLOW,
+                                           MAX_STEPS_REACHED, SUCCESS)
+
+
+@pytest.fixture(scope="module")
+def h2o2(lib_dir):
+    import batchreactor_tpu as br
+
+    gm = br.compile_gaschemistry(f"{lib_dir}/h2o2.dat")
+    th = br.create_thermo(list(gm.species), f"{lib_dir}/therm.dat")
+    return gm, th
+
+
+def _decay_rhs(t, y, cfg):
+    return -cfg["k"] * y
+
+
+def _decay_setup(B=6, poison_lane=None, k_hi=2.5):
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (B, 2))
+    if poison_lane is not None:
+        y0s = y0s.at[poison_lane, 0].set(jnp.nan)
+    cfgs = {"k": jnp.logspace(1.0, k_hi, B)}
+    return y0s, cfgs
+
+
+def _decay_observer():
+    init = {"ymax": -jnp.inf, "t_last": jnp.nan}
+
+    def obs(t, y, acc):
+        return {"ymax": jnp.maximum(y[0], acc["ymax"]), "t_last": t}
+
+    return obs, init
+
+
+def _fields(res):
+    out = {f: np.asarray(getattr(res, f))
+           for f in ("t", "y", "status", "n_accepted", "n_rejected",
+                     "ts", "ys", "n_saved", "h")}
+    if res.observed is not None:
+        for k, v in res.observed.items():
+            out[f"obs_{k}"] = np.asarray(v)
+    if res.stats is not None:
+        for k, v in res.stats.items():
+            out[f"stat_{k}"] = np.asarray(v)
+    return out
+
+
+def _assert_bit_exact(a, b, ctx=""):
+    fa, fb = _fields(a), _fields(b)
+    assert fa.keys() == fb.keys(), (ctx, fa.keys(), fb.keys())
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], fb[k],
+                                      err_msg=f"{ctx} field {k}")
+
+
+# --------------------------------------------------------------------------
+# knob grammar + loud validation
+# --------------------------------------------------------------------------
+def test_resolve_admission_grammar():
+    assert resolve_admission(None, None) == (None, None)
+    assert resolve_admission(False, None) == (None, None)
+    assert resolve_admission(True, None, n_lanes=7) == (7, 0.25)
+    assert resolve_admission(4, None) == (4, 0.25)
+    assert resolve_admission(4, 0.5) == (4, 0.5)
+    assert resolve_admission(4, 2) == (4, 2)
+    for bad in ("pow2", 0, -1, 1.5):
+        with pytest.raises(ValueError, match="admission"):
+            resolve_admission(bad)
+    with pytest.raises(ValueError, match="refill"):
+        resolve_admission(None, 0.5)      # refill without admission
+    for bad in (0, -2, 0.0, 1.5, True, "x"):
+        with pytest.raises(ValueError, match="refill"):
+            resolve_admission(4, bad)
+    with pytest.raises(ValueError, match="lane count"):
+        resolve_admission(True)
+    # fractions convert AFTER bucket padding, rounding up, clamped
+    assert _refill_slots(0.25, 8) == 2
+    assert _refill_slots(0.25, 3) == 1
+    assert _refill_slots(1.0, 4) == 4
+    assert _refill_slots(100, 4) == 4
+
+
+def test_admission_driver_validation():
+    y0s, cfgs = _decay_setup(B=4)
+    kw = dict(segment_steps=16, max_segments=8)
+    with pytest.raises(ValueError, match="pipelined gear"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 pipeline=False, admission=2, **kw)
+    with pytest.raises(ValueError, match="mesh"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 mesh=make_mesh(), admission=2, **kw)
+    with pytest.raises(ValueError, match="n_save"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 n_save=16, admission=2, **kw)
+    with pytest.raises(ValueError, match="refill"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 refill=0.5, **kw)
+
+
+def test_checkpointed_admission_validation(tmp_path):
+    y0s, cfgs = _decay_setup(B=4)
+    with pytest.raises(ValueError, match="segment_steps"):
+        checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                           str(tmp_path / "a"), chunk_size=2, admission=2)
+    with pytest.raises(ValueError, match="chunk_budget_s"):
+        checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                           str(tmp_path / "b"), chunk_size=2, admission=2,
+                           segment_steps=16, chunk_budget_s=30.0)
+    with pytest.raises(ValueError, match="n_save"):
+        checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                           str(tmp_path / "c"), chunk_size=2, admission=2,
+                           segment_steps=16, n_save=8)
+
+
+# --------------------------------------------------------------------------
+# streaming driver equivalence
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("method", ["bdf", "sdirk"])
+def test_streaming_bit_exact(method):
+    """Compacted/refilled sweep results — state, final t/h, statuses,
+    step counts, per-lane telemetry arrays, observer folds — are
+    bit-exact vs the admission-less pipelined driver, un-shuffled to
+    caller lane order.  Includes a DT_UNDERFLOW lane (slot freed early,
+    refilled from the backlog) and mid-sweep terminations (the k
+    spread)."""
+    obs, obs0 = _decay_observer()
+    y0s, cfgs = _decay_setup(B=6, poison_lane=1)
+    k_before = np.asarray(cfgs["k"]).copy()
+    kw = dict(segment_steps=16, max_segments=60, observer=obs,
+              observer_init=obs0, method=method, dt_min_factor=1e-12,
+              stats=True)
+    ref = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                   pipeline=True, **kw)
+    status = np.asarray(ref.status)
+    assert status[1] == DT_UNDERFLOW and np.all(np.delete(status, 1)
+                                                == SUCCESS)
+    for refill in (1, 0.5):
+        adm = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                       pipeline=True, admission=3,
+                                       refill=refill, **kw)
+        _assert_bit_exact(ref, adm, f"{method}/refill={refill}")
+        # donation-aliasing regression: the compaction/relaunch programs
+        # donate the resident blocks, and on the CPU backend a zero-copy
+        # view would let them scribble over the CALLER's arrays (the
+        # corruption only ever surfaced on the NEXT sweep using them)
+        assert np.isnan(np.asarray(y0s)[1, 0])
+        np.testing.assert_array_equal(np.asarray(cfgs["k"]), k_before)
+
+
+def test_streaming_budget_parking_bit_exact():
+    """The exact max_attempts budget — reset per admitted lane — parks
+    lanes at the same attempt counts and statuses as the admission-less
+    driver."""
+    y0s, cfgs = _decay_setup(B=6)
+    kw = dict(segment_steps=16, max_segments=60, max_attempts=120,
+              stats=True)
+    ref = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                   pipeline=True, **kw)
+    status = np.asarray(ref.status)
+    assert np.any(status == MAX_STEPS_REACHED) and np.any(status == SUCCESS)
+    adm = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                   pipeline=True, admission=3, **kw)
+    _assert_bit_exact(ref, adm, "budget")
+
+
+def test_streaming_counters_and_occupancy():
+    """The admission telemetry: compactions fire, every backlog lane is
+    admitted exactly once, and the occupancy pair reports useful
+    attempts <= capacity (docs/observability.md)."""
+    from batchreactor_tpu.obs import Recorder
+
+    y0s, cfgs = _decay_setup(B=6)
+    rec = Recorder()
+    res = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                   pipeline=True, admission=3, refill=1,
+                                   segment_steps=16, max_segments=60,
+                                   recorder=rec)
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    _, _, ctrs = rec.snapshot()
+    assert ctrs["admitted_lanes"] == 3          # backlog beyond resident
+    assert ctrs["compactions"] >= 1
+    att = int(res.n_accepted.sum() + res.n_rejected.sum())
+    assert ctrs["lane_attempts"] == att
+    assert 0 < ctrs["lane_attempts"] <= ctrs["lane_capacity"]
+
+
+@pytest.mark.slow   # tier-1 budget (CI satellite): the heavy end of
+#   the matrix runs in CI's default suite; the bit-exact core stays
+#   in the timed tier-1 run
+def test_streaming_bucketed_bit_exact():
+    """admission x buckets (no down-shift: the ladder floor equals the
+    resident bucket): the resident program runs a canonical bucket
+    shape, refills keep it full, and live-lane results stay bit-exact
+    vs the admission-less bucketed driver."""
+    y0s, cfgs = _decay_setup(B=6)
+    kw = dict(segment_steps=16, max_segments=60, stats=True,
+              buckets=(4, 16))
+    ref = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                   pipeline=True, **kw)
+    adm = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                   pipeline=True, admission=3, **kw)
+    _assert_bit_exact(ref, adm, "bucketed")
+
+
+@pytest.mark.slow   # tier-1 budget (CI satellite): the heavy end of
+#   the matrix runs in CI's default suite; the bit-exact core stays
+#   in the timed tier-1 run
+def test_bucket_downshift():
+    """Backlog drained + live lanes fitting a smaller pow2 rung: the
+    driver down-shifts onto the smaller program.  Step counts, statuses,
+    and per-lane counters stay exact; carried state is tolerance-level
+    across the program-shape switch (the documented bucket-shape ulp
+    caveat); the switch is an EXPECTED compile under its new
+    program_key, never a retrace."""
+    from batchreactor_tpu.obs import CompileWatch, Recorder
+
+    # 7 cheap lanes + 1 stiff straggler: the cheap lanes park early and
+    # the drain tail runs long enough for polls to catch live < bucket
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (8, 2))
+    cfgs = {"k": jnp.asarray([10.0] * 7 + [10.0 ** 3.2])}
+    kw = dict(segment_steps=16, max_segments=120, stats=True,
+              buckets="pow2", poll_every=1)
+    ref = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                   pipeline=True, **kw)
+    rec = Recorder()
+    watch = CompileWatch(recorder=rec, default_label="test")
+    with watch:
+        adm = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                       pipeline=True, admission=True,
+                                       refill=1, recorder=rec,
+                                       watch=watch, **kw)
+    _, _, ctrs = rec.snapshot()
+    assert ctrs["bucket_downshifts"] >= 1
+    assert watch.summary()["retraces"] == 0
+    for f in ("status", "n_accepted", "n_rejected", "n_saved"):
+        np.testing.assert_array_equal(np.asarray(getattr(ref, f)),
+                                      np.asarray(getattr(adm, f)),
+                                      err_msg=f)
+    for k in ref.stats:
+        np.testing.assert_array_equal(np.asarray(ref.stats[k]),
+                                      np.asarray(adm.stats[k]),
+                                      err_msg=k)
+    for f in ("t", "y", "h"):
+        np.testing.assert_allclose(np.asarray(getattr(ref, f)),
+                                   np.asarray(getattr(adm, f)),
+                                   rtol=1e-9, atol=1e-30, err_msg=f)
+
+
+# --------------------------------------------------------------------------
+# checkpointed backlog mode
+# --------------------------------------------------------------------------
+def test_checkpointed_streamed_bit_exact_and_resume(tmp_path):
+    """Chunks as completion units: artifacts, concatenated results, and
+    resume — including a resume finished by the NON-admission driver
+    (the knobs are fingerprint-exempt gear) — are bit-exact vs the
+    chunked driver.  B divides chunk_size so both drivers run one
+    program-shape class (module docstring)."""
+    y0s, cfgs = _decay_setup(B=6)
+    kw = dict(segment_steps=16, max_steps=2000, stats=True)
+    ref = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                             str(tmp_path / "ref"), chunk_size=3, **kw)
+    adm = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                             str(tmp_path / "adm"), chunk_size=3,
+                             admission=True, refill=1, **kw)
+    _assert_bit_exact(ref, adm, "checkpointed")
+    # the manifest records the admission order (operational, non-pinned)
+    import json
+
+    man = json.load(open(tmp_path / "adm" / "manifest.json"))
+    assert man["admission"]["resident"] == 3
+    # resume: drop one chunk, re-stream only it
+    os.remove(str(tmp_path / "adm" / "chunk_00001.npz"))
+    resumed = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 str(tmp_path / "adm"), chunk_size=3,
+                                 admission=True, refill=1, **kw)
+    _assert_bit_exact(ref, resumed, "checkpointed-resume")
+    # cross-gear resume: the admission-written dir serves the chunked
+    # driver (and vice versa) — the fingerprint never learned the gear
+    os.remove(str(tmp_path / "adm" / "chunk_00000.npz"))
+    cross = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                               str(tmp_path / "adm"), chunk_size=3, **kw)
+    _assert_bit_exact(ref, cross, "checkpointed-cross-gear")
+
+
+@pytest.mark.slow   # tier-1 budget (CI satellite): the heavy end of
+#   the matrix runs in CI's default suite; the bit-exact core stays
+#   in the timed tier-1 run
+def test_provenance_maps_through_permutation(tmp_path):
+    """Quarantine provenance codes land at the caller lane index under
+    admission — the permutation un-shuffle covers the resilience layer,
+    not just results (a NaN lane admitted mid-stream must quarantine as
+    lane 4, not as whatever slot it occupied)."""
+    y0s, cfgs = _decay_setup(B=6, poison_lane=4)
+    kw = dict(segment_steps=16, max_steps=2000, quarantine=True)
+    ref = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                             str(tmp_path / "ref"), chunk_size=3, **kw)
+    adm = checkpointed_sweep(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                             str(tmp_path / "adm"), chunk_size=3,
+                             admission=True, refill=1, **kw)
+    assert ref.provenance is not None
+    np.testing.assert_array_equal(np.asarray(ref.provenance),
+                                  np.asarray(adm.provenance))
+    np.testing.assert_array_equal(np.asarray(ref.status),
+                                  np.asarray(adm.status))
+    # the poisoned lane is the one carrying a non-primary code, at its
+    # caller index on both gears
+    assert int(np.asarray(adm.provenance)[4]) != 0
+    assert np.all(np.asarray(adm.provenance)[[0, 1, 2, 3, 5]] == 0)
+
+
+@pytest.mark.slow   # tier-1 budget (CI satellite): the heavy end of
+#   the matrix runs in CI's default suite; the bit-exact core stays
+#   in the timed tier-1 run
+def test_api_admission_knobs(h2o2):
+    """api.py loudness + end-to-end: admission knobs on the monolithic
+    path raise before any parsing; a segmented admission sweep matches
+    the admission-less sweep (the real-mechanism <=2-ulp cross-shape
+    tolerance, the test_aot convention) and reports the occupancy
+    counters in its telemetry.  The plain sweep mirrors
+    test_api_bucketed_sweep_matches_unbucketed's configuration, so its
+    program is persistent-cache-served on a warm suite."""
+    import batchreactor_tpu as br
+
+    gm, th = h2o2
+    kw = dict(chem=br.Chemistry(gaschem=True), thermo_obj=th, md=gm)
+    comp = {"H2": 0.3, "O2": 0.2, "N2": 0.5}
+    T = np.linspace(1050, 1150, 5)
+    with pytest.raises(ValueError, match="segmented-path"):
+        br.batch_reactor_sweep(comp, T, 1e5, 1e-5, admission=3, **kw)
+    with pytest.raises(ValueError, match="segmented-path"):
+        br.batch_reactor_sweep(comp, T, 1e5, 1e-5, refill=1, **kw)
+    with pytest.raises(ValueError, match="refill"):
+        br.batch_reactor_sweep(comp, T, 1e5, 1e-5, segment_steps=16,
+                               refill=1, **kw)
+    with pytest.raises(ValueError, match="mesh"):
+        br.batch_reactor_sweep(comp, T, 1e5, 1e-5, segment_steps=16,
+                               admission=3, mesh=make_mesh(), **kw)
+    seg = dict(segment_steps=16, ignition_marker="H2", telemetry=True)
+    ref = br.batch_reactor_sweep(comp, T, 1e5, 1e-5, **kw, **seg)
+    adm = br.batch_reactor_sweep(comp, T, 1e5, 1e-5, admission=3,
+                                 refill=1, **kw, **seg)
+    np.testing.assert_array_equal(ref["status"], adm["status"])
+    np.testing.assert_allclose(ref["tau"], adm["tau"], rtol=1e-12)
+    for s in ref["x"]:
+        np.testing.assert_allclose(ref["x"][s], adm["x"][s], rtol=1e-12)
+    ctrs = adm["telemetry"]["counters"]
+    assert ctrs["admitted_lanes"] == 2          # 5 lanes, 3 resident
+    assert adm["telemetry"]["meta"]["admission"] is True
+    assert 0 < ctrs["lane_attempts"] <= ctrs["lane_capacity"]

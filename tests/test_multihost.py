@@ -73,8 +73,78 @@ def _free_port():
     return port
 
 
+_MP_PROBE = r"""
+import os, sys
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=n, process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.asarray(jax.devices()), ("b",))
+local = jnp.zeros((2,))
+g = jax.make_array_from_single_device_arrays(
+    (2 * n,), NamedSharding(mesh, P("b")),
+    [jax.device_put(local[i:i + 1], d) for i, d in
+     enumerate(jax.local_devices())])
+out = jax.jit(lambda a: a + 1.0)(g)
+np.asarray(multihost_utils.process_allgather(out))
+print("MP_OK")
+"""
+
+_mp_capability = {}
+
+
+def _multiprocess_cpu_capable(tmp_path_factory):
+    """Capability probe: can THIS jax build actually execute a jitted
+    computation on a multi-process CPU mesh?  Some CPU backends reject
+    it outright ('Multiprocess computations aren't implemented on the
+    CPU backend' — the pre-existing PR-7 failure), which is an
+    environment limitation, not a regression: the dependent test skips
+    instead of failing.  One probe per session (two bare-jax processes,
+    a few seconds); any nonzero exit or missing marker means incapable."""
+    if "ok" not in _mp_capability:
+        d = tmp_path_factory.mktemp("mp_probe")
+        script = d / "probe.py"
+        script.write_text(_MP_PROBE)
+        port = _free_port()
+        env = {**os.environ, "PYTHONPATH": str(REPO)}
+        env.pop("XLA_FLAGS", None)
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(d)) for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=120)
+                outs.append(out)
+            _mp_capability["ok"] = all(
+                p.returncode == 0 and "MP_OK" in out
+                for p, out in zip(procs, outs))
+        except subprocess.TimeoutExpired:
+            _mp_capability["ok"] = False
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        _mp_capability["detail"] = "\n".join(o[-500:] for o in outs)
+    return _mp_capability["ok"]
+
+
 @pytest.mark.slow
-def test_two_process_global_mesh_matches_single(tmp_path, lib_dir):
+def test_two_process_global_mesh_matches_single(tmp_path, tmp_path_factory,
+                                                lib_dir):
+    if not _multiprocess_cpu_capable(tmp_path_factory):
+        pytest.skip("CPU backend lacks multi-process collectives "
+                    "(probe failed: "
+                    f"{_mp_capability['detail'].splitlines()[-1:]})" )
     child = tmp_path / "mh_child.py"
     child.write_text(CHILD)
     port = _free_port()
