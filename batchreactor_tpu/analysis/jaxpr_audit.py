@@ -451,4 +451,47 @@ def run_audit(fixtures_dir=None):
             "trace: the continuous-batching plumbing leaked into the "
             "shared segment program (parallel/sweep.py admission-off "
             "byte-identity contract)"))
+
+    # per-lane timeline ring (obs/timeline.py, solver ``timeline=N``):
+    # (1) the instrumented solver and segment programs meet the same
+    # purity contract — the ring is masked row scatters on values the
+    # attempt already computed, never a callback or in-loop staging;
+    # (2) ``timeline=None`` byte-identity survives the timeline
+    # machinery having been built AND RUN (the economy/admission
+    # noop-fork invariance class): the stats-instrumented solver
+    # program and the plain segment program are re-traced after a real
+    # timeline sweep and must match their pre-timeline traces.
+    j_stats_before = str(jax.make_jaxpr(functools.partial(
+        _bdf_run, stats=True))(y0))
+    jaxpr = jax.make_jaxpr(functools.partial(
+        _bdf_run, stats=True, timeline=8))(y0)
+    findings.extend(_audit_jaxpr("bdf-step-timeline", jaxpr,
+                                 check_dtype=False))
+    tl_seg_fn = _sweep._segment_fn(
+        rhs, 1e-6, 1e-10, 4, 1e-22, "auto", jac, None, 0, False, 1,
+        0.03, "bdf", True, True, 0, True, timeline=8)
+    carry_t = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
+                                         True, 0, timeline=8)
+    jaxpr = jax.make_jaxpr(_run_seg(tl_seg_fn, cfgb))(carry_t)
+    findings.extend(_audit_jaxpr("segment-pipelined-step-timeline",
+                                 jaxpr, check_dtype=False))
+    tl_res = _sweep.ensemble_solve_segmented(
+        lambda t, y, cfg: -cfg["k"] * y,
+        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (2, 2)), 0.0, 1.0,
+        {"k": jnp.asarray([10.0, 40.0])}, segment_steps=8,
+        max_segments=200, pipeline=True, poll_every=1, method="bdf",
+        stats=True, timeline=8)
+    assert int(tl_res.status.sum()) == 2  # 2 lanes, all SUCCESS(=1)
+    j_stats_after = str(jax.make_jaxpr(functools.partial(
+        _bdf_run, stats=True))(y0))
+    j_seg_after = str(jax.make_jaxpr(_run_seg(plain_seg_fn,
+                                              cfgb))(carry_r))
+    if j_stats_after != j_stats_before or j_seg_after != j_unarmed:
+        findings.append(Finding(
+            "timeline-noop-fork", "<jaxpr:timeline-noop>", 0, 0,
+            "tracing after building and running the timeline ring "
+            "changed a timeline-off program (solver stats step or "
+            "segment program): the ring plumbing leaked into the "
+            "default trace (solver/bdf.py timeline=None byte-identity "
+            "contract)"))
     return findings

@@ -777,7 +777,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         linsolve="auto", setup_economy=False, stale_tol=0.3,
                         analytic_jac=True, telemetry=False, pipeline=None,
                         poll_every=None, buckets=None, fetch_deadline=None,
-                        quarantine=None, admission=None, refill=None):
+                        quarantine=None, admission=None, refill=None,
+                        timeline=None, live_metrics=None):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -899,6 +900,31 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     ``admitted_lanes``, ``bucket_downshifts`` —
     docs/observability.md).
 
+    ``timeline=N`` (requires ``telemetry=True``; docs/observability.md
+    "Solver timelines") records each lane's last N step-attempt records
+    ``(t, h, code)`` — attempted time, attempted step size, and a
+    signed code packing outcome/cause (order taken on accept, error vs
+    convergence reject) — into a per-lane ring riding the solver stats
+    carry (``obs/timeline.py``).  The ring lands in
+    ``out["telemetry"]["solver_stats"]["per_lane"]["timeline_*"]``,
+    renders with ``scripts/obs_report.py --timeline``, and is
+    positionally exact under admission/bucket padding (the same
+    un-shuffle as every per-lane array).  ``timeline=None`` (default)
+    leaves every traced program byte-identical (brlint tier-B
+    ``timeline-noop-fork``).
+
+    ``live_metrics`` (docs/observability.md "Live metrics") serves a
+    Prometheus ``/metrics`` + JSON ``/healthz`` endpoint for the
+    duration of the sweep from a background stdlib HTTP thread
+    (``obs.MetricsServer``): ``True`` = an ephemeral port, an int = that
+    port (0 = ephemeral), ``None`` resolves from the
+    ``BR_METRICS_PORT`` env lever (unset = off — THE resolution rule,
+    ``obs.live.resolve_live_metrics``).  Segmented runs publish
+    in-flight occupancy/backlog gauges at every poll boundary, so
+    ``br_sweep_occupancy`` moves between scrapes while lanes stream.
+    Purely host-side: traced programs are byte-identical with the
+    endpoint on or off.
+
     ``quarantine`` (None/True/dict/``resilience.QuarantinePolicy``)
     recovers non-success lanes instead of reporting them failed: a
     same-settings full-batch retry pass (bit-exact for transient
@@ -940,6 +966,14 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
 
     if admission is not True:
         resolve_admission(admission, refill, n_lanes=1)
+    # timeline/live validation up front, before any mechanism parsing
+    # (the other knobs' convention); ONE rule each — obs/timeline.py and
+    # obs/live.py
+    from .obs.live import resolve_live_metrics
+    from .obs.timeline import validate as _tl_validate
+
+    timeline = _tl_validate(timeline, telemetry)
+    live_port = resolve_live_metrics(live_metrics)
     if admission not in (None, False) and mesh is not None:
         raise ValueError(
             "admission= is incompatible with mesh= (parallel/sweep.py "
@@ -1095,18 +1129,31 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                 "this sweep runs on CPU devices; for f64-exact CPU rates "
                 "set BR_EXP32=0 before importing batchreactor_tpu",
                 RuntimeWarning, stacklevel=2)
-    from .obs import CompileWatch, Recorder, build_report
+    from .obs import CompileWatch, LiveRegistry, MetricsServer, Recorder, \
+        build_report
 
-    rec = Recorder() if telemetry else None
+    # a live endpoint needs a recorder to have counters to serve even
+    # when the device counter block (stats=telemetry) stays off — the
+    # recorder is host-side bookkeeping, not a traced-program change
+    rec = Recorder() if (telemetry or live_port is not None) else None
     watch = CompileWatch(recorder=rec, default_label="sweep")
+    registry = server = None
+    if live_port is not None:
+        registry = LiveRegistry(
+            recorder=rec,
+            meta={"entry": "batch_reactor_sweep", "mode": mode,
+                  "lanes": B})
+        server = MetricsServer(registry, port=live_port)
     common = dict(mesh=mesh, rtol=rtol, atol=atol, jac=jac,
                   observer=observer, observer_init=obs0, method=method,
                   jac_window=jac_window, linsolve=linsolve,
                   setup_economy=setup_economy, stale_tol=stale_tol,
-                  stats=telemetry, buckets=buckets)
-    with (watch if telemetry else contextlib.nullcontext()), \
+                  stats=telemetry, buckets=buckets, timeline=timeline)
+    with (server if server is not None else contextlib.nullcontext()), \
+            (watch if telemetry else contextlib.nullcontext()), \
             (rec.span("solve", lanes=B)
              if telemetry else contextlib.nullcontext()):
+        bound_port = server.port if server is not None else None
         if segment_steps > 0:
             res = ensemble_solve_segmented(rhs, y0s, 0.0, float(time), cfgs,
                                            segment_steps=segment_steps,
@@ -1116,6 +1163,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                                            fetch_deadline=fetch_deadline,
                                            admission=admission,
                                            refill=refill,
+                                           live=registry,
                                            watch=watch if telemetry
                                            else None, **common)
         else:
@@ -1169,7 +1217,11 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                 jac=jac, observer=observer, observer_init=obs0,
                 method=method, jac_window=jac_window, linsolve=linsolve,
                 setup_economy=setup_economy, stale_tol=stale_tol,
-                stats=telemetry, rtol=kw["rtol"], atol=kw["atol"])
+                stats=telemetry, rtol=kw["rtol"], atol=kw["atol"],
+                # same stats schema as the primary result: without the
+                # ring keys the quarantine merge_lanes tree-map would
+                # see mismatched pytrees
+                timeline=timeline)
             if segment_steps > 0:
                 ms = kw["max_steps"]
                 return ensemble_solve_segmented(
@@ -1214,7 +1266,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
             meta={"entry": "batch_reactor_sweep", "mode": mode,
                   "method": method, "lanes": B, "bucket": bucket,
                   "segmented": bool(segment_steps > 0),
-                  "admission": admission not in (None, False)})
+                  "admission": admission not in (None, False),
+                  "timeline": timeline, "live_port": bound_port})
     return out
 
 

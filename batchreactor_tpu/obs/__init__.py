@@ -36,6 +36,10 @@ from .retrace import CompileWatch
 from .report import build_report, render, diff, stats_totals
 from .export import (to_jsonl, from_jsonl, to_prometheus, write_jsonl,
                      read_jsonl)
+from . import live, timeline  # noqa: F401  (submodule re-exports)
+from .live import (FlightRecorder, LiveRegistry, MetricsServer,
+                   arm_flight, armed_flight, disarm_flight, flight_dump,
+                   resolve_live_metrics)
 
 __all__ = [
     "Recorder",
@@ -50,4 +54,14 @@ __all__ = [
     "to_prometheus",
     "write_jsonl",
     "read_jsonl",
+    "live",
+    "timeline",
+    "LiveRegistry",
+    "MetricsServer",
+    "FlightRecorder",
+    "arm_flight",
+    "armed_flight",
+    "disarm_flight",
+    "flight_dump",
+    "resolve_live_metrics",
 ]

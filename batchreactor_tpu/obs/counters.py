@@ -90,6 +90,19 @@ ADMISSION_KEYS = ("compactions", "admitted_lanes", "bucket_downshifts",
 
 #: step_audit payloads folded into stats (not counters; excluded from sums)
 AUDIT_KEYS = ("accept_ring", "it_matrix")
+#: per-lane timeline ring payloads (``timeline=N`` — obs/timeline.py):
+#: slot-keyed sample buffers like the audit ring, so they REPLACE across
+#: segments (the solver carries the ring forward and returns the updated
+#: whole) and never enter counter totals
+TIMELINE_KEYS = ("timeline_t", "timeline_h", "timeline_code")
+#: live-telemetry-plane counters (obs/live.py — docs/observability.md
+#: "Live metrics"/"Flight recorder"): Recorder counters incremented by
+#: the metrics endpoint (scrapes), the registry (publishes), the fleet
+#: snapshot writer, and the flight recorder (dumps).  Absent from a
+#: report whose run served no endpoint — ``obs.diff`` maps a missing
+#: key to 0 (the FAULT_KEYS/ADMISSION_KEYS convention).
+LIVE_KEYS = ("metrics_scrapes", "live_publishes", "fleet_snapshots",
+             "flight_dumps")
 
 
 def occupancy(counters):
@@ -122,7 +135,7 @@ def accumulate(total, seg_stats, live):
     if total is None:
         total = {}
         for k, v in seg_stats.items():
-            if k in AUDIT_KEYS:
+            if k in AUDIT_KEYS or k in TIMELINE_KEYS:
                 total[k] = np.asarray(v)
             else:
                 # gauges start from their first live observation too:
@@ -131,7 +144,7 @@ def accumulate(total, seg_stats, live):
         return total
     out = dict(total)
     for k, v in seg_stats.items():
-        if k in AUDIT_KEYS:
+        if k in AUDIT_KEYS or k in TIMELINE_KEYS:
             mask = np.asarray(live)
             mask = mask.reshape(mask.shape + (1,) * (np.asarray(v).ndim
                                                      - mask.ndim))
@@ -156,7 +169,9 @@ def totals(stats):
         return None
     out = {}
     for k, v in stats.items():
-        if k in AUDIT_KEYS:
+        if k in AUDIT_KEYS or k in TIMELINE_KEYS:
+            # sample buffers, not counters: summing ring slots would
+            # report a number with no meaning
             continue
         a = np.asarray(v)
         if k == "order_hist":

@@ -46,12 +46,22 @@ class Recorder:
     """Collects nested spans, point events, and counters (module doc)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # REENTRANT: the flight recorder's SIGTERM hook (obs/live.py)
+        # runs on the main thread and snapshots this recorder — if the
+        # signal lands while the interrupted frame already holds the
+        # lock (a counter() mid-update), a plain Lock would deadlock
+        # the teardown the dump exists to capture
+        self._lock = threading.RLock()
         self._tls = threading.local()
         self._seq = 0
         self.spans = []     # append order = start order (per the lock)
         self.events = []
         self.counters = {}
+        #: optional observer ``tap(kind, record)`` called (outside the
+        #: lock) once per COMPLETED span, event, and counter update —
+        #: the flight recorder's attachment point (obs/live.py); must be
+        #: cheap and must not call back into this recorder
+        self.tap = None
 
     # ---- spans ------------------------------------------------------------
     def _stack(self):
@@ -86,18 +96,29 @@ class Recorder:
                 jax.block_until_ready(block)
             rec["dur"] = time.perf_counter() - t0
             stack.pop()
+            tap = self.tap   # local snapshot: a concurrent disarm may
+            if tap is not None:   # null the attribute between the
+                tap("span", dict(rec))   # check and the call
 
     # ---- events & counters ------------------------------------------------
     def event(self, name, **attrs):
         """Record a point event (e.g. ``retrace``, ``chunk_loaded``)."""
+        rec = {"name": name, "time": time.time(), "attrs": dict(attrs)}
         with self._lock:
-            self.events.append({"name": name, "time": time.time(),
-                                "attrs": dict(attrs)})
+            self.events.append(rec)
+        tap = self.tap
+        if tap is not None:
+            tap("event", dict(rec))
 
     def counter(self, name, value=1):
         """Accumulate ``value`` onto the named counter."""
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + value
+            total = self.counters[name]
+        tap = self.tap
+        if tap is not None:
+            tap("counter", {"name": name, "value": value,
+                            "total": total})
 
     # ---- views ------------------------------------------------------------
     def by_name(self):

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.live import flight_dump, flight_note_counters
 from ..obs.recorder import Recorder
 from ..solver.sdirk import SolveResult
 from .sweep import ensemble_solve
@@ -200,7 +201,12 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
             # from the identical default-resolved configuration
             continue
         if k in ("pipeline", "poll_every", "fetch_deadline", "admission",
-                 "refill"):
+                 "refill", "live"):
+            # NOTE: ``timeline`` is deliberately NOT exempt — unlike the
+            # gear knobs it changes the persisted chunk-artifact schema
+            # (stat_timeline_* keys/shapes), so a resume under a
+            # different ring must fail loudly like any changed solver
+            # setting (``stats`` has always hashed for the same reason)
             # segmented execution-GEAR / watchdog knobs, contractually
             # results-neutral (parallel/sweep.py): they change how
             # segments are driven or how long the host waits, never the
@@ -372,7 +378,8 @@ def _solve_chunk(rhs, y0c, t0, t1, cfgc, solve_kw, recorder=None):
         # solve_kw (checkpointed_sweep binds them as named kwargs)
         kw = {k: v for k, v in solve_kw.items()
               if k not in ("segment_steps", "pipeline", "poll_every",
-                           "fetch_deadline", "admission", "refill")}
+                           "fetch_deadline", "admission", "refill",
+                           "live")}
         res = ensemble_solve(rhs, y0c, t0, t1, cfgc, **kw)
     if pad:
         res = jax.tree.map(
@@ -521,6 +528,14 @@ def _stream_pending_chunks(rhs, y0s, t0, t1, cfgs, ckpt_dir, parts, *,
         save_async(i, os.path.join(ckpt_dir, f"chunk_{i:05d}.npz"), res,
                    chunk_cfgs)
         done[i] = res
+        live = solve_kw.get("live")
+        if live is not None:
+            # chunk-completion progress for the live plane (the driver
+            # itself publishes the "sweep"-source occupancy/backlog)
+            live.publish("checkpoint", gauges={
+                "chunks_done": len(done), "chunks_total": len(chunks),
+                "chunk_retry_attempts": sum(
+                    len(v) for v in ledger.attempts.values())})
 
     attempts = (retry.max_retries if retry is not None else 0) + 1
     for attempt in range(attempts):
@@ -614,6 +629,11 @@ def _stream_pending_chunks(rhs, y0s, t0, t1, cfgs, ckpt_dir, parts, *,
                           f"FAILED ({type(e).__name__}); "
                           f"{'giving up' if last else 'retrying'}")
             if last:
+                # postmortem: the armed flight ring dumps before the
+                # exhausted fault propagates (obs/live.py; no-op unarmed)
+                flight_note_counters(rec)
+                flight_dump(f"streamed pass retry exhausted: "
+                            f"{type(e).__name__}")
                 raise
             rec.counter("chunk_retries")
             if isinstance(e, WedgeError):
@@ -748,6 +768,18 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     grow host memory for its whole life.  The recorder is deliberately
     NOT part of the sweep fingerprint (it describes the observer, not
     the sweep).
+
+    ``timeline=``/``live=`` (in ``solve_kw``; docs/observability.md
+    "Solver timelines"/"Live metrics") ride through to the per-chunk
+    sweep driver: the per-lane attempt-record ring persists in each
+    chunk's npz under ``stat_timeline_*`` keys, and the live registry
+    additionally receives "checkpoint"-source gauges — chunks
+    done/total and the manifest retry-ledger attempt count — whenever a
+    chunk completes.  ``live`` is fingerprint-exempt observer gear like
+    ``recorder``; a NON-None ``timeline`` joins the resume fingerprint
+    (it changes the persisted chunk stats schema — resuming under a
+    different ring fails loudly; explicit ``timeline=None``
+    fingerprints identically to the knob absent).
     """
     from ..resilience import inject
     from ..resilience.policy import (RETRYABLE, fallback_kwargs,
@@ -814,6 +846,12 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
         solve_kw["buckets"] = normalize_buckets(solve_kw["buckets"])
         if solve_kw["buckets"] is None:
             del solve_kw["buckets"]
+    if "timeline" in solve_kw and solve_kw["timeline"] is None:
+        # explicit timeline=None fingerprints identically to the knob
+        # absent (the buckets=None convention) — pre-timeline checkpoint
+        # dirs stay resumable; a NON-None ring joins the fingerprint
+        # because it changes the chunk stats schema
+        del solve_kw["timeline"]
     rec = recorder if recorder is not None else Recorder()
     if chunk_log is not None:
         # the writer thread emits its completion line concurrently with
@@ -850,6 +888,22 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
               "t0": float(t0), "t1": float(t1),
               "fingerprint": _sweep_fingerprint(rhs, y0s, cfgs, solve_kw)}
     ledger = _Ledger(ckpt_dir, pinned, ensure_manifest(ckpt_dir, pinned))
+    # live telemetry plane (obs/live.py, rides solve_kw into the
+    # segmented driver too): chunk progress + retry-ledger state publish
+    # as "checkpoint"-source gauges, fingerprint-exempt like the gear
+    # knobs
+    live = solve_kw.get("live")
+    n_chunks_total = -(-int(B) // int(chunk_size))
+    chunks_done = [0]
+
+    def _publish_chunks():
+        if live is None:
+            return
+        live.publish("checkpoint", gauges={
+            "chunks_done": chunks_done[0],
+            "chunks_total": n_chunks_total,
+            "chunk_retry_attempts": sum(
+                len(v) for v in ledger.attempts.values())})
 
     oracle_fn = oracle
     if (oracle_fn is None and qpol is not None and qpol.oracle
@@ -896,6 +950,12 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
                               f"FAILED ({type(e).__name__}); "
                               f"{'giving up' if last else 'retrying'}")
                 if last:
+                    # retry exhaustion is a postmortem moment: dump the
+                    # armed flight ring (no-op unarmed — obs/live.py)
+                    # before the fault propagates
+                    flight_note_counters(rec)
+                    flight_dump(f"chunk {i} retry exhausted: "
+                                f"{type(e).__name__}")
                     raise
                 rec.counter("chunk_retries")
                 if isinstance(e, WedgeError):
@@ -1031,6 +1091,8 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
                             f"max {att.max()}")
                     _save_async(i, path, res, chunk_cfgs)
                 parts.append(res)
+                chunks_done[0] += 1
+                _publish_chunks()
         # durability barrier: a failed/unfinished save must fail the sweep
         # call, not surface later as a missing chunk on resume
         while pending:

@@ -186,7 +186,8 @@ def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
                                poll_s=0.25, timeout_s=600.0,
                                retry=None, quarantine=None, oracle=None,
                                chunk_budget_s=None,
-                               recorder=None, chunk_log=None, **solve_kw):
+                               recorder=None, chunk_log=None, live=None,
+                               **solve_kw):
     """Wedge-resilient multi-process checkpointed sweep (module section
     doc): every process runs this with the same arguments and its own
     ``process_id``; chunks are initially partitioned round-robin, each
@@ -230,6 +231,17 @@ def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
     (results stay correct — artifacts are identical and saves atomic —
     but the work partitioning is defeated).
 
+    ``live=`` (an ``obs.LiveRegistry``; auto-derived from ``recorder``
+    when omitted) turns on the fleet telemetry plane: this process
+    drops periodic metric snapshots beside its heartbeat
+    (``hosts/p<id>.metrics.json`` — ``obs.live.write_fleet_snapshot``),
+    the registry's ``fleet_dir`` is pointed at ``ckpt_dir`` so its
+    ``/metrics`` serves the merged per-host fleet view, and — with
+    ``segment_steps`` in ``solve_kw`` — the per-chunk sweep driver
+    publishes its in-flight occupancy into the same registry.  View
+    without a server via ``scripts/obs_fleet.py``
+    (docs/observability.md "Fleet view").
+
     Returns the full concatenated SolveResult (loaded from the chunk
     artifacts, so every surviving process returns the same values).
     Raises after ``timeout_s`` without progress — own, or observed peer
@@ -264,6 +276,10 @@ def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
                 f"segment_steps > 0 or drop the arguments")
     if dead_after_s is None:
         dead_after_s = 6.0 * float(heartbeat_s)
+    if "timeline" in solve_kw and solve_kw["timeline"] is None:
+        # checkpointed_sweep's convention: explicit timeline=None
+        # fingerprints identically to the knob absent
+        del solve_kw["timeline"]
     retry = normalize_retry(retry)
     qpol = normalize_quarantine(quarantine)
     budget = _ChunkBudget(resolve_chunk_budget(chunk_budget_s))
@@ -277,6 +293,43 @@ def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
     ensure_manifest(ckpt_dir, pinned)
     hb = _Heartbeat(_heartbeat_path(ckpt_dir, process_id), heartbeat_s)
     hb.start()
+
+    # fleet telemetry (obs/live.py — docs/observability.md "Fleet
+    # view"): each process drops periodic metric snapshots BESIDE its
+    # heartbeat, so any process's /metrics (live.fleet_dir) and
+    # scripts/obs_fleet.py can serve the merged per-host view.  With no
+    # live registry given, one is derived from the recorder (snapshots
+    # only — no endpoint); with neither, the fleet plane stays off.
+    from ..obs.live import LiveRegistry, write_fleet_snapshot
+
+    reg = live
+    if reg is None and recorder is not None:
+        reg = LiveRegistry(recorder=recorder,
+                           meta={"process_id": int(process_id)})
+    if reg is not None and reg.fleet_dir is None:
+        reg.fleet_dir = ckpt_dir
+    if reg is not None and int(solve_kw.get("segment_steps", 0) or 0) > 0:
+        # the per-chunk segmented driver then publishes its own
+        # "sweep"-source occupancy gauges into the same registry, so
+        # fleet snapshots carry mid-chunk state too (fingerprint-exempt
+        # observer gear, parallel/checkpoint.py)
+        solve_kw.setdefault("live", reg)
+    _snap_last = [0.0]
+
+    def drop_snapshot(force=False, **gauges):
+        if reg is None:
+            return
+        now = time.time()
+        if not force and now - _snap_last[0] < max(float(heartbeat_s),
+                                                   0.25):
+            return
+        _snap_last[0] = now
+        if gauges:
+            reg.publish("elastic", gauges=gauges)
+        try:
+            write_fleet_snapshot(ckpt_dir, process_id, reg)
+        except OSError:
+            pass   # a missed snapshot reads as stale, never fatal
 
     def chunk_path(i):
         return os.path.join(ckpt_dir, f"chunk_{i:05d}.npz")
@@ -397,6 +450,8 @@ def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
         if chunk_log is not None:
             chunk_log(f"[elastic] p{process_id} chunk {i} "
                       f"({hi - lo} lanes) solved+saved in {wall:.2f}s")
+        drop_snapshot(force=True, last_chunk=int(i),
+                      chunks_total=int(n_chunks))
 
     def owner_dead(cl, live):
         """A claim owner is dead when its heartbeat (or, if it never
@@ -435,6 +490,8 @@ def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
         while True:
             missing = [i for i in range(n_chunks)
                        if not os.path.exists(chunk_path(i))]
+            drop_snapshot(chunks_missing=len(missing),
+                          chunks_total=int(n_chunks))
             if not missing:
                 break
             if prev_missing is not None and len(missing) < prev_missing:
@@ -491,5 +548,6 @@ def elastic_checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *,
                 solve_and_save(i)
                 parts.append(load_result(chunk_path(i))[0])
     finally:
+        drop_snapshot(force=True)
         hb.stop()
     return _concat_results(parts)

@@ -247,7 +247,7 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
                    observer=None, observer_init=None, jac_window=1,
                    newton_tol=0.03, method="bdf", freeze_precond=False,
                    setup_economy=False, stale_tol=0.3, stats=False,
-                   buckets=None):
+                   buckets=None, timeline=None):
     """Solve a batch of reactor conditions in one XLA program.
 
     ``y0s``: (B, S) initial states; ``cfgs``: dict pytree with (B,)-leading
@@ -287,6 +287,9 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     if setup_economy and method != "bdf":
         raise ValueError(
             f"setup_economy is a bdf-only knob; method={method!r}")
+    from ..obs.timeline import validate as _tl_validate
+
+    timeline = _tl_validate(timeline, stats)
     y0s = jnp.asarray(y0s)
     B_live = y0s.shape[0]
     bucket = resolve_bucket(
@@ -304,7 +307,7 @@ def ensemble_solve(rhs, y0s, t0, t1, cfgs, *, mesh=None, axis="batch",
     jitted = _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0,
                             dt_min_factor, linsolve, jac, observer,
                             jac_window, newton_tol, method, freeze_precond,
-                            setup_economy, stale_tol, stats)
+                            setup_economy, stale_tol, stats, timeline)
     t0 = jnp.asarray(t0, dtype=y0s.dtype)
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     obs0 = observer_init if observer is not None else 0.0
@@ -336,7 +339,8 @@ def _check_method(method, newton_tol):
 def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
                    linsolve, jac=None, observer=None, jac_window=1,
                    newton_tol=0.03, method="bdf", freeze_precond=False,
-                   setup_economy=False, stale_tol=0.3, stats=False):
+                   setup_economy=False, stale_tol=0.3, stats=False,
+                   timeline=None):
     """One compiled batched solve per (rhs, solver-settings) combination.
 
     Re-jitting a fresh closure every ``ensemble_solve`` call would recompile
@@ -353,6 +357,8 @@ def _cached_vsolve(rhs, rtol, atol, max_steps, n_save, dt0, dt_min_factor,
                     "freeze_precond": freeze_precond,
                     "setup_economy": setup_economy,
                     "stale_tol": stale_tol})
+        if timeline is not None:
+            kw["timeline"] = timeline
         return _SOLVERS[method](
             rhs, y0, t0, t1, cfg, rtol=rtol, atol=atol, max_steps=max_steps,
             n_save=n_save, dt0=dt0, dt_min_factor=dt_min_factor,
@@ -447,7 +453,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              stats=False, recorder=None, watch=None,
                              pipeline=None, poll_every=None, buckets=None,
                              fetch_deadline=None, admission=None,
-                             refill=None, _on_harvest=None):
+                             refill=None, timeline=None, live=None,
+                             _on_harvest=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -584,10 +591,40 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     ``poll_every``.  Counters: ``compactions``, ``admitted_lanes``,
     ``bucket_downshifts``, and the occupancy pair ``lane_attempts`` /
     ``lane_capacity`` (docs/observability.md).
+
+    ``timeline=N`` (requires ``stats=True`` and the pipelined gear;
+    semantics ``obs/timeline.py``) records each lane's last N attempt
+    records ``(t, h, code)`` into a ring riding the control block's
+    stats — resumed across segment relaunches via the solver's
+    ``timeline_state`` carry (global-attempt slot keying, so the
+    segmented ring is bit-identical to the monolithic one at
+    ``jac_window=1``), harvested and un-shuffled under admission like
+    every per-lane stats leaf, and byte-identity-neutral when off
+    (brlint tier-B ``timeline-noop-fork``).
+
+    ``live=`` (an ``obs.LiveRegistry`` — docs/observability.md "Live
+    metrics") receives an in-flight publish at every poll boundary,
+    built from the data the poll already fetched: the running
+    occupancy counter pair plus segment/lanes-done gauges (the
+    streaming driver adds backlog depth, harvested/admitted lanes, and
+    the resident bucket).  Purely host-side; cleared on return after
+    the final totals land on the recorder.
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
     pipeline, poll_every = resolve_pipeline_defaults(pipeline, poll_every)
+    # ONE validation rule for the timeline knob (obs/timeline.py); the
+    # ring rides the pipelined control block — the blocking gear has no
+    # carried stats input to resume a ring through, so it raises loudly
+    # instead of returning per-segment fragments
+    from ..obs.timeline import validate as _tl_validate
+
+    timeline = _tl_validate(timeline, stats)
+    if timeline is not None and not pipeline:
+        raise ValueError(
+            "timeline= needs the pipelined gear (the ring resumes "
+            "through the device-resident control block); drop "
+            "pipeline=False or the timeline knob")
     from ..resilience.watchdog import resolve_fetch_deadline
 
     fetch_deadline = resolve_fetch_deadline(fetch_deadline)
@@ -646,8 +683,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 newton_tol=newton_tol, method=method,
                 setup_economy=setup_economy, stale_tol=float(stale_tol),
                 stats=stats, recorder=recorder, watch=watch,
-                progress=progress, fetch_kw=fkw,
-                on_harvest=_on_harvest)
+                progress=progress, fetch_kw=fkw, timeline=timeline,
+                live=live, on_harvest=_on_harvest)
     B_live = y0s.shape[0]
     bucket = resolve_bucket(
         B_live, buckets,
@@ -677,7 +714,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     t1 = jnp.asarray(t1, dtype=y0s.dtype)
     carry = _init_segment_carry(y0s, t0, method, observer, observer_init,
                                 stats, n_save, economy=economy,
-                                linsolve=linsolve)
+                                linsolve=linsolve, timeline=timeline)
     if mesh is not None:
         spec = NamedSharding(mesh, P(axis))
         carry = jax.tree.map(lambda x: jax.device_put(x, spec), carry)
@@ -707,7 +744,8 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 newton_tol=newton_tol, method=method,
                 setup_economy=setup_economy, stale_tol=float(stale_tol),
                 stats=stats, recorder=recorder, watch=watch,
-                progress=progress, fetch_kw=fkw, n_live_lanes=B_live),
+                progress=progress, fetch_kw=fkw, n_live_lanes=B_live,
+                timeline=timeline, live=live),
                 B_live)
 
     jitted = _cached_vsolve_segmented(rhs, rtol, atol, segment_steps,
@@ -846,12 +884,15 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
 def _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
                       linsolve, jac, observer, n_save, bundle_mode,
                       jac_window, newton_tol, method, stats,
-                      setup_economy=False, stale_tol=0.3):
+                      setup_economy=False, stale_tol=0.3, timeline=None):
     """Per-lane segment solve shared by the blocking and pipelined traced
     programs — keeping it single-sourced is what makes the two drivers'
-    step sequences identical by construction."""
+    step sequences identical by construction.  With ``timeline`` the
+    per-lane solve takes one extra operand: the carried ring +
+    global-attempt base (``timeline_state``), so the slot arithmetic
+    keys on total attempts across segment relaunches."""
 
-    def one(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate):
+    def _solve(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate, extra):
         if bundle_mode:
             rhs_fn, jac_fn = rhs(bundle)
         else:
@@ -861,12 +902,22 @@ def _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
               else {"solver_state": sstate, "jac_window": jac_window,
                     "setup_economy": setup_economy,
                     "stale_tol": stale_tol})
+        kw.update(extra)
         return _SOLVERS[method](
             rhs_fn, y0, t0, t1, cfg, rtol=rtol, atol=atol,
             max_steps=segment_steps, n_save=n_save, dt0=h0, err0=e0,
             dt_min_factor=dt_min_factor, linsolve=linsolve, jac=jac_fn,
             observer=observer, stats=stats,
             observer_init=obs0 if observer is not None else None, **kw)
+
+    if timeline is None:
+        def one(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate):
+            return _solve(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate,
+                          {})
+    else:
+        def one(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate, tl):
+            return _solve(bundle, y0, t0, t1, cfg, h0, e0, obs0, sstate,
+                          {"timeline": timeline, "timeline_state": tl})
 
     return one
 
@@ -905,7 +956,8 @@ def _madd(acc, seg, live):
 
 
 def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
-                        n_save, economy=False, linsolve="lu"):
+                        n_save, economy=False, linsolve="lu",
+                        timeline=None):
     """Initial per-segment carry shared by both segmented drivers:
     ``(y, t, h, e, obs, sstate, ctrl)``.  ``ctrl`` is the pipelined
     driver's device-resident control block — the park/budget/accumulate
@@ -975,6 +1027,15 @@ def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
             # solver's stats block always carries them under bdf
             st["setup_reuses"] = jnp.zeros((B,), dtype=jnp.int32)
             st["precond_age"] = jnp.zeros((B,), dtype=jnp.int32)
+        if timeline is not None:
+            # the per-lane attempt-record ring (obs/timeline.py): cold
+            # slots are zeros (code 0 = empty); rides ctrl["stats"] so
+            # harvest/un-shuffle/accumulation cover it like any other
+            # per-lane stats leaf
+            st["timeline_t"] = jnp.zeros((B, timeline), dtype=y0s.dtype)
+            st["timeline_h"] = jnp.zeros((B, timeline), dtype=y0s.dtype)
+            st["timeline_code"] = jnp.zeros((B, timeline),
+                                            dtype=jnp.int8)
         ctrl["stats"] = st
     return (y0s, t, h, e, obs, sstate, ctrl)
 
@@ -982,7 +1043,8 @@ def _init_segment_carry(y0s, t0, method, observer, observer_init, stats,
 def _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor, linsolve,
                 jac, observer, seg_save, bundle_mode, jac_window,
                 newton_tol, method, stats, has_budget, n_save_total,
-                compact, setup_economy=False, stale_tol=0.3):
+                compact, setup_economy=False, stale_tol=0.3,
+                timeline=None):
     """The PIPELINED driver's traced segment program (un-jitted — brlint
     tier B audits it through here): one vmapped segment solve plus the
     device-resident control-block update that the blocking driver performs
@@ -1000,12 +1062,26 @@ def _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor, linsolve,
     one = _make_segment_one(rhs, rtol, atol, segment_steps, dt_min_factor,
                             linsolve, jac, observer, seg_save, bundle_mode,
                             jac_window, newton_tol, method, stats,
-                            setup_economy, stale_tol)
-    vsolve = jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0, 0, 0, 0))
+                            setup_economy, stale_tol, timeline)
+    axes = (None, 0, 0, None, 0, 0, 0, 0, 0)
+    vsolve = jax.vmap(one, in_axes=axes + ((0,) if timeline is not None
+                                           else ()))
 
     def seg(bundle, t1, cfgs, budget, carry):
         y, t, h, e, obs, sstate, ctrl = carry
-        res = vsolve(bundle, y, t, t1, cfgs, h, e, obs, sstate)
+        if timeline is not None:
+            # carried ring + global attempt base: the solver resumes the
+            # slot arithmetic where the previous segment stopped, so the
+            # segmented ring is bit-identical to the monolithic one
+            tl_state = {"t": ctrl["stats"]["timeline_t"],
+                        "h": ctrl["stats"]["timeline_h"],
+                        "code": ctrl["stats"]["timeline_code"],
+                        "base": (ctrl["n_acc"]
+                                 + ctrl["n_rej"]).astype(jnp.int32)}
+            res = vsolve(bundle, y, t, t1, cfgs, h, e, obs, sstate,
+                         tl_state)
+        else:
+            res = vsolve(bundle, y, t, t1, cfgs, h, e, obs, sstate)
         # ---- host bookkeeping, verbatim, on device ------------------------
         running = ctrl["final_status"] == int(sdirk.RUNNING)
         n_acc = ctrl["n_acc"] + jnp.where(
@@ -1030,13 +1106,19 @@ def _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor, linsolve,
             # device twin of obs.counters.accumulate: counters masked-add,
             # gauges (precond_age) take the running max — summing a
             # high-water mark across segments would report an age no
-            # factorization ever reached
-            ctrl2["stats"] = {
-                k: (jnp.maximum(ctrl["stats"][k],
-                                jnp.where(running, res.stats[k], 0))
-                    if k in obs_counters.GAUGE_KEYS
-                    else _madd(ctrl["stats"][k], res.stats[k], running))
-                for k in ctrl["stats"]}
+            # factorization ever reached — and timeline rings REPLACE
+            # (the solver was handed the carried ring and returned the
+            # updated whole; obs/counters.py TIMELINE_KEYS)
+            def _fold(k):
+                if k in obs_counters.GAUGE_KEYS:
+                    return jnp.maximum(ctrl["stats"][k],
+                                       jnp.where(running, res.stats[k], 0))
+                if k in obs_counters.TIMELINE_KEYS:
+                    m = running.reshape(running.shape + (1,))
+                    return jnp.where(m, res.stats[k], ctrl["stats"][k])
+                return _madd(ctrl["stats"][k], res.stats[k], running)
+
+            ctrl2["stats"] = {k: _fold(k) for k in ctrl["stats"]}
         if seg_save:
             saved = ctrl["saved"]
             take = jnp.where(
@@ -1092,7 +1174,7 @@ def _cached_vsolve_segmented_ctrl(rhs, rtol, atol, segment_steps,
                                   method="bdf", stats=False,
                                   has_budget=False, n_save_total=0,
                                   compact=True, setup_economy=False,
-                                  stale_tol=0.3):
+                                  stale_tol=0.3, timeline=None):
     """Compiled pipelined segment program.  The carry (argument 4 — y, h,
     e, observer fold, the (B, MAXORD+3, S) BDF history, control block) is
     DONATED: each relaunch aliases the previous segment's output buffers
@@ -1101,7 +1183,8 @@ def _cached_vsolve_segmented_ctrl(rhs, rtol, atol, segment_steps,
     fn = _segment_fn(rhs, rtol, atol, segment_steps, dt_min_factor,
                      linsolve, jac, observer, seg_save, bundle_mode,
                      jac_window, newton_tol, method, stats, has_budget,
-                     n_save_total, compact, setup_economy, stale_tol)
+                     n_save_total, compact, setup_economy, stale_tol,
+                     timeline)
     return jax.jit(fn, donate_argnums=(4,))
 
 
@@ -1244,18 +1327,46 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
                              bundle_mode, jac_window, newton_tol, method,
                              setup_economy, stale_tol, stats, recorder,
                              watch, progress, fetch_kw=None,
-                             n_live_lanes=None):
+                             n_live_lanes=None, timeline=None, live=None):
     """The pipelined gear of :func:`ensemble_solve_segmented` (module
     docstring): run-ahead dispatch with carry donation, device-resident
     termination/budget logic, strided polling, and the background
-    trajectory drain.  Bit-exact against the blocking gear."""
+    trajectory drain.  Bit-exact against the blocking gear.
+
+    ``live`` (an ``obs.LiveRegistry``) receives an in-flight publish at
+    every poll boundary — the data the host already fetched for
+    termination detection, repackaged, so the live plane costs no extra
+    device traffic: the running occupancy counter pair (the
+    ``br_sweep_occupancy`` scrape moves mid-sweep) and the
+    segment/lanes-done gauges."""
     fkw = fetch_kw or {}
     B = y0s.shape[0]
     jitted = _cached_vsolve_segmented_ctrl(
         rhs, rtol, atol, segment_steps, dt_min_factor, linsolve, jac,
         observer, seg_save, bundle_mode, jac_window, newton_tol, method,
         stats, max_attempts is not None, int(n_save) if n_save else 0,
-        compact, setup_economy, stale_tol)
+        compact, setup_economy, stale_tol, timeline)
+    nl_live = int(B if n_live_lanes is None else n_live_lanes)
+
+    def _publish_live(seg, status_np, acc_np, rej_np, launched):
+        """Fold the poll's already-fetched state into the live registry
+        (no extra fetch beyond the poll's own vectors; obs/live.py).
+        Counters are the in-flight occupancy pair DELTA for this sweep
+        — accepted + rejected, the same definition the final recorder
+        fold uses, so the gauge never jumps at completion; cleared on
+        return after the recorder gets the final totals."""
+        if live is None:
+            return
+        lanes_done = int((status_np != int(sdirk.RUNNING)).sum())
+        live.publish(
+            "sweep",
+            counters={"lane_attempts": int(acc_np[:nl_live].sum()
+                                           + rej_np[:nl_live].sum()),
+                      "lane_capacity": (int(launched) * int(B)
+                                        * int(segment_steps))},
+            gauges={"segment": int(seg), "lanes_done": lanes_done,
+                    "lanes_total": int(B),
+                    "lanes_running": int(B) - lanes_done})
     budget = jnp.asarray(int(max_attempts) if max_attempts is not None
                          else 0, dtype=jnp.int64)
     # the first relaunch DONATES the carry: the y slot must not alias the
@@ -1310,13 +1421,24 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
             if launched % poll_every == 0 or launched == max_segments:
                 ctrl = carry[6]
                 with span_or_null(recorder, "poll", upto=seg) as sp:
-                    status_np, acc_np = _host_fetch(
-                        (ctrl["final_status"], ctrl["n_acc"]), recorder,
-                        **fkw)
+                    # n_rej rides the same single fetch ONLY when a
+                    # live registry consumes it (true attempt count for
+                    # the occupancy publish) — live=None polls move
+                    # exactly the pre-live bytes
+                    if live is not None:
+                        status_np, acc_np, rej_np = _host_fetch(
+                            (ctrl["final_status"], ctrl["n_acc"],
+                             ctrl["n_rej"]), recorder, **fkw)
+                    else:
+                        status_np, acc_np = _host_fetch(
+                            (ctrl["final_status"], ctrl["n_acc"]),
+                            recorder, **fkw)
+                        rej_np = None
                 if recorder is not None and sp["dur"] is not None:
                     # device-ahead attribution: poll wall-clock is the
                     # only time the pipelined host waits on the device
                     recorder.counter("poll_wait_s", sp["dur"])
+                _publish_live(seg, status_np, acc_np, rej_np, launched)
                 flush_progress(status_np, acc_np, launched)
                 if not bool(np.any(status_np == int(sdirk.RUNNING))):
                     done = True
@@ -1349,11 +1471,14 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
         # denominator keeps the padded B the device actually runs.
         # Additive across sweeps/chunks; consumers derive occupancy =
         # lane_attempts / lane_capacity.
-        nl = int(B if n_live_lanes is None else n_live_lanes)
         recorder.counter("lane_attempts",
-                         int(na[:nl].sum() + nr[:nl].sum()))
+                         int(na[:nl_live].sum() + nr[:nl_live].sum()))
         recorder.counter("lane_capacity",
                          int(launched) * int(B) * int(segment_steps))
+    if live is not None:
+        # final totals just landed on the recorder: drop the in-flight
+        # overlay so the next scrape doesn't double-count this sweep
+        live.clear("sweep")
 
     if n_save:
         ts_out = jnp.asarray(drainer.all_ts, dtype=y0s.dtype)
@@ -1420,7 +1545,7 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
                              dt_min_factor, bundle_mode, jac_window,
                              newton_tol, method, setup_economy, stale_tol,
                              stats, recorder, watch, progress, fetch_kw,
-                             on_harvest=None):
+                             timeline=None, live=None, on_harvest=None):
     """Continuous batching: one resident B-lane segment program streams
     through an N-lane backlog (``ensemble_solve_segmented`` docstring,
     ``admission=``).  The loop structure is the pipelined driver's —
@@ -1468,7 +1593,8 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
     jitted = _cached_vsolve_segmented_ctrl(
         rhs, rtol, atol, segment_steps, dt_min_factor, linsolve, jac,
         observer, 0, bundle_mode, jac_window, newton_tol, method, stats,
-        max_attempts is not None, 0, True, setup_economy, stale_tol)
+        max_attempts is not None, 0, True, setup_economy, stale_tol,
+        timeline)
     budget = jnp.asarray(int(max_attempts) if max_attempts is not None
                          else 0, dtype=jnp.int64)
 
@@ -1490,14 +1616,15 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
     next_gid = n_seed
     carry = _init_segment_carry(y0_blk, t0, method, observer,
                                 observer_init, stats, 0, economy=economy,
-                                linsolve=linsolve)
+                                linsolve=linsolve, timeline=timeline)
     cfgs_res = cfg_blk
     # cold per-slot template for admissions (the y slot is replaced by
     # the admitted rows inside the traced program); NOT donated — reused
     # by every compaction
     fresh = _init_segment_carry(jnp.zeros((B,) + tail, dtype=dtype), t0,
                                 method, observer, observer_init, stats, 0,
-                                economy=economy, linsolve=linsolve)
+                                economy=economy, linsolve=linsolve,
+                                timeline=timeline)
 
     # N-lane output accumulators, caller order (the un-shuffle target)
     out_t = np.full((N,), np.nan)
@@ -1509,7 +1636,10 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
     out_stats = None
     if stats:
         st0 = carry[6]["stats"]
-        out_stats = {k: np.zeros((N,) + tuple(v.shape[1:]), dtype=np.int32)
+        # per-key dtype (not a blanket int32): the timeline ring carries
+        # float t/h and int8 codes next to the int32 counters
+        out_stats = {k: np.zeros((N,) + tuple(v.shape[1:]),
+                                 dtype=np.dtype(v.dtype))
                      for k, v in st0.items()}
     out_obs = None
     if observer is not None:
@@ -1661,6 +1791,32 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
                                         + acc_np[live_rows].sum()),
                   "admitted_total": n_seed + admitted_total})
 
+    def _publish_live(seg, status_np, acc_np, rej_np):
+        """In-flight publish at the poll boundary (obs/live.py): the
+        streaming queue's own state — backlog depth, harvested/admitted
+        lanes, resident bucket — plus the running occupancy pair
+        (accepted + rejected, the final fold's definition), all from
+        data the poll already fetched."""
+        if live is None:
+            return
+        live_rows = slot_gid >= 0
+        lanes_done = harvested + int(((status_np != RUN)
+                                      & live_rows).sum())
+        live.publish(
+            "sweep",
+            counters={"lane_attempts": int(out_acc.sum() + out_rej.sum()
+                                           + acc_np[live_rows].sum()
+                                           + rej_np[live_rows].sum()),
+                      "lane_capacity": (int(capacity_lane_segs)
+                                        * int(segment_steps))},
+            gauges={"segment": int(seg), "lanes_done": lanes_done,
+                    "lanes_total": int(N),
+                    "lanes_running": int(N) - lanes_done,
+                    "backlog_depth": int(N - next_gid),
+                    "harvested_lanes": int(harvested),
+                    "admitted_lanes": int(n_seed + admitted_total),
+                    "resident_bucket": int(B)})
+
     done = False
     for seg in range(max_segments):
         region = (watch.region("sweep-segment", single_program=True,
@@ -1674,14 +1830,26 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
             continue
         ctrl = carry[6]
         with span_or_null(recorder, "poll", upto=seg) as sp:
-            status_np, acc_np = _host_fetch(
-                (ctrl["final_status"], ctrl["n_acc"]), recorder, **fkw)
+            # n_rej rides the same single fetch ONLY when a live
+            # registry consumes it — live=None polls move exactly the
+            # pre-live bytes
+            if live is not None:
+                status_np, acc_np, rej_np = _host_fetch(
+                    (ctrl["final_status"], ctrl["n_acc"],
+                     ctrl["n_rej"]), recorder, **fkw)
+                rej_np = np.asarray(rej_np)
+            else:
+                status_np, acc_np = _host_fetch(
+                    (ctrl["final_status"], ctrl["n_acc"]), recorder,
+                    **fkw)
+                rej_np = None
         if recorder is not None and sp["dur"] is not None:
             recorder.counter("poll_wait_s", sp["dur"])
         status_np = np.asarray(status_np)
         acc_np = np.asarray(acc_np)
         # emit BEFORE harvest/compaction: the payload reads slot_gid,
         # which the compaction permutes out from under status_np
+        _publish_live(seg, status_np, acc_np, rej_np)
         _progress(seg, status_np, acc_np)
         running = status_np == RUN
         n_parked = int(B - running.sum())
@@ -1732,6 +1900,10 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
                                               + out_rej.sum()))
         recorder.counter("lane_capacity",
                          int(capacity_lane_segs) * int(segment_steps))
+    if live is not None:
+        # final totals just landed on the recorder: drop the in-flight
+        # overlay so the next scrape doesn't double-count this sweep
+        live.clear("sweep")
     return sdirk.SolveResult(
         t=jnp.asarray(out_t, dtype=dtype), y=jnp.asarray(out_y),
         status=jnp.asarray(out_status),

@@ -143,11 +143,19 @@ def _guarded_wait(x, deadline_s, wait, recorder, label):
         devices = _devices_of(x)
         for d in devices:
             mark_suspect(d)
+        # flight recorder (obs/live.py; no-op unarmed): snapshot the
+        # counters BEFORE the fault event lands, so the dumped ring's
+        # tail reads "last known state, then the fault" — the
+        # postmortem ordering docs/observability.md promises
+        from ..obs.live import flight_dump, flight_note_counters
+
+        flight_note_counters(recorder)
         if recorder is not None:
             recorder.counter("fetch_timeouts")
             recorder.event("fault", kind="hung_fetch", label=label,
                            deadline_s=float(deadline_s),
                            elapsed_s=round(elapsed, 3), devices=devices)
+        flight_dump(f"hung_fetch [{label}] after {deadline_s:g}s")
         raise WedgeError(
             f"blocking device wait [{label}] exceeded its "
             f"{deadline_s:g} s deadline ({elapsed:.1f} s elapsed); "

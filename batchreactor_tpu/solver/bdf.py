@@ -134,6 +134,8 @@ def solve(
     sens_errcon=False,
     step_audit=False,
     stats=False,
+    timeline=None,
+    timeline_state=None,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` with BDF(1..5).
 
@@ -242,6 +244,21 @@ def solve(
     vmap-batched per lane).  Counters are masked adds on values the loop
     already computes: no host callbacks, no extra transfers, and with
     ``stats=False`` (default) the traced step program is unchanged.
+
+    ``timeline=N`` (requires ``stats=True``; semantics and host-side
+    decoding: ``obs/timeline.py``) additionally records, for each of
+    the last N step attempts, ``(t, h, code)`` — the attempted time and
+    step size plus a signed int8 packing outcome and cause (order taken
+    on accept, -1 error reject, -2 convergence reject) — into a
+    per-lane ring under ``stats["timeline_t"/"timeline_h"/
+    "timeline_code"]``, the generalization of the 64-slot
+    ``step_audit`` accept ring.  Slots key on the GLOBAL attempt index
+    mod N: ``timeline_state`` (a ``{"t", "h", "code", "base"}`` dict a
+    previous segment's ring + accumulated attempt count) resumes the
+    ring across bounded launches, so a segmented sweep's ring is
+    bit-identical to the monolithic one at ``jac_window=1``.  With
+    ``timeline=None`` (default) the traced program is byte-identical
+    to the knob not existing (brlint tier-B ``timeline-noop-fork``).
     """
     y0 = jnp.asarray(y0)
     n = y0.shape[0]
@@ -288,6 +305,13 @@ def solve(
             "carry — run forward-sensitivity solves monolithically")
     if sens_iters < 1:
         raise ValueError(f"sens_iters must be >= 1, got {sens_iters}")
+    # ONE validation rule for the timeline ring knob (obs/timeline.py)
+    from ..obs.timeline import validate as _tl_validate
+
+    timeline = _tl_validate(timeline, stats)
+    if timeline is None and timeline_state is not None:
+        raise ValueError("timeline_state resumes a timeline ring; pass "
+                         "timeline=N too or drop the state")
 
     f = functools.partial(rhs, cfg=cfg)
     if jac is None:
@@ -357,6 +381,25 @@ def solve(
         DS_init = jnp.zeros((_ROWS,) + S0.shape, dtype=y0.dtype)
         DS_init = DS_init.at[0].set(S0).at[1].set(h_init * fdot(t0, y0, S0))
 
+    if timeline is not None:
+        # cold ring: zeroed slots (code 0 = empty — obs/timeline.py);
+        # a carried-in state resumes both the ring and the GLOBAL
+        # attempt base its slot arithmetic keys on
+        if timeline_state is None:
+            tl_init = {"t": jnp.zeros((timeline,), dtype=y0.dtype),
+                       "h": jnp.zeros((timeline,), dtype=y0.dtype),
+                       "code": jnp.zeros((timeline,), dtype=jnp.int8)}
+            tl_base = jnp.asarray(0, dtype=jnp.int32)
+        else:
+            tl_init = {"t": jnp.asarray(timeline_state["t"],
+                                        dtype=y0.dtype),
+                       "h": jnp.asarray(timeline_state["h"],
+                                        dtype=y0.dtype),
+                       "code": jnp.asarray(timeline_state["code"],
+                                           dtype=jnp.int8)}
+            tl_base = jnp.asarray(timeline_state["base"],
+                                  dtype=jnp.int32)
+
     n_save_buf = max(n_save, 1)
     ts_buf = jnp.full((n_save_buf,), jnp.inf, dtype=y0.dtype)
     ys_buf = jnp.zeros((n_save_buf, n), dtype=y0.dtype)
@@ -423,6 +466,9 @@ def solve(
         if step_audit:
             ring, M_last = carry[k], carry[k + 1]
             k += 2
+        if timeline is not None:
+            tl = carry[k]
+            k += 1
         if stats:
             st = carry[k]
         running = status == RUNNING
@@ -634,6 +680,23 @@ def solve(
                 jnp.where(live, accept.astype(ring.dtype), ring[slot]))
             M_last2 = jnp.where(live, M, M_last)
             out = out + (ring2, M_last2)
+        if timeline is not None:
+            # full attempt record (obs/timeline.py): slot keys on the
+            # GLOBAL attempt index (tl_base carries prior segments'
+            # attempts), code packs outcome/cause — order taken on
+            # accept, -1 err reject, -2 conv reject
+            live_tl = running & ~already
+            tslot = (tl_base + n_acc + n_rej) % timeline
+            tcode = jnp.where(
+                accept, order.astype(jnp.int8),
+                jnp.where(conv, jnp.int8(-1), jnp.int8(-2)))
+            out = out + ({
+                "t": tl["t"].at[tslot].set(
+                    jnp.where(live_tl, t_new, tl["t"][tslot])),
+                "h": tl["h"].at[tslot].set(
+                    jnp.where(live_tl, h, tl["h"][tslot])),
+                "code": tl["code"].at[tslot].set(
+                    jnp.where(live_tl, tcode, tl["code"][tslot]))},)
         if stats:
             # masked adds on values this attempt already computed; the
             # `live` gate makes counters report algorithmic work per lane,
@@ -668,10 +731,12 @@ def solve(
     def cond(carry):
         return carry[5] == RUNNING
 
-    # carry index of the stats block (after the optional tangent history
-    # and step-audit pair) and of the setup-economy state (after stats)
-    k_stats = 12 + (1 if tangent is not None else 0) + (2 if step_audit
-                                                        else 0)
+    # carry index of the stats block (after the optional tangent history,
+    # step-audit pair, and timeline ring) and of the setup-economy state
+    # (after stats)
+    k_stats = (12 + (1 if tangent is not None else 0)
+               + (2 if step_audit else 0)
+               + (1 if timeline is not None else 0))
     k_econ = k_stats + (1 if stats else 0)
 
     def _count_window_open(carry):
@@ -837,6 +902,8 @@ def solve(
     if step_audit:
         init = init + (jnp.full((64,), -1, dtype=jnp.int8),
                        jnp.zeros((n, n), dtype=y0.dtype))
+    if timeline is not None:
+        init = init + (tl_init,)
     if stats:
         # setup_reuses/precond_age are present whether or not economy is
         # on (zero without it), so the counter-block schema is uniform
@@ -862,12 +929,21 @@ def solve(
     if step_audit:
         ring_out, M_out = final[k], final[k + 1]
         k += 2
+    tl_out = None
+    if timeline is not None:
+        tl_out = final[k]
+        k += 1
     stats_out = None
     if stats:
         # n_accepted/n_rejected repeated inside stats so an exported
         # counter block is self-contained (obs/counters.py)
         stats_out = {"n_accepted": n_acc, "n_rejected": n_rej, **final[k]}
         k += 1
+    if tl_out is not None:
+        # the ring lands under stats (the telemetry surface), TIMELINE_KEYS
+        stats_out["timeline_t"] = tl_out["t"]
+        stats_out["timeline_h"] = tl_out["h"]
+        stats_out["timeline_code"] = tl_out["code"]
     state_out = (D, order, h, n_equal)
     if economy:
         # the carried factorization joins the opaque resume carry so

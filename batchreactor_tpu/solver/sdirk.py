@@ -116,6 +116,8 @@ def solve(
     err0=None,
     jac_window=1,
     stats=False,
+    timeline=None,
+    timeline_state=None,
 ):
     """Adaptively integrate ``dy/dt = rhs(t, y, cfg)`` from t0 to t1.
 
@@ -174,6 +176,13 @@ def solve(
     Counters are masked adds on values the loop already computes: no host
     callbacks, no extra device transfers, and with ``stats=False``
     (default) the traced step program is unchanged.
+
+    ``timeline=N`` (requires ``stats=True``) records the last N attempt
+    records ``(t, h, code)`` into a per-lane ring under the stats dict —
+    same contract as ``bdf.solve`` (semantics: ``obs/timeline.py``; the
+    accept code is SDIRK4's fixed order 4) — with ``timeline_state``
+    resuming ring + global attempt base across segmented launches.
+    ``timeline=None`` (default) leaves the traced program byte-identical.
     """
     y0 = jnp.asarray(y0)
     n = y0.shape[0]
@@ -280,6 +289,28 @@ def solve(
         raise ValueError("observer and observer_init must be given together")
     obs0 = observer_init if observer is not None else jnp.zeros((),
                                                                 dtype=y0.dtype)
+    # ONE validation rule for the timeline ring knob (obs/timeline.py)
+    from ..obs.timeline import validate as _tl_validate
+
+    timeline = _tl_validate(timeline, stats)
+    if timeline is None and timeline_state is not None:
+        raise ValueError("timeline_state resumes a timeline ring; pass "
+                         "timeline=N too or drop the state")
+    if timeline is not None:
+        if timeline_state is None:
+            tl_init = {"t": jnp.zeros((timeline,), dtype=y0.dtype),
+                       "h": jnp.zeros((timeline,), dtype=y0.dtype),
+                       "code": jnp.zeros((timeline,), dtype=jnp.int8)}
+            tl_base = jnp.asarray(0, dtype=jnp.int32)
+        else:
+            tl_init = {"t": jnp.asarray(timeline_state["t"],
+                                        dtype=y0.dtype),
+                       "h": jnp.asarray(timeline_state["h"],
+                                        dtype=y0.dtype),
+                       "code": jnp.asarray(timeline_state["code"],
+                                           dtype=jnp.int8)}
+            tl_base = jnp.asarray(timeline_state["base"],
+                                  dtype=jnp.int32)
 
     def cond(carry):
         return carry[4] == RUNNING
@@ -347,11 +378,26 @@ def solve(
         status2 = jnp.where(running, status2, status)
         out = (t_new, y_out, h_next, err_prev_new, status2, n_acc2, n_rej2,
                ts2, ys2, n_saved2, obs)
+        if timeline is not None:
+            # attempt record ring (obs/timeline.py; bdf.solve has the
+            # slot-arithmetic contract): SDIRK's accept code is its
+            # fixed order 4
+            tl = carry[11]
+            tslot = (tl_base + n_acc + n_rej) % timeline
+            tcode = jnp.where(accept, jnp.int8(4),
+                              jnp.where(ok, jnp.int8(-1), jnp.int8(-2)))
+            out = out + ({
+                "t": tl["t"].at[tslot].set(
+                    jnp.where(running, t + h_eff, tl["t"][tslot])),
+                "h": tl["h"].at[tslot].set(
+                    jnp.where(running, h_eff, tl["h"][tslot])),
+                "code": tl["code"].at[tslot].set(
+                    jnp.where(running, tcode, tl["code"][tslot]))},)
         if stats:
             # masked adds on values the attempt already computed; the
             # `running` gate means counters report algorithmic work, not
             # the masked SIMD lanes an idling vmap sibling still executes
-            st = carry[11]
+            st = carry[11 + (1 if timeline is not None else 0)]
             rej = running & ~accept
             out = out + ({
                 "newton_iters": st["newton_iters"]
@@ -366,13 +412,16 @@ def solve(
             },)
         return out
 
+    # carry index of the stats block (after the optional timeline ring)
+    k_stats = 11 + (1 if timeline is not None else 0)
+
     def _count_jac(carry):
         # one J per body call (either window size); gate like step_once
-        st = carry[11]
+        st = carry[k_stats]
         live = carry[4] == RUNNING
         st = {**st, "jac_builds": st["jac_builds"]
               + live.astype(jnp.int32)}
-        return carry[:11] + (st,)
+        return carry[:k_stats] + (st,)
 
     if jac_window == 1:
         def body(carry):
@@ -403,6 +452,8 @@ def solve(
     init = (t0, y0, dt0, err_init,
             jnp.array(RUNNING, dtype=jnp.int32), zero, zero,
             ts_buf, ys_buf, zero, obs0)
+    if timeline is not None:
+        init = init + (tl_init,)
     if stats:
         init = init + ({"newton_iters": zero, "jac_builds": zero,
                         "factorizations": zero, "err_rejects": zero,
@@ -414,7 +465,14 @@ def solve(
     if stats:
         # n_accepted/n_rejected repeated inside stats so an exported
         # counter block is self-contained (obs/counters.py)
-        stats_out = {"n_accepted": n_acc, "n_rejected": n_rej, **final[11]}
+        stats_out = {"n_accepted": n_acc, "n_rejected": n_rej,
+                     **final[k_stats]}
+    if timeline is not None:
+        # the ring lands under stats (the telemetry surface), TIMELINE_KEYS
+        tl_out = final[11]
+        stats_out["timeline_t"] = tl_out["t"]
+        stats_out["timeline_h"] = tl_out["h"]
+        stats_out["timeline_code"] = tl_out["code"]
     return SolveResult(
         t=t, y=y, status=status, n_accepted=n_acc, n_rejected=n_rej,
         ts=ts, ys=ys, n_saved=n_saved, h=h,

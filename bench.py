@@ -210,6 +210,14 @@ def rung_main():
     # the grid streams through freed slots), BENCH_REFILL the queue
     # threshold.  The rung json records admission + the occupancy split
     # either way, so ragged-horizon rounds can cite uplift per rung.
+    # live metrics endpoint (bench.py --live-port / BENCH_LIVE_PORT —
+    # obs/live.py): serve /metrics + /healthz for the rung's duration so
+    # long rungs are watchable mid-flight; the rung json records the
+    # port so a with/without A/B pair bounds the endpoint overhead for
+    # the next PERF.md round (expect <1%, min-of-5 — the endpoint is a
+    # host-side thread publishing at existing poll boundaries)
+    live_env = os.environ.get("BENCH_LIVE_PORT", "")
+    live_port = int(live_env) if live_env else None
     ragged = os.environ.get("BENCH_RAGGED") == "1"
     adm_env = os.environ.get("BENCH_ADMISSION", "")
     if adm_env in ("", "0"):
@@ -261,6 +269,15 @@ def rung_main():
     obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
     seg_steps = int(os.environ.get("BENCH_SEG_STEPS", "256"))
 
+    from batchreactor_tpu.obs import LiveRegistry, MetricsServer
+
+    live_reg = live_srv = None
+    if live_port is not None:
+        live_reg = LiveRegistry(recorder=rec,
+                                meta={"entry": "bench", "B": B})
+        live_srv = MetricsServer(live_reg, port=live_port).start()
+        log(f"[rung B={B}] live metrics at {live_srv.url}/metrics")
+
     def sweep():
         rhos = jax.vmap(lambda T: density(jnp.asarray(x0), th.molwt, T, 1e5))(
             T_grid)
@@ -273,11 +290,12 @@ def rung_main():
             method=method, **solver_kw,
             observer=obs, observer_init=obs0,
             admission=admission, refill=refill,
-            stats=obs_on,
+            stats=obs_on, live=live_reg,
             # the recorder rides along whenever admission is on too: the
             # occupancy split (lane_attempts/lane_capacity) is recorded
             # there, and the rung json cites it
-            recorder=rec if (obs_on or admission is not None) else None,
+            recorder=rec if (obs_on or admission is not None
+                             or live_reg is not None) else None,
             watch=watch if obs_on else None,
             progress=lambda p: log(f"  segment {p['segment']}: "
                                    f"{p['lanes_done']}/{p['n_lanes']} lanes"))
@@ -342,8 +360,14 @@ def rung_main():
     linsolve_resolved = resolve_linsolve(
         os.environ.get("BENCH_LINSOLVE", "auto"), method=method,
         platform=jax.default_backend(), batch=B, n=len(sp))
+    bound_live_port = live_srv.port if live_srv is not None else None
+    if live_srv is not None:
+        live_srv.close()
     print(json.dumps({
         "B": B, "method": method, "wall_s": round(wall, 3),
+        # live metrics endpoint (null = off): the with/without pair at
+        # one B is the endpoint-overhead bound for the next PERF round
+        "live_port": bound_live_port,
         "cps": round(B / wall, 3),
         "pipeline": gear, "poll_every": stride,
         "linsolve": linsolve_resolved,
@@ -589,6 +613,13 @@ def parse_args(argv):
                    help=f"path for the per-rung progress artifact "
                         f"(default {os.path.basename(PARTIAL)} next to "
                         f"this file)")
+    p.add_argument("--live-port", type=int, metavar="N",
+                   help="serve the live /metrics + /healthz endpoint "
+                        "during each rung (obs/live.py; 0 = ephemeral "
+                        "port, logged per rung) so long rungs are "
+                        "watchable mid-flight; the rung json records "
+                        "live_port for the endpoint-overhead A/B "
+                        "(BENCH_LIVE_PORT is the env twin)")
     p.add_argument("--ragged", action="store_true",
                    help="ragged-horizon rung preset: widens the T window "
                         "to 1100-2000 K (a stratified spread of per-lane "
@@ -614,6 +645,10 @@ if __name__ == "__main__":
         args = parse_args(sys.argv[1:])
         if args.rungs:
             os.environ["BENCH_LADDER"] = args.rungs  # main() reads it
+        if args.live_port is not None:
+            # env twin so the rung CHILDREN (which re-exec this file
+            # with BENCH_MODE=rung and no argv) inherit the knob
+            os.environ["BENCH_LIVE_PORT"] = str(args.live_port)
         if args.ragged:
             # explicit T_LO so the parent's workload fingerprint and the
             # rung children agree on the measured window (the banked-rung

@@ -64,6 +64,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="fault_events.jsonl",
                     help="fault-event JSONL artifact path")
+    ap.add_argument("--scrape-out", default="fault_scrape.prom",
+                    help="where to save the live /metrics scrape taken "
+                         "WHILE the injected sweep runs (CI artifact)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="directory for flight_*.jsonl postmortem dumps "
+                         "(default: the --out directory)")
     args = ap.parse_args(argv)
 
     import jax
@@ -74,6 +80,8 @@ def main(argv=None):
     import numpy as np
 
     from batchreactor_tpu.obs import export, report
+    from batchreactor_tpu.obs.live import (LiveRegistry, MetricsServer,
+                                           arm_flight, disarm_flight)
     from batchreactor_tpu.obs.recorder import Recorder
     from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
     from batchreactor_tpu.resilience import inject
@@ -86,6 +94,13 @@ def main(argv=None):
     cfgs = {"k": jnp.logspace(1.0, 2.0, B)}
     rec = Recorder()   # one recorder across every faulted run: the
     #                    artifact aggregates all four recovery paths
+    # flight recorder armed for the whole smoke (docs/observability.md
+    # "Flight recorder"): the hung-fetch wedge below dumps a
+    # flight_*.jsonl postmortem, and the SIGTERM hook covers a
+    # supervised teardown (run_guarded sends SIGTERM first)
+    flight_dir = args.flight_dir or (os.path.dirname(
+        os.path.abspath(args.out)) or ".")
+    arm_flight(recorder=rec, dir=flight_dir, install_signal=True)
 
     def sweep(d, **kw):
         return checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs, d,
@@ -101,12 +116,59 @@ def main(argv=None):
     with tempfile.TemporaryDirectory() as base:
         clean = sweep(os.path.join(base, "clean"))
 
-        # 1 — hung fetch: watchdog breach -> WedgeError -> chunk retry
+        # 1 — hung fetch: watchdog breach -> WedgeError -> chunk retry,
+        # with the live /metrics endpoint up and scraped WHILE the
+        # injected sweep runs (the CI artifact next to the fault JSONL)
+        import threading
+        import urllib.request
+
         inject.arm("hang_fetch:delay=10")
-        res = sweep(os.path.join(base, "hang"), chunk_budget_s=0.3,
-                    retry={"max_retries": 2, "backoff_s": 0.0},
-                    recorder=rec)
+        registry = LiveRegistry(recorder=rec, meta={"smoke": "fault"})
+        scrapes = []
+        stop = threading.Event()
+        with MetricsServer(registry, port=0) as srv:
+            url = srv.url + "/metrics"
+
+            def scraper():
+                while not stop.is_set():
+                    try:
+                        scrapes.append(
+                            urllib.request.urlopen(url).read().decode())
+                    except OSError:
+                        pass
+                    stop.wait(0.05)
+
+            t = threading.Thread(target=scraper, daemon=True)
+            t.start()
+            try:
+                res = sweep(os.path.join(base, "hang"),
+                            chunk_budget_s=0.3,
+                            retry={"max_retries": 2, "backoff_s": 0.0},
+                            recorder=rec)
+            finally:
+                stop.set()
+                t.join()
         assert_bit_exact(clean, res, "hung fetch")
+        assert scrapes and any("br_" in s for s in scrapes), \
+            "no live scrape landed while the injected sweep ran"
+        # the LAST scrape carries the wedge evidence
+        # (br_fault_events_total{kind="hung_fetch"})
+        with open(args.scrape_out, "w") as fh:
+            fh.write(scrapes[-1])
+        print(f"[fault-smoke] {len(scrapes)} live scrapes during the "
+              f"wedged sweep -> {args.scrape_out}", file=sys.stderr)
+        import glob
+
+        flights = glob.glob(os.path.join(flight_dir, "flight_*.jsonl"))
+        assert flights, "hung-fetch wedge left no flight_*.jsonl dump"
+        tail = [json.loads(ln) for ln in
+                open(sorted(flights)[-1])][-8:]
+        assert any(r.get("kind") == "event" and r.get("name") == "fault"
+                   for r in tail), tail
+        assert any(r.get("kind") == "counter_snapshot" for r in tail), tail
+        print(f"[fault-smoke] flight recorder dumped "
+              f"{os.path.basename(sorted(flights)[-1])} (fault event + "
+              f"counter snapshot in the tail)", file=sys.stderr)
 
         # 2 — corrupt chunk: torn post-save, resume validates + re-solves
         inject.arm("corrupt_chunk:chunk=1")
@@ -154,6 +216,7 @@ def main(argv=None):
         for k, v in got["counters"].items():
             rec.counter(k, v)
 
+    disarm_flight()
     rep = report.build_report(recorder=rec,
                               meta={"smoke": "fault-injection",
                                     "faults": ["hang_fetch",

@@ -69,7 +69,30 @@ in `resilience.inject` (`BR_FAULT_INJECT`)."""),
     ("Observability", "batchreactor_tpu.obs",
      ["Recorder", "CompileWatch", "build_report", "render", "diff",
       "stats_totals", "to_jsonl", "from_jsonl", "to_prometheus",
-      "write_jsonl", "read_jsonl"]),
+      "write_jsonl", "read_jsonl", "LiveRegistry", "MetricsServer",
+      "resolve_live_metrics", "FlightRecorder", "arm_flight",
+      "flight_dump"]),
+    ("Live telemetry plane", "batchreactor_tpu.obs.live",
+     ["write_fleet_snapshot", "read_fleet_snapshots", "merge_fleet",
+      "fleet_prometheus"],
+     """\
+The in-flight half of the telemetry subsystem (docs/observability.md
+"Live metrics" / "Fleet view" / "Flight recorder"): `MetricsServer`
+serves `/metrics` + `/healthz` from a `LiveRegistry` the sweep drivers
+publish into at poll boundaries (`live=` / `live_metrics=` /
+`BR_METRICS_PORT`), elastic multihost processes drop per-host metric
+snapshots that merge into one fleet view (counters summed, gauges
+max-reduced; `scripts/obs_fleet.py`), and the armed `FlightRecorder`
+dumps `flight_<ts>.jsonl` postmortems on wedges, retry exhaustion, and
+SIGTERM."""),
+    ("Solver timelines", "batchreactor_tpu.obs.timeline",
+     ["validate", "decode", "render", "has_timeline"],
+     """\
+Per-lane rings of recent step-attempt records (`timeline=N` on the
+solvers and sweep entry points; docs/observability.md "Solver
+timelines"): `(t, h, code)` per attempt with the code packing outcome
+and cause — accepted order, error reject (-1), convergence reject (-2).
+Rendered by `scripts/obs_report.py --timeline`."""),
     ("Solvers", "batchreactor_tpu.solver.bdf", ["solve"]),
     ("Solvers (SDIRK)", "batchreactor_tpu.solver.sdirk", ["solve"]),
     # the intro (4th element) carries the mode table — docstring first

@@ -148,9 +148,22 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
     # knob is set — that pair is the A/B evidence the map-vs-rung gap
     # analysis needs (PERF.md)
     from batchreactor_tpu.obs import Recorder
+    from batchreactor_tpu.obs.live import arm_flight
 
     obs_rec = (Recorder() if (admission is not None or record_occupancy)
                else None)
+    # flight recorder armed for every northstar run (docs/observability
+    # .md "Flight recorder"): chip_session drives this script under
+    # resilience.run_guarded, whose teardown is SIGTERM-with-grace — the
+    # SIGTERM hook dumps flight_<ts>.jsonl next to the output, so the
+    # next on-chip wedge postmortem ships evidence instead of a bare
+    # SIGTERM note.  The watchdog/retry fault paths dump through the
+    # same ring.
+    arm_flight(recorder=obs_rec,
+               dir=os.path.dirname(os.environ.get(
+                   "NORTHSTAR_OUT", os.path.join(REPO, "NORTHSTAR.json")))
+               or ".",
+               install_signal=True)
     lane_cost = None
     if sort_lanes and ckpt_dir:
         # cost-sorted chunking only changes anything when the sweep is

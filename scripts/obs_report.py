@@ -51,6 +51,13 @@ def main(argv=None):
                     help="print the Prometheus text exposition instead")
     ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
                     help="diff two stored reports (baseline -> candidate)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="render the per-lane solver timelines instead "
+                         "(needs a report from a timeline=N run; "
+                         "docs/observability.md 'Solver timelines')")
+    ap.add_argument("--lanes",
+                    help="comma-separated lane indices for --timeline "
+                         "(default: the most-rejecting lanes)")
     args = ap.parse_args(argv)
 
     from batchreactor_tpu import obs
@@ -89,6 +96,10 @@ def main(argv=None):
         sys.stdout.write(obs.to_jsonl(report))
     elif args.prom:
         sys.stdout.write(obs.to_prometheus(report))
+    elif args.timeline:
+        lanes = ([int(x) for x in args.lanes.split(",")]
+                 if args.lanes else None)
+        print(obs.timeline.render(report, lanes=lanes))
     else:
         print(obs.render(report))
     return 0

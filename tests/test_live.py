@@ -1,0 +1,479 @@
+"""Live telemetry plane + solver timelines (obs/live.py, obs/timeline.py).
+
+Tier-1 coverage for the in-flight metrics endpoint (a real HTTP scrape
+mid-streaming-sweep, with `br_sweep_occupancy` moving between scrapes),
+the per-lane timeline ring (monolithic == segmented == admission
+un-shuffled, bit-exact), the flight recorder (dump replayed through the
+`BR_FAULT_INJECT` hung-fetch), fleet snapshot merging, and the
+missing-key→0 diff convention for the new counter keys.  Tiny linear
+ODEs throughout — the tier-1 budget discipline."""
+
+import glob
+import json
+import os
+import re
+import sys
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from batchreactor_tpu import obs  # noqa: E402
+from batchreactor_tpu.obs import counters as C  # noqa: E402
+from batchreactor_tpu.obs import live as L  # noqa: E402
+from batchreactor_tpu.obs import timeline as TL  # noqa: E402
+from batchreactor_tpu.parallel import sweep as S  # noqa: E402
+from batchreactor_tpu.solver import bdf, sdirk  # noqa: E402
+from batchreactor_tpu.solver.sdirk import SUCCESS  # noqa: E402
+
+
+def rhs(t, y, cfg):
+    return -cfg["k"] * y
+
+
+def _lanes(B, spread=2.0):
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (B, 2))
+    cfgs = {"k": jnp.logspace(1.0, 1.0 + spread, B)}
+    return y0s, cfgs
+
+
+# --------------------------------------------------------------------------
+# live registry + metrics endpoint
+# --------------------------------------------------------------------------
+def test_resolve_live_metrics_grammar(monkeypatch):
+    monkeypatch.delenv("BR_METRICS_PORT", raising=False)
+    assert L.resolve_live_metrics(None) is None
+    assert L.resolve_live_metrics(False) is None
+    assert L.resolve_live_metrics(True) == 0
+    assert L.resolve_live_metrics(9107) == 9107
+    monkeypatch.setenv("BR_METRICS_PORT", "9108")
+    assert L.resolve_live_metrics(None) == 9108
+    with pytest.raises(ValueError):
+        L.resolve_live_metrics(-1)
+    with pytest.raises(ValueError):
+        L.resolve_live_metrics(70000)
+
+
+def test_registry_overlay_and_healthz():
+    rec = obs.Recorder()
+    rec.counter("lane_attempts", 10)
+    reg = L.LiveRegistry(recorder=rec, meta={"entry": "test"})
+    reg.publish("sweep", counters={"lane_attempts": 5,
+                                   "lane_capacity": 100},
+                gauges={"backlog_depth": 7})
+    # overlay counters SUM onto recorder counters
+    assert reg.report()["counters"]["lane_attempts"] == 15
+    text = reg.prometheus()
+    assert "br_sweep_occupancy" in text        # 15/100 derivable
+    assert "br_sweep_backlog_depth 7" in text
+    hz = reg.healthz()
+    assert hz["ok"] and hz["gauges"]["backlog_depth"] == 7
+    # clearing the overlay drops the in-flight deltas
+    reg.clear("sweep")
+    assert reg.report()["counters"]["lane_attempts"] == 10
+    assert reg.gauges() == {}
+
+
+def test_metrics_endpoint_mid_streaming_sweep():
+    """The acceptance scrape: /healthz + /metrics polled from a thread
+    while a streaming (admission=) sweep runs, with br_sweep_occupancy
+    and the backlog depth observably changing between scrapes.  Scrapes
+    are driven from the progress callback (poll boundaries), so the
+    mid-flight timing is deterministic — the HTTP round-trip itself is
+    served by the endpoint's own thread."""
+    B = 8
+    y0s, cfgs = _lanes(B, spread=1.3)
+    rec = obs.Recorder()
+    reg = L.LiveRegistry(recorder=rec, meta={"entry": "test"})
+    scrapes, healths = [], []
+    with L.MetricsServer(reg, port=0) as srv:
+        url = srv.url
+
+        def progress(_payload):
+            scrapes.append(urllib.request.urlopen(
+                url + "/metrics", timeout=10).read().decode())
+            healths.append(json.loads(urllib.request.urlopen(
+                url + "/healthz", timeout=10).read()))
+
+        res = S.ensemble_solve_segmented(
+            rhs, y0s, 0.0, 1.0, cfgs, segment_steps=8, max_segments=400,
+            poll_every=1, stats=True, recorder=rec, live=reg,
+            admission=4, refill=1, progress=progress)
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    assert len(scrapes) >= 2
+    occ = [float(m.group(1)) for s in scrapes
+           for m in [re.search(r"^br_sweep_occupancy (\S+)$", s, re.M)]
+           if m]
+    assert len(set(occ)) >= 2, f"occupancy never moved: {occ}"
+    depth = [float(m.group(1)) for s in scrapes
+             for m in [re.search(r"^br_sweep_backlog_depth (\S+)$", s,
+                                 re.M)] if m]
+    assert len(set(depth)) >= 2, f"backlog depth never moved: {depth}"
+    assert all(h["ok"] for h in healths)
+    # scrapes are counted under the LIVE_KEYS convention
+    assert rec.snapshot()[2]["metrics_scrapes"] == len(scrapes)
+    # the overlay cleared on return: a post-sweep report carries only
+    # the recorder's final totals (no double count)
+    assert reg.gauges() == {}
+    assert (reg.report()["counters"]["lane_attempts"]
+            == rec.snapshot()[2]["lane_attempts"])
+
+
+def test_metrics_server_404():
+    reg = L.LiveRegistry()
+    with L.MetricsServer(reg, port=0) as srv:
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url + "/nope", timeout=10)
+
+
+# --------------------------------------------------------------------------
+# solver timelines
+# --------------------------------------------------------------------------
+def test_timeline_monolithic_decode_bdf():
+    y0 = jnp.asarray([1.0, 0.5])
+    cfg = {"k": jnp.asarray(30.0)}
+    r = bdf.solve(rhs, y0, 0.0, 1.0, cfg, rtol=1e-6, atol=1e-10,
+                  stats=True, timeline=16)
+    st = {k: np.asarray(v) for k, v in r.stats.items()}
+    att = int(r.n_accepted) + int(r.n_rejected)
+    recs = TL.decode(st)
+    assert len(recs) == min(att, 16)
+    # chronological, last attempt is the accepted step landing on t1
+    assert recs[-1]["code"] > 0
+    assert abs(recs[-1]["t"] - 1.0) < 1e-9
+    assert all(recs[i]["attempt"] < recs[i + 1]["attempt"]
+               for i in range(len(recs) - 1))
+    # accept codes are BDF orders 1..5; reject codes match the cause
+    # partition keys
+    for rec_ in recs:
+        assert rec_["code"] in (-2, -1, 1, 2, 3, 4, 5)
+
+
+def test_timeline_sdirk_codes():
+    y0 = jnp.asarray([1.0, 0.5])
+    cfg = {"k": jnp.asarray(30.0)}
+    r = sdirk.solve(rhs, y0, 0.0, 1.0, cfg, rtol=1e-6, atol=1e-10,
+                    stats=True, timeline=8)
+    recs = TL.decode({k: np.asarray(v) for k, v in r.stats.items()})
+    assert recs and all(rec_["code"] in (-2, -1, 4) for rec_ in recs)
+
+
+@pytest.mark.parametrize("method", ["bdf", "sdirk"])
+def test_timeline_segmented_bit_exact(method):
+    """Segmented pipelined ring == monolithic ring at jac_window=1 (the
+    timeline_state global-attempt slot keying)."""
+    B = 4
+    y0s, cfgs = _lanes(B)
+    kw = dict(rtol=1e-6, atol=1e-10, stats=True, timeline=32,
+              method=method)
+    mono = S.ensemble_solve(rhs, y0s, 0.0, 1.0, cfgs, **kw)
+    seg = S.ensemble_solve_segmented(rhs, y0s, 0.0, 1.0, cfgs,
+                                     segment_steps=8, max_segments=400,
+                                     poll_every=1, **kw)
+    for k in TL.TIMELINE_KEYS:
+        np.testing.assert_array_equal(np.asarray(mono.stats[k]),
+                                      np.asarray(seg.stats[k]),
+                                      err_msg=f"{method}:{k}")
+
+
+def test_timeline_admission_unshuffle_bit_exact():
+    """The acceptance matrix: under admission= (slot permutation +
+    refill) AND bucket padding, the harvested rings land back in caller
+    lane order bit-exactly equal to the monolithic run's.  The
+    single-rung ladder pads the resident block with dead copy-lanes but
+    never down-shifts, so the bit-exact contract holds (the pow2
+    down-shift tail is covered at tolerance level below — the
+    documented bucket-shape ulp sensitivity, parallel/sweep.py)."""
+    B = 5          # ragged vs the 4-lane rung: exercises bucket padding
+    y0s, cfgs = _lanes(B, spread=1.5)
+    kw = dict(rtol=1e-6, atol=1e-10, stats=True, timeline=24)
+    mono = S.ensemble_solve(rhs, y0s, 0.0, 1.0, cfgs, **kw)
+    adm = S.ensemble_solve_segmented(
+        rhs, y0s, 0.0, 1.0, cfgs, segment_steps=8, max_segments=600,
+        poll_every=1, admission=2, refill=1, buckets=(4,), **kw)
+    assert np.all(np.asarray(adm.status) == SUCCESS)
+    for k in TL.TIMELINE_KEYS:
+        np.testing.assert_array_equal(np.asarray(mono.stats[k]),
+                                      np.asarray(adm.stats[k]),
+                                      err_msg=k)
+
+
+def test_timeline_admission_pow2_downshift_tolerance():
+    """pow2 ladder: the drain-phase bucket down-shift re-runs the tail
+    in a smaller program, which perturbs t/h at the documented ulp
+    level — the attempt SEQUENCE (codes, counts) stays identical and
+    the values stay within solver tolerance."""
+    B = 5
+    y0s, cfgs = _lanes(B, spread=1.5)
+    kw = dict(rtol=1e-6, atol=1e-10, stats=True, timeline=24)
+    mono = S.ensemble_solve(rhs, y0s, 0.0, 1.0, cfgs, **kw)
+    adm = S.ensemble_solve_segmented(
+        rhs, y0s, 0.0, 1.0, cfgs, segment_steps=8, max_segments=600,
+        poll_every=1, admission=2, refill=1, buckets="pow2", **kw)
+    np.testing.assert_array_equal(np.asarray(mono.stats["timeline_code"]),
+                                  np.asarray(adm.stats["timeline_code"]))
+    for k in ("timeline_t", "timeline_h"):
+        np.testing.assert_allclose(np.asarray(mono.stats[k]),
+                                   np.asarray(adm.stats[k]),
+                                   rtol=1e-8, atol=1e-10, err_msg=k)
+
+
+def test_timeline_validation():
+    y0 = jnp.asarray([1.0])
+    cfg = {"k": jnp.asarray(1.0)}
+    with pytest.raises(ValueError, match="stats"):
+        bdf.solve(rhs, y0, 0.0, 1.0, cfg, timeline=8)
+    with pytest.raises(ValueError, match="ring length"):
+        bdf.solve(rhs, y0, 0.0, 1.0, cfg, stats=True, timeline=1)
+    with pytest.raises(ValueError, match="ring length"):
+        bdf.solve(rhs, y0, 0.0, 1.0, cfg, stats=True, timeline=True)
+    y0s, cfgs = _lanes(2)
+    with pytest.raises(ValueError, match="pipelined"):
+        S.ensemble_solve_segmented(rhs, y0s, 0.0, 1.0, cfgs,
+                                   segment_steps=8, stats=True,
+                                   timeline=8, pipeline=False)
+
+
+def test_timeline_noop_byte_identity():
+    """timeline=None traces byte-identically before and after a
+    timeline program has been built and run (the brlint
+    timeline-noop-fork contract, asserted in-suite too)."""
+    y0 = jnp.asarray([1.0, 0.5])
+    cfg = {"k": jnp.asarray(20.0)}
+
+    def run(y0_, **kw):
+        return bdf.solve(rhs, y0_, 0.0, 1.0, cfg, rtol=1e-6, atol=1e-10,
+                         stats=True, **kw).y
+
+    before = str(jax.make_jaxpr(run)(y0))
+    bdf.solve(rhs, y0, 0.0, 1e-3, cfg, rtol=1e-6, atol=1e-10,
+              stats=True, timeline=8)
+    after = str(jax.make_jaxpr(run)(y0))
+    assert before == after
+
+
+def test_timeline_rides_report_and_render():
+    """End-to-end through the report/export/CLI surface: per-lane
+    timeline arrays land in the report, survive the JSONL round-trip,
+    and render as strip charts (obs_report.py --timeline)."""
+    B = 3
+    y0s, cfgs = _lanes(B)
+    rec = obs.Recorder()
+    res = S.ensemble_solve_segmented(rhs, y0s, 0.0, 1.0, cfgs,
+                                     segment_steps=8, max_segments=400,
+                                     stats=True, timeline=16,
+                                     recorder=rec)
+    report = obs.build_report(recorder=rec, solver_stats=res.stats)
+    per_lane = report["solver_stats"]["per_lane"]
+    assert TL.has_timeline(per_lane)
+    assert len(per_lane["timeline_code"]) == B
+    # totals never sum ring slots
+    assert "timeline_t" not in report["solver_stats"]["totals"]
+    rt = obs.from_jsonl(obs.to_jsonl(report))
+    assert rt == report
+    text = TL.render(report, lanes=[0, 2])
+    assert "lane 0" in text and "lane 2" in text and "acc=" in text
+    # explicit out-of-range lane fails loudly
+    with pytest.raises(ValueError):
+        TL.render(report, lanes=[99])
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+def test_flight_ring_bounded_and_dump(tmp_path):
+    fl = L.FlightRecorder(capacity=4)
+    for i in range(10):
+        fl.note("event", name=f"e{i}")
+    recs = fl.records()
+    assert len(recs) == 4 and recs[-1]["name"] == "e9"
+    path = fl.dump(dir=str(tmp_path), reason="test")
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines[0]["kind"] == "flight" and lines[0]["reason"] == "test"
+    assert len(lines) == 5
+    # a second dump never overwrites the first
+    path2 = fl.dump(dir=str(tmp_path), reason="again")
+    assert path2 != path and os.path.exists(path)
+
+
+def test_flight_recorder_hung_fetch_dump(tmp_path):
+    """The acceptance postmortem: a BR_FAULT_INJECT hung-fetch wedge
+    dumps a flight_*.jsonl whose tail carries the fault event and the
+    last counter snapshot preceding it."""
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+    from batchreactor_tpu.resilience import inject
+
+    B = 4
+    y0s, cfgs = _lanes(B)
+    rec = obs.Recorder()
+    L.arm_flight(recorder=rec, dir=str(tmp_path), install_signal=False)
+    inject.arm("hang_fetch:delay=10")
+    try:
+        res = checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs,
+                                 str(tmp_path / "ck"), chunk_size=2,
+                                 chunk_budget_s=0.3,
+                                 retry={"max_retries": 2,
+                                        "backoff_s": 0.0},
+                                 recorder=rec)
+    finally:
+        inject.disarm()
+        L.disarm_flight()
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    dumps = sorted(glob.glob(str(tmp_path / "flight_*.jsonl")))
+    assert dumps, "wedge left no flight dump"
+    lines = [json.loads(ln) for ln in open(dumps[-1])]
+    assert lines[0]["kind"] == "flight"
+    tail = lines[-8:]
+    fault_idx = [i for i, r in enumerate(tail)
+                 if r.get("kind") == "event" and r.get("name") == "fault"
+                 and r["attrs"]["kind"] == "hung_fetch"]
+    snap_idx = [i for i, r in enumerate(tail)
+                if r.get("kind") == "counter_snapshot"]
+    assert fault_idx and snap_idx
+    # a counter snapshot PRECEDES the fault event (watchdog ordering)
+    assert min(snap_idx) < max(fault_idx)
+    # counted under the LIVE_KEYS convention
+    assert rec.snapshot()[2]["flight_dumps"] >= 1
+    # disarm really detached the tap
+    assert rec.tap is None
+
+
+def test_flight_unarmed_noops():
+    assert L.flight_dump("nothing") is None
+    assert L.armed_flight() is None
+    L.flight_note_counters(obs.Recorder())   # must not raise
+
+
+# --------------------------------------------------------------------------
+# fleet aggregation
+# --------------------------------------------------------------------------
+def test_fleet_merge_and_prometheus(tmp_path):
+    d = str(tmp_path)
+    for pid, (att, occ_depth) in enumerate([(10, 3), (32, 7)]):
+        rec = obs.Recorder()
+        rec.counter("lane_attempts", att)
+        rec.counter("lane_capacity", 64)
+        reg = L.LiveRegistry(recorder=rec)
+        reg.publish("sweep", gauges={"backlog_depth": occ_depth})
+        L.write_fleet_snapshot(d, pid, reg)
+    snaps = L.read_fleet_snapshots(d)
+    assert [s["pid"] for s in snaps] == [0, 1]
+    merged = L.merge_fleet(snaps)
+    # counters summed, gauges max-reduced (the GAUGE convention)
+    assert merged["counters"]["lane_attempts"] == 42
+    assert merged["counters"]["lane_capacity"] == 128
+    assert merged["gauges"]["backlog_depth"] == 7
+    text = L.fleet_prometheus(snaps)
+    assert 'host="p0"' in text and 'host="p1"' in text
+    assert "br_fleet_hosts 2" in text
+    assert "br_fleet_occupancy" in text       # 42/128 derivable
+    # a registry with fleet_dir serves the merged view from /metrics
+    reg2 = L.LiveRegistry(fleet_dir=d)
+    assert "br_fleet_hosts 2" in reg2.prometheus()
+    # torn snapshot skipped, not fatal
+    with open(os.path.join(d, "hosts", "p9.metrics.json"), "w") as f:
+        f.write('{"pid": 9, "cou')
+    assert len(L.read_fleet_snapshots(d)) == 2
+
+
+# --------------------------------------------------------------------------
+# diff conventions + CLI
+# --------------------------------------------------------------------------
+def test_diff_missing_live_and_timeline_keys_map_to_zero():
+    """The PR-6/8 convention extended: live-plane counters absent from
+    an endpoint-less report diff as 0, not as a difference — and ring
+    payloads never enter solver totals, so an archived pre-timeline
+    report diffs cleanly against a timeline run."""
+    base = {"schema": "br-obs-v1", "meta": {}, "spans": [], "events": [],
+            "counters": {}, "solver_stats": None, "compile": None}
+    b = dict(base)
+    b["counters"] = {k: 0 for k in C.LIVE_KEYS}
+    out = obs.diff(base, b)
+    assert "no differences" in out
+    b2 = dict(base)
+    b2["counters"] = {"metrics_scrapes": 3}
+    out2 = obs.diff(base, b2)
+    assert "metrics_scrapes: 0 -> 3" in out2
+    # timeline arrays excluded from totals entirely
+    st = {"n_accepted": np.asarray([2, 3]),
+          "n_rejected": np.asarray([0, 1]),
+          "timeline_t": np.zeros((2, 4)),
+          "timeline_h": np.zeros((2, 4)),
+          "timeline_code": np.zeros((2, 4), np.int8)}
+    tot = C.totals(st)
+    assert set(tot) == {"n_accepted", "n_rejected"}
+
+
+def test_obs_report_cli_timeline(tmp_path, capsys):
+    B = 2
+    y0s, cfgs = _lanes(B)
+    res = S.ensemble_solve(rhs, y0s, 0.0, 1.0, cfgs, stats=True,
+                           timeline=8)
+    report = obs.build_report(solver_stats=res.stats)
+    path = str(tmp_path / "tl.jsonl")
+    obs.write_jsonl(path, report)
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    assert obs_report.main([path, "--timeline"]) == 0
+    out = capsys.readouterr().out
+    assert "solver timelines" in out and "lane" in out
+    assert obs_report.main([path, "--timeline", "--lanes", "1"]) == 0
+    assert "lane 1" in capsys.readouterr().out
+
+
+def test_timeline_joins_checkpoint_fingerprint(tmp_path):
+    """A non-None ring changes the persisted chunk stats schema, so it
+    PINS the resume fingerprint: same ring resumes, a different ring
+    fails loudly, and explicit timeline=None fingerprints identically
+    to the knob absent (the buckets=None convention)."""
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    B = 4
+    y0s, cfgs = _lanes(B)
+    d = str(tmp_path / "ck")
+    kw = dict(chunk_size=2, segment_steps=16, stats=True)
+    r1 = checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs, d, timeline=8, **kw)
+    # same ring: resumes from the chunk artifacts
+    r2 = checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs, d, timeline=8, **kw)
+    np.testing.assert_array_equal(np.asarray(r1.stats["timeline_t"]),
+                                  np.asarray(r2.stats["timeline_t"]))
+    # different ring (or off): loud manifest mismatch, never mixed chunks
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs, d, timeline=16, **kw)
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs, d, **kw)
+    # knob-absent and explicit None fingerprint identically
+    d2 = str(tmp_path / "ck2")
+    checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs, d2, **kw)
+    checkpointed_sweep(rhs, y0s, 0.0, 1.0, cfgs, d2, timeline=None, **kw)
+
+
+def test_api_timeline_and_live_validation():
+    import batchreactor_tpu as br
+    from batchreactor_tpu import Chemistry
+
+    gm = br.compile_gaschemistry(
+        os.path.join(REPO, "tests", "fixtures", "h2o2.dat"))
+    th = br.create_thermo(list(gm.species),
+                          os.path.join(REPO, "tests", "fixtures",
+                                       "therm.dat"))
+    with pytest.raises(ValueError, match="telemetry"):
+        br.batch_reactor_sweep({"H2": 0.3, "O2": 0.2, "N2": 0.5},
+                               1000.0, 1e5, 1e-6,
+                               chem=Chemistry(gaschem=True),
+                               thermo_obj=th, md=gm, timeline=8)
+    with pytest.raises(ValueError):
+        br.batch_reactor_sweep({"H2": 0.3, "O2": 0.2, "N2": 0.5},
+                               1000.0, 1e5, 1e-6,
+                               chem=Chemistry(gaschem=True),
+                               thermo_obj=th, md=gm, telemetry=True,
+                               timeline=1)
