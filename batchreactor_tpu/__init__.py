@@ -12,6 +12,7 @@ Architecture (host -> device):
     -> batched implicit stiff integrators (SDIRK4 and variable-order
        BDF 1..5, Newton + mixed-precision LU, vmap-able)
     -> mesh-sharded ensemble sweeps (jax.sharding, collective-free)
+    -> resident sweep-as-a-service daemon (serving/, docs/serving.md)
     -> API layer reproducing the reference's three batch_reactor signatures.
 
 Chemistry spans ~40 orders of magnitude and the reference integrates at

@@ -28,7 +28,8 @@ and the sweep drivers lazily via this module's ``__getattr__``, so
 
 from .buckets import POW2, bucket_ladder, normalize_buckets, resolve_bucket
 
-_REGISTRY_NAMES = ("warmup", "configure_cache", "reset_persistent_cache",
+_REGISTRY_NAMES = ("warmup", "spec_keys", "configure_cache",
+                   "reset_persistent_cache",
                    "program_key", "mechanism_fingerprint", "load_manifest",
                    "manifest_path", "WarmupResult")
 

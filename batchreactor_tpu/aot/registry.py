@@ -48,7 +48,7 @@ SCHEMA = "br-aot-manifest-v1"
 _MANIFEST = "br_aot_manifest.json"
 
 #: spec keys that are warmup bookkeeping, not sweep kwargs
-_SPEC_KEYS = ("rhs", "y0", "cfg", "lanes", "buckets")
+_SPEC_KEYS = ("rhs", "y0", "cfg", "lanes", "buckets", "backlog")
 
 
 def reset_persistent_cache():
@@ -177,6 +177,44 @@ def _flag_set(kw):
     return flags
 
 
+def _resolve_spec(spec):
+    """THE one spec-parsing point shared by :func:`warmup` and
+    :func:`spec_keys`: pops the bookkeeping keys, validates the
+    backlog-needs-admission contract, and derives the mechanism
+    fingerprint — so the --list coverage probe structurally cannot
+    drift from the warming pass.  Returns ``(rhs, y0, cfg, lanes,
+    buckets, backlog, kw, method, mech_fp)`` with ``kw`` the remaining
+    sweep kwargs (== the flag set)."""
+    import jax
+
+    from .buckets import normalize_buckets
+
+    spec = dict(spec)
+    rhs = spec.pop("rhs")
+    y0 = spec.pop("y0", None)
+    cfg = spec.pop("cfg", None)
+    lanes = spec.pop("lanes")
+    # absent key defaults to the pow2 ladder; an EXPLICIT None is the
+    # valid bucketing-off spelling (warm the exact lane-count shapes
+    # the session will run — coercing it to pow2 would warm the wrong
+    # program set)
+    buckets = normalize_buckets(spec.pop("buckets", "pow2"))
+    backlog = float(spec.pop("backlog", 1) or 1)
+    kw = spec
+    method = kw.get("method", "bdf")
+    if backlog > 1 and not kw.get("admission"):
+        # a >bucket lane count on the non-streaming drivers would pad
+        # UP to a bigger bucket and warm the wrong program
+        raise ValueError(
+            "warmup spec: backlog > 1 needs admission= in the spec "
+            "(only the streaming driver runs a backlog through a "
+            "fixed resident program)")
+    mech_fp = mechanism_fingerprint(
+        rhs, kw.get("jac"), kw.get("observer"),
+        extra=jax.tree_util.tree_map(repr, kw.get("observer_init")))
+    return rhs, y0, cfg, lanes, buckets, backlog, kw, method, mech_fp
+
+
 def warmup(specs, *, cache_dir=None, configure=True, log=None):
     """Pre-compile the canonical bucket programs for the given sweep
     specs; returns a list of :class:`WarmupResult` (one per program).
@@ -195,11 +233,19 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None):
       :func:`~.buckets.normalize_buckets` grammar; an explicit ``None``
       warms the exact lane-count shapes, for sessions that run with
       bucketing off);
+    * ``backlog`` — a lane multiplier > 1 (streaming admission specs
+      only; requires ``admission`` in the spec): the warmup run feeds
+      ``bucket * backlog`` lanes through the ``bucket``-slot resident
+      program, so the traced compaction/admission step
+      (``parallel/sweep._compact_admit``) is warmed ALONGSIDE the
+      segment program — a serving session (``serving/session.py``)
+      whose first live request would otherwise pay the compact compile;
     * every other key (``method``, ``rtol``, ``atol``, ``jac``,
       ``observer``/``observer_init``, ``jac_window``, ``n_save``,
-      ``segment_steps``, ``max_attempts``, ``stats``, ...) passes
-      straight through to :func:`parallel.ensemble_solve_segmented`
-      (when ``segment_steps`` > 0) or :func:`parallel.ensemble_solve` —
+      ``segment_steps``, ``max_attempts``, ``stats``, ``admission``/
+      ``refill``, ...) passes straight through to
+      :func:`parallel.ensemble_solve_segmented` (when
+      ``segment_steps`` > 0) or :func:`parallel.ensemble_solve` —
       the flag set MUST match the real run's, it is part of the key.
 
     ``configure=True`` (default) routes compiles into the managed
@@ -214,7 +260,7 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None):
     from .. import __version__ as _pkg_version
     from ..obs.retrace import CompileWatch
     from ..parallel.sweep import ensemble_solve, ensemble_solve_segmented
-    from .buckets import bucket_ladder, normalize_buckets
+    from .buckets import bucket_ladder
 
     man = None
     if configure:
@@ -224,31 +270,24 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None):
         man["package"] = _pkg_version
     results = []
     for spec in specs:
-        spec = dict(spec)
-        rhs = spec.pop("rhs")
-        y0 = jnp.asarray(spec.pop("y0"))
-        cfg = spec.pop("cfg")
-        lanes = spec.pop("lanes")
-        # absent key defaults to the pow2 ladder; an EXPLICIT None is the
-        # valid bucketing-off spelling (warm the exact lane-count shapes
-        # the session will run — coercing it to pow2 would warm the
-        # wrong program set)
-        buckets = normalize_buckets(spec.pop("buckets", "pow2"))
-        kw = spec  # remaining keys are sweep kwargs == the flag set
-        method = kw.get("method", "bdf")
+        (rhs, y0, cfg, lanes, buckets, backlog, kw, method,
+         mech_fp) = _resolve_spec(spec)
+        y0 = jnp.asarray(y0)
         seg = int(kw.get("segment_steps", 0) or 0)
-        mech_fp = mechanism_fingerprint(
-            rhs, kw.get("jac"), kw.get("observer"),
-            extra=jax.tree_util.tree_map(repr, kw.get("observer_init")))
         for bucket in bucket_ladder(lanes, buckets):
             flags = _flag_set(kw)
             key = program_key(mech_fp, method, bucket, flags)
-            y0s = jnp.broadcast_to(y0, (bucket,) + y0.shape)
+            # backlog > 1 streams extra lanes through the bucket-slot
+            # resident program so the compaction step traces too; the
+            # resident shape (and therefore the program key) is still
+            # the bucket
+            n_lanes = max(bucket, int(round(bucket * backlog)))
+            y0s = jnp.broadcast_to(y0, (n_lanes,) + y0.shape)
             cfgs = {
                 k: jnp.broadcast_to(
                     jnp.asarray(v, dtype=jnp.float64
                                 if jnp.asarray(v).dtype.kind == "f"
-                                else None), (bucket,))
+                                else None), (n_lanes,))
                 for k, v in cfg.items()}
             watch = CompileWatch(default_label=key)
             t0 = time.perf_counter()
@@ -303,3 +342,20 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None):
     if man is not None:
         _save_manifest(cache_dir, man)
     return results
+
+
+def spec_keys(spec):
+    """The ``(program_key, bucket)`` pairs one :func:`warmup` spec
+    resolves to, WITHOUT executing (or compiling) anything — the
+    coverage probe ``scripts/warm_cache.py --list --spec`` uses to flag
+    manifest entries a session spec expects but the cache is missing.
+    Parsing and key derivation go through the SAME :func:`_resolve_spec`
+    / :func:`_flag_set` / :func:`program_key` calls as :func:`warmup`,
+    so the probe structurally cannot drift from the warming pass."""
+    from .buckets import bucket_ladder
+
+    (_rhs, _y0, _cfg, lanes, buckets, _backlog, kw, method,
+     mech_fp) = _resolve_spec(spec)
+    flags = _flag_set(kw)
+    return [(program_key(mech_fp, method, b, flags), b)
+            for b in bucket_ladder(lanes, buckets)]

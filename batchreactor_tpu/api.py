@@ -29,6 +29,13 @@ forward sensitivities natively (tests/test_solver.py exercises this).
 CVODES-style staggered forward tangents riding the BDF loop, or
 checkpointed adjoint gradients of a scalar QoI — via the
 :mod:`~batchreactor_tpu.sensitivity` subsystem (docs/sensitivity.md).
+
+For a long-lived process answering a *stream* of programmatic-form
+requests, the :mod:`~batchreactor_tpu.serving` daemon (docs/serving.md)
+wraps this entry point's condition/result math around one warm,
+continuously-batched resident sweep: results are bit-exact vs direct
+:func:`batch_reactor_sweep` calls on the same conditions, with request
+coalescing, backpressure, and live ``/metrics`` on top.
 """
 
 import contextlib

@@ -103,6 +103,19 @@ TIMELINE_KEYS = ("timeline_t", "timeline_h", "timeline_code")
 #: key to 0 (the FAULT_KEYS/ADMISSION_KEYS convention).
 LIVE_KEYS = ("metrics_scrapes", "live_publishes", "fleet_snapshots",
              "flight_dumps")
+#: serving-plane counters (serving/ — docs/serving.md): Recorder
+#: counters incremented by the daemon's scheduler (request admission /
+#: rejection / resolution, epoch turnover, injected stalls), the
+#: streaming driver's live feed (``fed_lanes`` — lanes appended to a
+#: resident backlog mid-stream), and the session warmup wall.
+#: ``serve_latency_s`` accumulates answered-request wall like
+#: ``poll_wait_s`` (divide by ``serve_answered`` for the mean).  Absent
+#: from a report whose run served nothing — ``obs.diff`` maps a missing
+#: key to 0 (the FAULT_KEYS convention).
+SERVE_KEYS = ("serve_requests", "serve_lanes", "serve_answered",
+              "serve_failed", "serve_rejects_overload",
+              "serve_rejects_draining", "serve_stalls", "serve_epochs",
+              "serve_latency_s", "serve_warmup_s", "fed_lanes")
 
 
 def occupancy(counters):

@@ -217,11 +217,12 @@ class MetricsServer:
     context manager (the sweep entry points do) or call
     ``start()``/``close()`` explicitly for a long-lived service."""
 
-    def __init__(self, registry, port=0, host="127.0.0.1"):
+    def __init__(self, registry, port=0, host="127.0.0.1", log=None):
         self.registry = registry
         self._requested = (host, int(port))
         self._server = None
         self._thread = None
+        self._log = log
 
     def start(self):
         if self._server is not None:
@@ -235,6 +236,17 @@ class MetricsServer:
             target=self._server.serve_forever, daemon=True,
             name="br-metrics-server")
         self._thread.start()
+        # the ephemeral-port (port=0) discipline: the BOUND port is the
+        # only one that exists, so expose it the moment it does — on the
+        # instance (.port/.url), as a recorder event, and through any
+        # caller-supplied log — so daemons, tests, and CI never race a
+        # fixed port
+        if self.registry is not None and self.registry.recorder is not None:
+            self.registry.recorder.event(
+                "metrics_server_bound",
+                host=self._server.server_address[0], port=self.port)
+        if self._log is not None:
+            self._log(f"[metrics] serving {self.url}/metrics")
         return self
 
     @property

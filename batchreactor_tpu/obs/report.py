@@ -211,11 +211,12 @@ def diff(a, b):
     ka, kb = a.get("counters") or {}, b.get("counters") or {}
     for k in sorted(set(ka) | set(kb)):
         va, vb = ka.get(k), kb.get(k)
-        if k in C.FAULT_KEYS or k in C.ADMISSION_KEYS or k in C.LIVE_KEYS:
-            # fault/admission/live-plane counters are absent from
-            # fault-free / admission-less / endpoint-less reports:
-            # missing is 0, not a difference (the setup_reuses/cache_*
-            # convention)
+        if (k in C.FAULT_KEYS or k in C.ADMISSION_KEYS
+                or k in C.LIVE_KEYS or k in C.SERVE_KEYS):
+            # fault/admission/live-plane/serving counters are absent
+            # from fault-free / admission-less / endpoint-less /
+            # serve-less reports: missing is 0, not a difference (the
+            # setup_reuses/cache_* convention)
             va, vb = va or 0, vb or 0
             if va == vb:
                 continue

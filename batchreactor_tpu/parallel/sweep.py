@@ -454,7 +454,7 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              pipeline=None, poll_every=None, buckets=None,
                              fetch_deadline=None, admission=None,
                              refill=None, timeline=None, live=None,
-                             _on_harvest=None):
+                             _on_harvest=None, _feed=None):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -592,6 +592,25 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     ``bucket_downshifts``, and the occupancy pair ``lane_attempts`` /
     ``lane_capacity`` (docs/observability.md).
 
+    ``_on_harvest``/``_feed`` (streaming driver only; the serving
+    scheduler's hooks — ``serving/scheduler.py``, and the
+    ``checkpointed_sweep`` backlog mode for ``_on_harvest``):
+    ``_on_harvest(gids, payload)`` fires from the driver thread at each
+    harvest with the finished lanes' global indices and per-lane field
+    rows, so a caller can consume results the moment a lane finishes
+    instead of at stream end.  ``_feed(n_space, idle)`` makes the
+    backlog LIVE: whenever the static backlog is exhausted and slots
+    are free, the driver asks the feed for up to ``n_space`` more lanes
+    — return ``(y0_rows, cfg_rows)`` numpy blocks (``k <= n_space``
+    appended to the backlog; their global indices continue the
+    sequence), or ``None`` to close the feed for good.  With
+    ``idle=True`` every resident lane has finished and the stream has
+    nothing to do: the feed may BLOCK until work arrives, and a
+    0-lane return while idle is treated as close (the stream cannot
+    spin on an empty program).  ``_feed`` requires the admission gear
+    (loud error otherwise — on the non-streaming paths a live backlog
+    has no meaning).
+
     ``timeline=N`` (requires ``stats=True`` and the pipelined gear;
     semantics ``obs/timeline.py``) records each lane's last N attempt
     records ``(t, h, code)`` into a ring riding the control block's
@@ -684,7 +703,14 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 setup_economy=setup_economy, stale_tol=float(stale_tol),
                 stats=stats, recorder=recorder, watch=watch,
                 progress=progress, fetch_kw=fkw, timeline=timeline,
-                live=live, on_harvest=_on_harvest)
+                live=live, on_harvest=_on_harvest, feed=_feed)
+    if _feed is not None:
+        # loudness convention (pipeline/poll_every): a live backlog only
+        # exists on the streaming admission driver — silently ignoring
+        # the feed would strand every lane it was going to supply
+        raise ValueError(
+            "_feed is a streaming-driver hook; pass admission= (continuous "
+            "batching) or drop the feed")
     B_live = y0s.shape[0]
     bucket = resolve_bucket(
         B_live, buckets,
@@ -1545,7 +1571,8 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
                              dt_min_factor, bundle_mode, jac_window,
                              newton_tol, method, setup_economy, stale_tol,
                              stats, recorder, watch, progress, fetch_kw,
-                             timeline=None, live=None, on_harvest=None):
+                             timeline=None, live=None, on_harvest=None,
+                             feed=None):
     """Continuous batching: one resident B-lane segment program streams
     through an N-lane backlog (``ensemble_solve_segmented`` docstring,
     ``admission=``).  The loop structure is the pipelined driver's —
@@ -1568,7 +1595,15 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
     ``on_harvest(gids, payload)`` (the ``checkpointed_sweep`` backlog
     hook) is called from the driver thread at each harvest with the
     finished lanes' global indices and their per-lane field rows —
-    chunk completion units for incremental checkpointing."""
+    chunk completion units for incremental checkpointing.
+
+    ``feed(n_space, idle)`` (the serving scheduler's live-backlog hook;
+    contract in the ``ensemble_solve_segmented`` docstring) is
+    consulted once the static backlog is exhausted: returned rows are
+    appended to the host backlog (and every output accumulator grows
+    with them), so one resident program can serve an open-ended
+    request stream; ``None`` — or a 0-lane return while ``idle`` —
+    closes the feed and the stream drains normally."""
     fkw = fetch_kw or {}
     RUN = int(sdirk.RUNNING)
     N = int(y0s.shape[0])
@@ -1779,6 +1814,57 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
             recorder.counter("bucket_downshifts")
             recorder.event("bucket_downshift", bucket=B, live=n_live)
 
+    def _feed_more(n_space, idle):
+        """Ask the live feed for up to ``n_space`` more backlog lanes
+        and append them to the host backlog + output accumulators;
+        returns the appended count, or ``None`` when the feed closed
+        (explicitly, or by returning nothing while the stream is
+        idle)."""
+        nonlocal y0_np, cfg_np, N
+        nonlocal out_t, out_status, out_y, out_h, out_acc, out_rej
+        nonlocal out_stats, out_obs
+        got = feed(int(n_space), bool(idle))
+        if got is None:
+            return None
+        y_new, cfg_new = got
+        y_new = np.asarray(y_new, dtype=y0_np.dtype).reshape((-1,) + tail)
+        k = int(y_new.shape[0])
+        if k == 0:
+            # an idle stream with an open-but-empty feed would relaunch
+            # all-parked segments forever: treat it as a close (the feed
+            # contract says block-or-close when idle)
+            return None if idle else 0
+        y0_np = np.concatenate([y0_np, y_new])
+        cfg_np = jax.tree.map(
+            lambda d, s: np.concatenate(
+                [d, np.asarray(s, dtype=d.dtype).reshape(
+                    (k,) + d.shape[1:])]), cfg_np, cfg_new)
+        out_t = np.concatenate([out_t, np.full((k,), np.nan)])
+        out_status = np.concatenate(
+            [out_status, np.full((k,), RUN, dtype=np.int32)])
+        out_y = np.concatenate([out_y, y_new.copy()])
+        out_h = np.concatenate([out_h, np.full((k,), -1.0)])
+        out_acc = np.concatenate([out_acc,
+                                  np.zeros((k,), dtype=np.int64)])
+        out_rej = np.concatenate([out_rej,
+                                  np.zeros((k,), dtype=np.int64)])
+        if out_stats is not None:
+            out_stats = {
+                key: np.concatenate(
+                    [v, np.zeros((k,) + v.shape[1:], dtype=v.dtype)])
+                for key, v in out_stats.items()}
+        if out_obs is not None:
+            out_obs = jax.tree.map(
+                lambda a, init: np.concatenate(
+                    [a, np.broadcast_to(
+                        np.asarray(init[:1]),
+                        (k,) + tuple(a.shape[1:])).copy()]),
+                out_obs, fresh[4])
+        if recorder is not None:
+            recorder.counter("fed_lanes", k)
+        N += k
+        return k
+
     def _progress(seg, status_np, acc_np):
         if progress is None:
             return
@@ -1853,6 +1939,15 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
         _progress(seg, status_np, acc_np)
         running = status_np == RUN
         n_parked = int(B - running.sum())
+        if feed is not None and next_gid >= N and n_parked:
+            # live backlog (serving/scheduler.py): the static backlog is
+            # exhausted but the stream may refill it — harvest finished
+            # lanes NOW (their callbacks fire at this poll boundary, not
+            # at stream end), then ask the feed for more, blocking only
+            # when nothing is left running
+            _harvest(status_np)
+            if _feed_more(n_parked, idle=not running.any()) is None:
+                feed = None
         if next_gid < N:
             if n_parked >= refill_n or not running.any():
                 _harvest(status_np)
@@ -1861,7 +1956,12 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
             _harvest(status_np)
             done = True
             break
-        elif buckets is not None and n_parked:
+        elif buckets is not None and n_parked and feed is None:
+            # drain-tail down-shift only once the backlog can never
+            # refill: there is no up-shift path, so shrinking the
+            # resident program under an OPEN feed would serialize every
+            # later-fed lane through the shrunken bucket for the rest
+            # of the stream
             _harvest(status_np)
             _downshift(status_np)
     if not done:

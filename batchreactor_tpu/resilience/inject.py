@@ -21,6 +21,15 @@ reproducible points — no timing races, no real wedges:
     global lane ``I``'s result is poisoned after its chunk solve
     (``y -> NaN``, ``status -> DT_UNDERFLOW``) — the mid-sweep numerical
     blowup the quarantine path re-solves.
+``slow_request[:delay=S][,request=ID][,count=N]``
+    the serving scheduler stalls the matched request ``S`` seconds
+    (default 0.5) between its admission into the resident stream and
+    its harvest-resolution (``serving/scheduler.py``) — the
+    slow-consumer scenario.  The stall sits IN the harvest path, so
+    it briefly pauses the driver thread exactly where a slow result
+    consumer would (co-harvested requests feel it too); that is what
+    makes the daemon's latency, drain, and mid-flight-scrape behavior
+    under a stuck request deterministic and testable.
 
 Plans arm from the ``BR_FAULT_INJECT`` env var (semicolon-separated
 specs, parsed once on first use) or programmatically via :func:`arm`;
@@ -51,7 +60,8 @@ class _Plan:
         return f"_Plan({self.kind}, {self.params}, fired={self.fired})"
 
 
-_KINDS = ("hang_fetch", "kill", "corrupt_chunk", "nan_lane")
+_KINDS = ("hang_fetch", "kill", "corrupt_chunk", "nan_lane",
+          "slow_request")
 
 
 def _parse(spec):
@@ -132,6 +142,17 @@ def fetch_hang_delay():
     """Seconds the next deadline-guarded wait should sleep (0 = none)."""
     p = _take("hang_fetch")
     return float(p.get("delay", 30.0)) if p else 0.0
+
+
+def slow_request_delay(request_id):
+    """Seconds the serving scheduler should stall this request between
+    admission and harvest (0 = none); a ``request=`` param pins the
+    plan to one request id, otherwise the next admitted request
+    matches."""
+    p = _take("slow_request",
+              lambda prm: ("request" not in prm
+                           or prm["request"] == str(request_id)))
+    return float(p.get("delay", 0.5)) if p else 0.0
 
 
 def kill_now(chunk):

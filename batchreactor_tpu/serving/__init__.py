@@ -1,0 +1,62 @@
+"""Sweep-as-a-service: the resident solver daemon (docs/serving.md).
+
+The serving plane assembles four existing subsystems into a long-lived
+process that answers a live stream of reactor-condition requests from
+ONE warm, continuously-batched device program:
+
+* warm AOT bucket executables (:mod:`~batchreactor_tpu.aot` — a warmed
+  session serves with ``compiles == 0``);
+* the device-resident streaming driver with live lane admission
+  (``parallel/sweep.py`` ``admission=`` + the ``_feed``/``_on_harvest``
+  hooks this PR adds — a request arriving mid-stream rides lanes freed
+  by finished conditions);
+* explicit admission-control backpressure and graceful drain
+  (:mod:`.scheduler` — ``overloaded``/``draining`` rejections, never
+  silent queueing; SIGTERM answers everything accepted);
+* the live telemetry plane (:mod:`~batchreactor_tpu.obs.live` —
+  ``GET /metrics`` mid-flight, flight-recorder postmortems).
+
+Layering (request path)::
+
+    schema.validate_request     # loud, versioned JSON grammar
+      -> Scheduler.submit       # queue + backpressure; future per request
+        -> SolverSession.stream # one resident program per pack key
+          -> on_harvest         # future resolves as the LAST lane lands
+
+Entry points: ``scripts/serve.py`` (HTTP / stdin-JSONL daemon),
+``scripts/serve_bench.py`` (seeded Poisson load + latency percentiles),
+``scripts/warm_cache.py --spec serve.json`` (pre-bake the session's
+program set).  Import is lazy jax-wise: :mod:`.schema`,
+:mod:`.scheduler` and :mod:`.client` are numpy/stdlib-only, so clients
+and the scheduler tests never pay a device.
+"""
+
+from .schema import (SCHEMA_VERSION, Request, error_response,  # noqa: F401
+                     ok_response, validate_request)
+from .scheduler import (Draining, Overloaded, RequestResult,  # noqa: F401
+                        Scheduler, SchedulerReject)
+from .client import ServeError, SolveClient, poisson_trace  # noqa: F401
+
+__all__ = [
+    "SCHEMA_VERSION", "Request", "validate_request", "error_response",
+    "ok_response", "Scheduler", "SchedulerReject", "Overloaded",
+    "Draining", "RequestResult", "SolverSession", "SessionSpec",
+    "load_spec", "ServingServer", "serve_jsonl", "SolveClient",
+    "ServeError", "poisson_trace",
+]
+
+_LAZY = {"SolverSession": "session", "SessionSpec": "session",
+         "load_spec": "session", "ServingServer": "server",
+         "serve_jsonl": "server"}
+
+
+def __getattr__(name):
+    # session/server import jax (through api._sweep_fns); loading them
+    # lazily keeps `from batchreactor_tpu.serving import SolveClient`
+    # device-free for remote clients
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

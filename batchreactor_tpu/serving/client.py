@@ -1,0 +1,166 @@
+"""Client + load-trace tooling for the serving daemon.
+
+:class:`SolveClient` is the minimal stdlib HTTP client (urllib) the
+tests, ``scripts/serve_bench.py``, and operators use: ``solve`` posts a
+schema request and returns the parsed response, raising
+:class:`ServeError` (with the server's error code) on anything but
+``status == "ok"``.
+
+:func:`poisson_trace` builds the SEEDED open-loop request trace the
+bench protocol measures under: exponential inter-arrival gaps at a
+target rate, deterministic per seed — two runs of the same seed issue
+byte-identical schedules, so a latency regression is a change in the
+server, not the load.  :func:`run_trace` fires a trace against a
+client from worker threads (open-loop: a slow response does not slow
+the arrival process — the honest way to find the knee) and returns
+per-request latency records for the p50/p95/p99 + cond/s summary
+(:func:`summarize`).
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+class ServeError(RuntimeError):
+    """A non-ok response; ``code`` is the schema error code and
+    ``response`` the parsed body (when the server sent one)."""
+
+    def __init__(self, code, message, response=None):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.response = response
+
+
+class SolveClient:
+    """Module doc.  ``url`` is the daemon base url
+    (``http://host:port``)."""
+
+    def __init__(self, url, timeout=300.0):
+        self.url = url.rstrip("/")
+        self.timeout = float(timeout)
+
+    def _get(self, path):
+        with urllib.request.urlopen(self.url + path,
+                                    timeout=self.timeout) as r:
+            return r.read().decode()
+
+    def healthz(self):
+        return json.loads(self._get("/healthz"))
+
+    def metrics(self):
+        """The raw Prometheus exposition text."""
+        return self._get("/metrics")
+
+    def solve(self, request):
+        """POST one request object; returns the parsed ``ok`` response
+        or raises :class:`ServeError` with the server's code."""
+        body = json.dumps(request).encode()
+        req = urllib.request.Request(
+            self.url + "/solve", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                resp = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                resp = json.loads(e.read().decode())
+            except (ValueError, OSError):
+                raise ServeError("internal",
+                                 f"HTTP {e.code}: {e.reason}") from None
+            err = resp.get("error") or {}
+            raise ServeError(err.get("code", "internal"),
+                             err.get("message", f"HTTP {e.code}"),
+                             resp) from None
+        if resp.get("status") != "ok":
+            err = resp.get("error") or {}
+            raise ServeError(err.get("code", "internal"),
+                            err.get("message", "non-ok response"), resp)
+        return resp
+
+
+def poisson_trace(n_requests, rate_hz, seed, make_request):
+    """The seeded open-loop trace: ``[(send_at_s, request), ...]`` with
+    exponential inter-arrival gaps at ``rate_hz`` mean arrivals/s.
+    ``make_request(i, rng)`` builds request ``i`` (the rng is the
+    trace's own — condition randomization stays inside the seed)."""
+    rng = random.Random(int(seed))
+    t = 0.0
+    out = []
+    for i in range(int(n_requests)):
+        t += rng.expovariate(float(rate_hz))
+        out.append((t, make_request(i, rng)))
+    return out
+
+
+def run_trace(client, trace, on_result=None):
+    """Fire a :func:`poisson_trace` schedule open-loop: each request is
+    posted from its own thread at its scheduled instant.  Returns one
+    record per request: ``{"id", "send_at", "latency_s", "ok",
+    "code", "response"}`` in trace order."""
+    records = [None] * len(trace)
+    threads = []
+    t0 = time.perf_counter()
+
+    def _fire(i, send_at, request):
+        delay = send_at - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        sent = time.perf_counter()
+        try:
+            resp = client.solve(request)
+            ok, code = True, None
+        except ServeError as e:
+            resp, ok, code = e.response, False, e.code
+        except OSError as e:
+            # transport-level failure (connection reset/refused under
+            # overload, daemon gone): a record, not a dead thread — the
+            # summary must account for every request fired
+            resp, ok, code = {"error": str(e)}, False, "transport"
+        records[i] = {"id": request.get("id", i), "send_at": send_at,
+                      "latency_s": time.perf_counter() - sent,
+                      "ok": ok, "code": code, "response": resp}
+        if on_result is not None:
+            on_result(records[i])
+
+    for i, (send_at, request) in enumerate(trace):
+        th = threading.Thread(target=_fire, args=(i, send_at, request),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    return records
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[int(idx)]
+
+
+def summarize(records, wall_s):
+    """The bench summary (PERF.md round-10 evidence format): counts,
+    sustained cond/s over the trace wall, and latency percentiles over
+    the ANSWERED requests."""
+    ok = [r for r in records if r and r["ok"]]
+    lat = sorted(r["latency_s"] for r in ok)
+    lanes = sum(len((r["response"] or {}).get("t", []))
+                for r in ok)
+    return {
+        "requests": len(records),
+        "answered": len(ok),
+        "rejected": sum(1 for r in records
+                        if r and not r["ok"]),
+        "lanes": lanes,
+        "wall_s": round(wall_s, 4),
+        "cond_per_s": round(lanes / wall_s, 3) if wall_s > 0 else None,
+        "p50_ms": round(1e3 * _percentile(lat, 0.50), 3) if lat else None,
+        "p95_ms": round(1e3 * _percentile(lat, 0.95), 3) if lat else None,
+        "p99_ms": round(1e3 * _percentile(lat, 0.99), 3) if lat else None,
+    }

@@ -1,0 +1,432 @@
+"""The coalescer: a thread-safe request queue feeding resident streams.
+
+Requests land here (:meth:`Scheduler.submit`) from any number of
+front-end threads, are grouped by *pack key* ``(t1, rtol, atol)`` —
+``t1`` and the conditions are traced operands of one shared program,
+``rtol``/``atol`` are static and therefore a distinct compiled program —
+and are packed into the PR-8 admission backlog of a resident streaming
+sweep: the scheduler's worker thread runs one *epoch* per active pack
+key through ``session.stream``, whose
+
+* ``feed(n_space, idle)`` hook pulls newly-arrived requests of the same
+  key INTO the live backlog (``parallel/sweep.py`` ``_feed`` contract)
+  — continuous admission, the LLM-inference-server shape: a request
+  arriving mid-stream rides freed lanes without a fresh dispatch;
+* ``on_harvest(gids, payload)`` hook resolves each request's future the
+  moment its LAST lane harvests — results are un-shuffled to request
+  lane order via the gid map (the driver already un-shuffles slot ->
+  global-index; the scheduler maps global index -> (request, offset)).
+
+An epoch ends when its feed goes idle past ``idle_timeout_s`` (the
+resident program is released; the next request re-enters through the
+warmed AOT cache at zero compiles), when a different pack key has work
+waiting (fairness rotation), or at drain.
+
+**Backpressure is explicit**: ``submit`` REJECTS with
+:class:`Overloaded` once ``max_queue_lanes`` lanes are queued
+(un-admitted) — never silent unbounded queueing — and with
+:class:`Draining` after :meth:`drain` began; accepted requests are
+always answered exactly once (drain finishes the backlog first, and a
+dead stream resolves its requests with ``internal`` errors rather than
+dropping them).
+
+The module imports stdlib + numpy only (no jax): the session object
+carries all device work, so the scheduler invariants are unit-testable
+against a fake session (tests/test_serving.py).
+"""
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+
+class SchedulerReject(RuntimeError):
+    """A request the scheduler refused; ``code`` is the response error
+    code (schema.ERROR_CODES)."""
+
+    code = "internal"
+
+
+class Overloaded(SchedulerReject):
+    """Queue bound reached — admission-control backpressure."""
+
+    code = "overloaded"
+
+
+class Draining(SchedulerReject):
+    """The scheduler is draining (SIGTERM path): in-flight work still
+    answers, new work is refused."""
+
+    code = "draining"
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """What a request's future resolves to: per-lane arrays in REQUEST
+    lane order (the harvest un-shuffle target), plus provenance and
+    wall time.  ``serving/session.py render_result`` turns this into
+    the response payload."""
+
+    request: object
+    t: np.ndarray
+    y: np.ndarray
+    status: np.ndarray
+    n_accepted: np.ndarray
+    n_rejected: np.ndarray
+    stats: dict | None
+    observed: dict | None
+    provenance: list
+    elapsed_s: float
+
+
+class _Work:
+    """One accepted request in flight: its future, pre-packed lane
+    blocks, per-lane result buffers, and the harvest countdown."""
+
+    __slots__ = ("request", "future", "y0", "cfg", "t", "y", "status",
+                 "n_acc", "n_rej", "stats", "observed", "remaining",
+                 "submitted", "stall_s", "seq")
+
+    def __init__(self, request, y0, cfg, seq):
+        self.request = request
+        self.future = Future()
+        self.y0 = y0
+        self.cfg = cfg
+        k = request.n_lanes
+        self.t = np.full((k,), np.nan)
+        self.y = np.array(y0, copy=True)
+        self.status = np.full((k,), -1, dtype=np.int32)
+        self.n_acc = np.zeros((k,), dtype=np.int64)
+        self.n_rej = np.zeros((k,), dtype=np.int64)
+        self.stats = None
+        self.observed = None
+        self.remaining = k
+        self.submitted = time.perf_counter()
+        self.stall_s = 0.0
+        self.seq = seq
+
+
+class Scheduler:
+    """Module doc.  ``session`` provides ``request_lanes`` /
+    ``stream`` / ``spec`` (a real :class:`~.session.SolverSession`, or
+    any stub with that surface — the invariant tests use one)."""
+
+    def __init__(self, session, *, max_queue_lanes=None,
+                 idle_timeout=None):
+        self.session = session
+        spec = session.spec
+        self.max_queue_lanes = int(
+            spec.max_queue_lanes if max_queue_lanes is None
+            else max_queue_lanes)
+        self.idle_timeout = float(
+            spec.idle_timeout_s if idle_timeout is None else idle_timeout)
+        self._cond = threading.Condition()
+        self._queues = {}            # pack key -> deque[_Work]
+        self._queued_lanes = 0
+        self._inflight_lanes = 0
+        self._draining = False
+        self._closed = False
+        self._seq = 0
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="br-serve-scheduler")
+        self._started = False
+
+    # ---- producer side ----------------------------------------------------
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._worker.start()
+        return self
+
+    def submit(self, request):
+        """Queue one validated request; returns its ``Future`` (resolves
+        to a :class:`RequestResult`).  Raises :class:`Overloaded` /
+        :class:`Draining` — the caller maps those onto 503 responses."""
+        rec = getattr(self.session, "recorder", None)
+        # pack lanes OUTSIDE the lock (y0 construction does real work);
+        # an invalid composition raises here, before anything is queued
+        y0, cfg = self.session.request_lanes(request)
+        with self._cond:
+            if self._draining or self._closed:
+                if rec is not None:
+                    rec.counter("serve_rejects_draining")
+                raise Draining("scheduler is draining; request refused")
+            if self._queued_lanes + request.n_lanes > self.max_queue_lanes:
+                if rec is not None:
+                    rec.counter("serve_rejects_overload")
+                raise Overloaded(
+                    f"admission queue full ({self._queued_lanes} + "
+                    f"{request.n_lanes} lanes > bound "
+                    f"{self.max_queue_lanes}); retry with backoff")
+            work = _Work(request, y0, cfg, self._seq)
+            self._seq += 1
+            self._queues.setdefault(request.pack_key(),
+                                    collections.deque()).append(work)
+            self._queued_lanes += request.n_lanes
+            if rec is not None:
+                rec.counter("serve_requests")
+                rec.counter("serve_lanes", request.n_lanes)
+            self._publish_locked()
+            self._cond.notify_all()
+        return work.future
+
+    def drain(self, timeout=None):
+        """Stop accepting, answer everything accepted, stop the worker.
+        Returns True when the queue fully drained within ``timeout``."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        if not self._started:
+            # no worker ever ran: anything queued can never be served —
+            # answer it loudly rather than stranding the futures
+            with self._cond:
+                stranded = [w for q in self._queues.values() for w in q]
+                self._queues.clear()
+                self._queued_lanes = 0
+                self._closed = True
+            for w in stranded:
+                w.future.set_exception(Draining(
+                    "scheduler closed before it ever started"))
+            return True
+        self._worker.join(timeout)
+        done = not self._worker.is_alive()
+        with self._cond:
+            self._closed = True
+        return done
+
+    close = drain
+
+    def depth(self):
+        """(queued_lanes, inflight_lanes) — the backpressure gauges."""
+        with self._cond:
+            return self._queued_lanes, self._inflight_lanes
+
+    def _publish_locked(self):
+        reg = getattr(self.session, "registry", None)
+        if reg is None:
+            return
+        reg.publish("serve", gauges={
+            "serve_queue_lanes": int(self._queued_lanes),
+            "serve_inflight_lanes": int(self._inflight_lanes),
+            "serve_pending_requests": int(
+                sum(len(q) for q in self._queues.values())),
+            "serve_draining": int(self._draining)})
+
+    # ---- worker side ------------------------------------------------------
+    def _next_key_locked(self):
+        """The pack key of the oldest queued request (FIFO fairness
+        across keys), or None."""
+        best = None
+        for key, q in self._queues.items():
+            if q and (best is None or q[0].seq < best[1]):
+                best = (key, q[0].seq)
+        return best[0] if best else None
+
+    def _run(self):
+        while True:
+            with self._cond:
+                key = self._next_key_locked()
+                while key is None and not self._draining:
+                    self._cond.wait()
+                    key = self._next_key_locked()
+                if key is None:       # draining and empty: done
+                    self._publish_locked()
+                    break
+            self._run_epoch(key)
+        with self._cond:
+            self._publish_locked()
+
+    def _pop_work_locked(self, key, n_space):
+        """Pop whole queued requests of ``key`` up to ~``n_space`` lanes
+        (always at least one when any is queued) — the rest stays
+        QUEUED, which is what keeps the ``max_queue_lanes`` bound
+        meaningful while a stream is resident."""
+        q = self._queues.get(key)
+        works, lanes = [], 0
+        while q and (not works or lanes + q[0].request.n_lanes
+                     <= max(int(n_space), 1)):
+            w = q.popleft()
+            works.append(w)
+            lanes += w.request.n_lanes
+        if q is not None and not q:
+            del self._queues[key]
+        self._queued_lanes -= lanes
+        self._inflight_lanes += lanes
+        if works:
+            self._publish_locked()
+        return works
+
+    def _run_epoch(self, key):
+        """One resident stream over one pack key (module doc)."""
+        from ..resilience import inject
+
+        rec = getattr(self.session, "recorder", None)
+        if rec is not None:
+            rec.counter("serve_epochs")
+        t1, rtol, atol = key
+        gid_map = []      # gid -> (_Work, lane offset); driver gids are
+        #                   append-order over (initial backlog + feeds)
+        epoch_works = []
+
+        def _admit(works):
+            for w in works:
+                w.stall_s = inject.slow_request_delay(w.request.id)
+                epoch_works.append(w)
+                for off in range(w.request.n_lanes):
+                    gid_map.append((w, off))
+
+        def _stack(works):
+            y0 = np.concatenate([w.y0 for w in works])
+            cfg = {k: np.concatenate([np.asarray(w.cfg[k])
+                                      for w in works])
+                   for k in works[0].cfg}
+            return y0, cfg
+
+        # seed the epoch with ~one resident program's worth of lanes;
+        # the rest stays queued and flows in through the feed
+        cap = getattr(self.session, "bucket_cap", None)
+        coalesce = float(getattr(self.session.spec, "coalesce_s", 0.0)
+                         or 0.0)
+        with self._cond:
+            if coalesce > 0:
+                # batching window (SessionSpec.coalesce_s): give
+                # concurrent arrivals a beat to fill the resident
+                # program before the seed is cut — counted against
+                # THIS epoch's pack key (other keys' lanes cannot ride
+                # this program and must not cut its window short)
+                def _key_lanes():
+                    return sum(w.request.n_lanes
+                               for w in self._queues.get(key, ()))
+
+                deadline = time.monotonic() + coalesce
+                while (_key_lanes() < (cap or 1)
+                       and not self._draining):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+            seed = self._pop_work_locked(
+                key, cap if cap else self.max_queue_lanes)
+            if not seed:    # drained away while coalescing
+                return
+        _admit(seed)
+        y0s, cfgs = _stack(seed)
+
+        def feed(n_space, idle):
+            with self._cond:
+                deadline = time.monotonic() + self.idle_timeout
+                while True:
+                    works = self._pop_work_locked(key, n_space)
+                    if works:
+                        break
+                    other = any(k != key and q
+                                for k, q in self._queues.items())
+                    if self._draining or other:
+                        return None     # rotate / drain: close the feed
+                    if not idle:
+                        return (np.zeros((0,) + y0s.shape[1:]),
+                                {k: np.zeros((0,))
+                                 for k in cfgs})
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return None     # idle past the timeout: release
+                        #                 the resident program
+                    self._cond.wait(left)
+            _admit(works)
+            return _stack(works)
+
+        def on_harvest(gids, payload):
+            finished = []
+            for row, gid in enumerate(np.asarray(gids)):
+                w, off = gid_map[int(gid)]
+                w.t[off] = payload["t"][row]
+                w.y[off] = payload["y"][row]
+                w.status[off] = payload["status"][row]
+                w.n_acc[off] = payload["n_accepted"][row]
+                w.n_rej[off] = payload["n_rejected"][row]
+                if "stats" in payload:
+                    if w.stats is None:
+                        w.stats = {
+                            k: np.zeros((w.request.n_lanes,)
+                                        + np.asarray(v).shape[1:],
+                                        dtype=np.asarray(v).dtype)
+                            for k, v in payload["stats"].items()}
+                    for k, v in payload["stats"].items():
+                        w.stats[k][off] = np.asarray(v)[row]
+                if "observed" in payload:
+                    if w.observed is None:
+                        w.observed = {
+                            k: np.zeros((w.request.n_lanes,)
+                                        + np.asarray(v).shape[1:],
+                                        dtype=np.asarray(v).dtype)
+                            for k, v in payload["observed"].items()}
+                    for k, v in payload["observed"].items():
+                        w.observed[k][off] = np.asarray(v)[row]
+                w.remaining -= 1
+                if w.remaining == 0:
+                    finished.append(w)
+            for w in finished:
+                self._resolve(w)
+
+        try:
+            self.session.stream(y0s, cfgs, t1=t1, rtol=rtol, atol=atol,
+                                on_harvest=on_harvest, feed=feed)
+        except BaseException as e:  # noqa: BLE001 — an epoch must not
+            #                         kill the scheduler thread; every
+            #                         admitted request is answered
+            if rec is not None:
+                rec.event("fault", kind="serve_epoch_error",
+                          error=f"{type(e).__name__}: {e}")
+        finally:
+            # a stream that died (or a driver bug) must still answer
+            # every admitted request exactly once
+            for w in epoch_works:
+                if not w.future.done():
+                    self._fail(w, RuntimeError(
+                        "serving stream ended before this request "
+                        "harvested (see the daemon's fault events)"))
+
+    def _settle_locked(self, w):
+        self._inflight_lanes -= w.request.n_lanes
+        self._publish_locked()
+
+    def _resolve(self, w):
+        from ..solver.sdirk import SUCCESS
+
+        if w.stall_s:
+            # deterministic slow_request fault injection: the stall sits
+            # between admission and harvest-resolution, exactly where a
+            # slow consumer would (resilience/inject.py)
+            rec = getattr(self.session, "recorder", None)
+            if rec is not None:
+                rec.counter("serve_stalls")
+                rec.event("fault", kind="slow_request",
+                          request=w.request.id, delay_s=w.stall_s)
+            time.sleep(w.stall_s)
+        prov = ["success" if int(c) == int(SUCCESS) else "failed"
+                for c in w.status]
+        result = RequestResult(
+            request=w.request, t=w.t, y=w.y, status=w.status,
+            n_accepted=w.n_acc, n_rejected=w.n_rej, stats=w.stats,
+            observed=w.observed, provenance=prov,
+            elapsed_s=time.perf_counter() - w.submitted)
+        with self._cond:
+            self._settle_locked(w)
+        rec = getattr(self.session, "recorder", None)
+        if rec is not None:
+            rec.counter("serve_answered")
+            rec.counter("serve_latency_s",
+                        time.perf_counter() - w.submitted)
+        w.future.set_result(result)
+
+    def _fail(self, w, exc):
+        with self._cond:
+            self._settle_locked(w)
+        rec = getattr(self.session, "recorder", None)
+        if rec is not None:
+            rec.counter("serve_failed")
+        w.future.set_exception(exc)

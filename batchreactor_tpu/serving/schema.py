@@ -1,0 +1,226 @@
+"""Versioned request/response schema of the serving plane.
+
+One request asks the daemon to solve ``k >= 1`` reactor conditions (the
+request's *lanes*) to a common horizon under common tolerances — the
+programmatic ``batch_reactor_sweep`` tuple ``(T, p, X, t1, rtol/atol)``
+as JSON.  Validation follows the ``api.py`` loudness convention: every
+malformed field is a specific ``ValueError`` naming the field and the
+expected grammar, unknown keys are rejected (a typo'd knob must not be
+silently ignored), and the validated form is a frozen :class:`Request`
+the scheduler packs from — nothing downstream re-checks.
+
+Request JSON (``POST /solve`` body, or one stdin-JSONL line)::
+
+    {"v": 1,                      # optional; must be 1 when present
+     "id": "run-42/7",            # optional; the server assigns one
+     "T": 1100.0 | [..k..],       # K       (scalars broadcast over lanes)
+     "p": 101325.0 | [..k..],     # Pa      (optional, default 1e5)
+     "X": {"H2": 0.3, ...},       # mole fractions, scalar or [..k..]
+     "t1": 0.05,                  # s, the integration horizon
+     "rtol": 1e-6, "atol": 1e-10, # optional (session defaults); NOTE: a
+                                  # non-default pair compiles a new
+                                  # program on first use (docs/serving.md)
+     "Asv": 1.0,                  # optional surface-coupling parameter
+     "n_save": 0}                 # optional; only 0 is accepted — the
+                                  # admission gear streams final states,
+                                  # not trajectories (loud error)
+
+Responses are ``{"v": 1, "id": ..., "status": "ok" | "error", ...}``:
+``ok`` carries per-lane ``t`` / ``solver_status`` / ``provenance`` /
+final mole fractions ``x`` (+ ``tau`` when the session runs an ignition
+observer, and solver counter ``stats`` when it runs instrumented);
+``error`` carries ``{"code", "message"}`` with the codes ``invalid``
+(schema/species rejection), ``overloaded`` (admission-control
+backpressure — the queue bound is a promise, never silent queueing),
+``draining`` (SIGTERM received; in-flight work still answers), and
+``internal`` (the stream died under the request).  Nothing here imports
+jax — the schema is shared by the client, the jsonl front-end, and the
+scheduler tests.
+"""
+
+import dataclasses
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+
+#: the only keys a request may carry (anything else is a loud error)
+_REQUEST_KEYS = ("v", "id", "T", "p", "X", "t1", "rtol", "atol", "Asv",
+                 "n_save")
+
+#: error codes a response may carry
+ERROR_CODES = ("invalid", "overloaded", "draining", "internal")
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """A validated solve request: per-lane condition arrays (all
+    broadcast to ``n_lanes``) plus the scalar pack key ``(t1, rtol,
+    atol)`` the scheduler coalesces on."""
+
+    id: str
+    T: np.ndarray          # (k,) float64, K
+    p: np.ndarray          # (k,) float64, Pa
+    Asv: np.ndarray        # (k,) float64
+    X: dict                # {species: (k,) float64}
+    t1: float
+    rtol: float
+    atol: float
+
+    @property
+    def n_lanes(self):
+        return int(self.T.shape[0])
+
+    def pack_key(self):
+        """Requests sharing this key can ride one resident stream: t1
+        is a traced operand of the shared program, rtol/atol are static
+        (a distinct pair is a distinct compiled program)."""
+        return (self.t1, self.rtol, self.atol)
+
+
+def _as_lane_array(name, value, rid):
+    """One condition field -> (k,) float64 (k=1 for scalars), loudly."""
+    try:
+        arr = np.asarray(value, dtype=np.float64)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"request {rid!r}: {name} must be a number or a flat list "
+            f"of numbers; got {value!r}") from None
+    if arr.ndim > 1:
+        raise ValueError(
+            f"request {rid!r}: {name} must be a number or a FLAT list; "
+            f"got shape {arr.shape}")
+    arr = np.atleast_1d(arr)
+    if arr.size == 0:
+        raise ValueError(f"request {rid!r}: {name} must not be empty")
+    if not np.all(np.isfinite(arr)):
+        raise ValueError(
+            f"request {rid!r}: {name} must be finite; got {value!r}")
+    return arr
+
+
+def _positive_scalar(name, value, rid):
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(f"request {rid!r}: {name} must be a number; "
+                         f"got {value!r}") from None
+    if not np.isfinite(v) or v <= 0:
+        raise ValueError(f"request {rid!r}: {name} must be a finite "
+                         f"positive number; got {value!r}")
+    return v
+
+
+def validate_request(obj, *, species=None, rtol_default=1e-6,
+                     atol_default=1e-10, default_id=None,
+                     max_lanes=None):
+    """Validate one request JSON object into a :class:`Request` (module
+    doc grammar); every rejection is a ``ValueError`` naming the field.
+
+    ``species`` (the session's gas species tuple) makes unknown ``X``
+    keys a validation error here instead of a failure deep in lane
+    packing; ``max_lanes`` bounds one request's lane count (a request
+    larger than the whole admission queue could never be accepted).
+    """
+    if not isinstance(obj, dict):
+        raise ValueError(f"request must be a JSON object; got "
+                         f"{type(obj).__name__}")
+    unknown = sorted(set(obj) - set(_REQUEST_KEYS))
+    if unknown:
+        raise ValueError(f"unknown request key(s) {unknown}; known keys: "
+                         f"{list(_REQUEST_KEYS)}")
+    v = obj.get("v", SCHEMA_VERSION)
+    if v != SCHEMA_VERSION:
+        raise ValueError(f"unsupported schema version {v!r} (this server "
+                         f"speaks v{SCHEMA_VERSION})")
+    rid = obj.get("id", default_id)
+    if rid is None:
+        raise ValueError("request needs an 'id' (or the caller must "
+                         "supply default_id)")
+    rid = str(rid)
+
+    for key in ("T", "X", "t1"):
+        if key not in obj:
+            raise ValueError(f"request {rid!r}: missing required key "
+                             f"{key!r}")
+    T = _as_lane_array("T", obj["T"], rid)
+    if np.any(T <= 0):
+        raise ValueError(f"request {rid!r}: T must be positive Kelvin")
+    p = _as_lane_array("p", obj.get("p", 1e5), rid)
+    if np.any(p <= 0):
+        raise ValueError(f"request {rid!r}: p must be positive Pa")
+    Asv = _as_lane_array("Asv", obj.get("Asv", 1.0), rid)
+
+    X_in = obj["X"]
+    if not isinstance(X_in, dict) or not X_in:
+        raise ValueError(f"request {rid!r}: X must be a non-empty "
+                         f"{{species: fraction}} object")
+    X = {}
+    for name, val in X_in.items():
+        arr = _as_lane_array(f"X[{name}]", val, rid)
+        if np.any(arr < 0):
+            raise ValueError(f"request {rid!r}: X[{name}] must be "
+                             f"non-negative mole fractions")
+        X[str(name)] = arr
+    if species is not None:
+        idx = {s.upper() for s in species}
+        missing = sorted(n for n in X if n.upper() not in idx)
+        if missing:
+            raise ValueError(
+                f"request {rid!r}: composition species {missing} not in "
+                f"the session mechanism (species: {list(species)[:6]}...)")
+
+    # lanes = broadcast of every per-lane field; mismatched non-1
+    # lengths are a packing ambiguity, not a broadcast
+    lengths = {int(a.shape[0])
+               for a in (T, p, Asv, *X.values()) if a.shape[0] != 1}
+    if len(lengths) > 1:
+        raise ValueError(
+            f"request {rid!r}: per-lane fields disagree on lane count "
+            f"{sorted(lengths)}; scalars broadcast, lists must match")
+    k = lengths.pop() if lengths else 1
+    if max_lanes is not None and k > int(max_lanes):
+        raise ValueError(
+            f"request {rid!r}: {k} lanes exceeds the per-request bound "
+            f"{int(max_lanes)}; split the request")
+
+    t1 = _positive_scalar("t1", obj["t1"], rid)
+    rtol = _positive_scalar("rtol", obj.get("rtol", rtol_default), rid)
+    atol = _positive_scalar("atol", obj.get("atol", atol_default), rid)
+    n_save = obj.get("n_save", 0)
+    if n_save not in (0, None):
+        raise ValueError(
+            f"request {rid!r}: n_save={n_save!r} is not supported — the "
+            f"streaming admission gear returns final states only "
+            f"(n_save=0); run a trajectory solve through batch_reactor")
+
+    bcast = (lambda a: np.broadcast_to(a, (k,)).copy()
+             if a.shape[0] == 1 else a)
+    X = {n: bcast(a) for n, a in X.items()}
+    # every lane needs a positive total: a zero-sum composition would
+    # make the initial state 0/0 = NaN (mole_to_mass normalizes by the
+    # mixture mass) — the lane would burn its whole device budget and
+    # answer NaNs, which bare-JSON serializers reject
+    total = sum(X.values())
+    if np.any(total <= 0):
+        bad = int(np.argmax(total <= 0))
+        raise ValueError(
+            f"request {rid!r}: lane {bad} composition sums to "
+            f"{float(total[bad])!r}; mole fractions must sum > 0 on "
+            f"every lane")
+    return Request(id=rid, T=bcast(T), p=bcast(p), Asv=bcast(Asv),
+                   X=X, t1=t1, rtol=rtol, atol=atol)
+
+
+def error_response(rid, code, message):
+    """An ``error`` response object (module doc)."""
+    if code not in ERROR_CODES:
+        raise ValueError(f"unknown error code {code!r}; known: "
+                         f"{ERROR_CODES}")
+    return {"v": SCHEMA_VERSION, "id": rid, "status": "error",
+            "error": {"code": code, "message": str(message)}}
+
+
+def ok_response(rid, payload):
+    """An ``ok`` response object around a per-lane result payload."""
+    return {"v": SCHEMA_VERSION, "id": rid, "status": "ok", **payload}

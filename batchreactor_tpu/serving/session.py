@@ -1,0 +1,406 @@
+"""SolverSession: the warm, device-resident half of the serving daemon.
+
+A session owns everything that must exist BEFORE the first request can
+be answered fast: the frozen mechanism bundle (parsed once), the exact
+sweep callables ``batch_reactor_sweep`` would build (``api._sweep_fns``
+— identical construction => identical traced programs => identical
+AOT/persistent-cache keys), the bucket ladder and solver config, and
+the obs plane (recorder + live registry + a session-wide
+``CompileWatch``).  :meth:`warmup` drives the :mod:`~batchreactor_tpu.
+aot` registry over the ladder — including the streaming compaction
+program via the warmup ``backlog`` knob — so a warmed session serves
+its first request with ``compiles == 0`` (the acceptance surface
+``scripts/serve_bench.py`` and the tier-1 e2e assert).
+
+Sessions are keyed by :attr:`fingerprint` (mechanism fingerprint — the
+same content hash the AOT registry and checkpoint resume trust), so the
+ROADMAP-5 multi-mechanism store is a ``{fingerprint: SolverSession}``
+dict away: everything request-scoped lives in the scheduler, everything
+mechanism-scoped lives here.
+
+The session spec (``serve.json``) is the ONE configuration artifact the
+daemon and ``scripts/warm_cache.py --spec`` share: both resolve it
+through :func:`load_spec` / :meth:`SolverSession.warmup_specs`, so the
+warmer provably bakes the same program keys the server will run
+(mechanism fingerprint x solver flags x ladder — drift is structurally
+impossible, not just discouraged).
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+
+from .schema import Request  # noqa: F401  (re-exported for callers)
+
+#: spec keys, per section — unknown keys are loud errors (the schema.py
+#: convention: a typo'd knob must not be silently ignored)
+_MECH_KEYS = ("mech", "therm")
+_SOLVER_KEYS = ("method", "rtol", "atol", "jac_window", "linsolve",
+                "setup_economy", "stale_tol", "segment_steps",
+                "max_attempts", "stats", "ignition_marker",
+                "ignition_mode")
+_SERVE_KEYS = ("resident", "refill", "buckets", "poll_every",
+               "max_queue_lanes", "idle_timeout_s", "request_timeout_s",
+               "max_lanes_per_request", "coalesce_s")
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionSpec:
+    """A validated serving session spec (``serve.json``).  ``mech`` /
+    ``therm`` are resolved absolute paths; everything else is the
+    solver/serve config with defaults applied."""
+
+    mech: str
+    therm: str
+    # solver config (the sweep flag set — part of every program key)
+    method: str = "bdf"
+    rtol: float = 1e-6
+    atol: float = 1e-10
+    jac_window: object = None        # None = the platform rule
+    linsolve: str = "auto"
+    setup_economy: bool = False
+    stale_tol: float = 0.3
+    segment_steps: int = 64
+    max_attempts: int = 200_000
+    stats: bool = True
+    ignition_marker: object = None
+    ignition_mode: str = "half"
+    # serve config (scheduler/capacity — NOT part of the program keys)
+    resident: int = 8
+    refill: object = 1
+    buckets: object = "pow2"
+    poll_every: int = 1
+    max_queue_lanes: int = 256
+    idle_timeout_s: float = 0.25
+    request_timeout_s: float = 300.0
+    max_lanes_per_request: object = None
+    #: batching window: a fresh epoch waits up to this long for the
+    #: queue to fill one resident program before seeding (the inference
+    #: servers' max-batch-delay knob; 0 = dispatch immediately).  Lanes
+    #: arriving after the seed still join through the live feed.
+    coalesce_s: float = 0.0
+
+
+def load_spec(source):
+    """``serve.json`` -> :class:`SessionSpec`.  ``source`` is a path, a
+    JSON string, or an already-parsed dict; relative mechanism paths
+    resolve against the spec file's directory (a spec checked into a
+    repo keeps working from any CWD).  Unknown keys at any level are
+    loud ``ValueError``s."""
+    base = os.getcwd()
+    if isinstance(source, dict):
+        obj = source
+    else:
+        text = str(source)
+        if text.lstrip().startswith("{"):
+            obj = json.loads(text)
+        else:
+            base = os.path.dirname(os.path.abspath(text))
+            with open(text) as f:
+                obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError(f"session spec must be a JSON object; got "
+                         f"{type(obj).__name__}")
+    unknown = sorted(set(obj) - {"mechanism", "solver", "serve"})
+    if unknown:
+        raise ValueError(f"unknown session-spec section(s) {unknown}; "
+                         f"known: ['mechanism', 'solver', 'serve']")
+    mech_sec = obj.get("mechanism")
+    if not isinstance(mech_sec, dict):
+        raise ValueError("session spec needs a 'mechanism' section "
+                         "{'mech': ..., 'therm': ...}")
+
+    def _section(sec, known, name):
+        unknown = sorted(set(sec) - set(known))
+        if unknown:
+            raise ValueError(f"unknown {name} key(s) {unknown}; known: "
+                             f"{list(known)}")
+        return dict(sec)
+
+    mech_sec = _section(mech_sec, _MECH_KEYS, "mechanism")
+    for key in _MECH_KEYS:
+        if key not in mech_sec:
+            raise ValueError(f"session spec mechanism section needs "
+                             f"{key!r}")
+    kw = {}
+    kw.update(_section(obj.get("solver") or {}, _SOLVER_KEYS, "solver"))
+    kw.update(_section(obj.get("serve") or {}, _SERVE_KEYS, "serve"))
+    if isinstance(kw.get("buckets"), list):
+        kw["buckets"] = tuple(int(b) for b in kw["buckets"])
+    resolve = (lambda p: p if os.path.isabs(p)
+               else os.path.normpath(os.path.join(base, p)))
+    spec = SessionSpec(mech=resolve(mech_sec["mech"]),
+                       therm=resolve(mech_sec["therm"]), **kw)
+    if spec.method not in ("bdf", "sdirk"):
+        raise ValueError(f"session spec: unknown method {spec.method!r}")
+    if int(spec.resident) < 1:
+        raise ValueError(f"session spec: resident must be >= 1, got "
+                         f"{spec.resident!r}")
+    if int(spec.segment_steps) < 1:
+        raise ValueError(f"session spec: segment_steps must be >= 1, "
+                         f"got {spec.segment_steps!r}")
+    if int(spec.max_queue_lanes) < 1:
+        raise ValueError(f"session spec: max_queue_lanes must be >= 1, "
+                         f"got {spec.max_queue_lanes!r}")
+    return spec
+
+
+class SolverSession:
+    """Module doc.  Build with :func:`from_spec` (parses the mechanism)
+    or directly from pre-built ``gm``/``thermo`` objects (tests, and
+    callers that already hold the bundles)."""
+
+    #: serving epochs are open-ended: the stream lives while its feed
+    #: does, so the segment ceiling is a runaway bound, not a budget
+    MAX_SEGMENTS = 1 << 30
+
+    def __init__(self, gm, thermo, spec, recorder=None):
+        from ..aot import mechanism_fingerprint, normalize_buckets, \
+            resolve_bucket
+        from ..api import _sweep_fns, resolve_jac_window
+        from ..obs import CompileWatch, LiveRegistry, Recorder
+
+        self.gm = gm
+        self.thermo = thermo
+        self.spec = spec
+        self.species = tuple(thermo.species)
+        self._sp_idx = {s.upper(): k for k, s in enumerate(self.species)}
+        marker_idx = None
+        if spec.ignition_marker is not None:
+            key = str(spec.ignition_marker).upper()
+            if key not in self._sp_idx:
+                raise ValueError(
+                    f"session spec: ignition_marker "
+                    f"{spec.ignition_marker!r} not in the mechanism")
+            marker_idx = self._sp_idx[key]
+        # the EXACT callables batch_reactor_sweep builds: identical
+        # construction => identical traced programs => identical AOT keys
+        (self.rhs, self.jac, self.observer,
+         self.observer_init) = _sweep_fns(
+            "gas", None, gm, None, thermo, False, True, marker_idx,
+            spec.ignition_mode)
+        self.jac_window = resolve_jac_window(spec.jac_window, spec.method)
+        self.buckets = normalize_buckets(spec.buckets)
+        #: the largest resident program shape the session will run —
+        #: admission packs into at most this many slots
+        self.bucket_cap = resolve_bucket(int(spec.resident), self.buckets)
+        import jax
+
+        self.fingerprint = mechanism_fingerprint(
+            self.rhs, self.jac, self.observer,
+            extra=jax.tree_util.tree_map(repr, self.observer_init))
+        self.recorder = recorder if recorder is not None else Recorder()
+        self.registry = LiveRegistry(
+            recorder=self.recorder,
+            meta={"entry": "serving", "fingerprint": self.fingerprint,
+                  "mech": os.path.basename(spec.mech),
+                  "bucket_cap": self.bucket_cap})
+        self._watch = CompileWatch(recorder=self.recorder,
+                                   default_label="serve-host")
+        self._watch_entered = False
+        self.warmed = None      # list[WarmupResult] after warmup()
+        self._t0 = time.time()
+
+    @classmethod
+    def from_spec(cls, source, recorder=None):
+        import batchreactor_tpu as br
+
+        spec = load_spec(source)
+        gm = br.compile_gaschemistry(spec.mech)
+        th = br.create_thermo(list(gm.species), spec.therm)
+        return cls(gm, th, spec, recorder=recorder)
+
+    # ---- lifecycle --------------------------------------------------------
+    def __enter__(self):
+        if not self._watch_entered:
+            self._watch.__enter__()
+            self._watch_entered = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._watch_entered:
+            self._watch_entered = False
+            self._watch.__exit__(*exc)
+
+    def compile_summary(self):
+        """The session watch's compile/retrace summary (obs.CompileWatch
+        semantics) — the ``compiles == 0`` serving contract reads off
+        this after warmup."""
+        return self._watch.summary()
+
+    def program_compiles(self):
+        """True-XLA-compile counts per ARMED single-program label
+        (``sweep-segment`` / ``sweep-compact``) during this session —
+        THE warm-serving contract: all zeros after :meth:`warmup` (the
+        PR-5 per-label convention; sub-ms host eager-op compiles ride
+        the unarmed ``serve-host`` label and totals instead)."""
+        w = self._watch.summary()
+        return {label: e["compiles"]
+                for label, e in (w.get("by_label") or {}).items()
+                if e.get("single_program")}
+
+    # ---- warmup (the aot/ registry face) ----------------------------------
+    def _stream_flags(self, rtol, atol):
+        """THE sweep flag set — shared verbatim by :meth:`stream` and
+        :meth:`warmup_specs` so the warmed program keys cannot drift
+        from the served ones (every key here shapes the traced
+        program)."""
+        s = self.spec
+        return dict(method=s.method, rtol=float(rtol), atol=float(atol),
+                    jac=self.jac, observer=self.observer,
+                    observer_init=self.observer_init,
+                    jac_window=self.jac_window, linsolve=s.linsolve,
+                    setup_economy=bool(s.setup_economy),
+                    stale_tol=float(s.stale_tol), stats=bool(s.stats),
+                    segment_steps=int(s.segment_steps),
+                    max_attempts=int(s.max_attempts))
+
+    def warmup_specs(self, rtol=None, atol=None):
+        """One ``aot.warmup`` spec per ladder rung <= the resident cap:
+        each warms its rung's segment program AND (``backlog=2`` +
+        ``admission=rung``) the traced compaction/admission step, so a
+        cold daemon's first streamed request compiles nothing."""
+        from ..aot import bucket_ladder
+
+        rtol = self.spec.rtol if rtol is None else rtol
+        atol = self.spec.atol if atol is None else atol
+        # exemplar lane: an equimolar mix over the first two species is
+        # shape-complete (values never enter the program key)
+        y0, cfg_row = self._exemplar()
+        if self.buckets is None:
+            rungs = (self.bucket_cap,)
+        else:
+            rungs = tuple(
+                b for b in bucket_ladder(
+                    range(1, self.bucket_cap + 1), self.buckets)
+                if b <= self.bucket_cap)
+        return [dict(rhs=self.rhs, y0=y0, cfg=cfg_row, lanes=[r],
+                     buckets=self.buckets, backlog=2, admission=r,
+                     refill=1, poll_every=int(self.spec.poll_every),
+                     **self._stream_flags(rtol, atol))
+                for r in rungs]
+
+    def _exemplar(self):
+        """One exemplar (y0, cfg) row for warmup spec construction —
+        only shapes matter, but the values must be solvable (finite
+        density)."""
+        X = np.zeros((1, len(self.species)))
+        X[0, 0] = 1.0
+        y0 = np.asarray(self._solution_vectors(
+            X, np.asarray([1500.0]), np.asarray([1e5])))[0]
+        return y0, {"T": 1500.0, "Asv": 1.0}
+
+    def warmup(self, cache_dir=None, log=None):
+        """Pre-bake the session's program set (:mod:`~batchreactor_tpu.
+        aot` — persistent cache + manifest + in-process dispatch cache).
+        Returns the per-program :class:`aot.WarmupResult` list; after a
+        warm pass a serving stream compiles nothing
+        (:meth:`compile_summary`)."""
+        from ..aot import warmup as aot_warmup
+
+        t0 = time.perf_counter()
+        self.warmed = aot_warmup(self.warmup_specs(), cache_dir=cache_dir,
+                                 log=log)
+        if self.recorder is not None:
+            self.recorder.counter("serve_warmup_s",
+                                  time.perf_counter() - t0)
+        return self.warmed
+
+    # ---- request -> lanes --------------------------------------------------
+    def _solution_vectors(self, X, T, p):
+        import jax.numpy as jnp
+
+        from ..parallel.grid import sweep_solution_vectors
+
+        return sweep_solution_vectors(jnp.asarray(X), self.thermo.molwt,
+                                      jnp.asarray(T), jnp.asarray(p))
+
+    def request_lanes(self, req):
+        """Pack one validated :class:`~.schema.Request` into sweep lane
+        blocks: ``(y0 (k, S) float64, {"T": (k,), "Asv": (k,)})`` —
+        exactly the state construction ``batch_reactor_sweep`` performs,
+        so a served lane and a direct sweep lane are the same numbers."""
+        k = req.n_lanes
+        X = np.zeros((k, len(self.species)))
+        for name, vals in req.X.items():
+            X[:, self._sp_idx[name.upper()]] = vals
+        y0 = np.asarray(self._solution_vectors(X, req.T, req.p))
+        return y0, {"T": np.asarray(req.T, dtype=np.float64),
+                    "Asv": np.asarray(req.Asv, dtype=np.float64)}
+
+    # ---- the resident stream ----------------------------------------------
+    def stream(self, y0s, cfgs, *, t1, rtol, atol, on_harvest=None,
+               feed=None):
+        """Run one resident streaming sweep epoch over the given
+        backlog, with the scheduler's harvest/feed hooks attached
+        (``parallel.ensemble_solve_segmented`` ``_on_harvest``/
+        ``_feed`` contract).  Blocks until the feed closes and every
+        admitted lane harvests."""
+        import jax.numpy as jnp
+
+        from ..parallel.sweep import ensemble_solve_segmented
+
+        s = self.spec
+        return ensemble_solve_segmented(
+            self.rhs, jnp.asarray(y0s), 0.0, float(t1),
+            {k: jnp.asarray(v) for k, v in cfgs.items()},
+            max_segments=self.MAX_SEGMENTS,
+            admission=int(s.resident),
+            refill=s.refill, buckets=self.buckets,
+            poll_every=int(s.poll_every),
+            recorder=self.recorder,
+            watch=self._watch if self._watch_entered else None,
+            live=self.registry, _on_harvest=on_harvest, _feed=feed,
+            **self._stream_flags(rtol, atol))
+
+    # ---- results -> response payload --------------------------------------
+    def fractions(self, y_rows):
+        """Final mole fractions per lane from final-state rows (the
+        ``batch_reactor_sweep`` output math)."""
+        y = np.asarray(y_rows)
+        ng = len(self.species)
+        moles = y[:, :ng] / np.asarray(self.thermo.molwt)
+        return moles / moles.sum(axis=1, keepdims=True)
+
+    def render_result(self, result):
+        """A scheduler :class:`~.scheduler.RequestResult` -> the ``ok``
+        response payload (schema module doc)."""
+        from ..api import _status_str
+
+        x = self.fractions(result.y)
+        payload = {
+            "lanes": int(result.t.shape[0]),
+            "t": [float(v) for v in result.t],
+            "solver_status": [_status_str(c) for c in result.status],
+            "provenance": list(result.provenance),
+            "x": {s: [float(v) for v in x[:, k]]
+                  for k, s in enumerate(self.species)},
+            "n_accepted": [int(v) for v in result.n_accepted],
+            "n_rejected": [int(v) for v in result.n_rejected],
+            "elapsed_ms": round(1e3 * result.elapsed_s, 3),
+        }
+        if result.observed is not None and "tau" in result.observed:
+            payload["tau"] = [float(v) for v in result.observed["tau"]]
+        if result.stats is not None:
+            from ..obs import counters as C
+
+            payload["stats"] = {
+                k: np.asarray(v).tolist() for k, v in result.stats.items()
+                if k not in C.AUDIT_KEYS and k not in C.TIMELINE_KEYS}
+        return payload
+
+    def healthz_extra(self):
+        """Serving fields the daemon folds into ``/healthz``."""
+        w = self.compile_summary()
+        return {"fingerprint": self.fingerprint,
+                "species": len(self.species),
+                "bucket_cap": self.bucket_cap,
+                "warmed": (None if self.warmed is None
+                           else sum(1 for r in self.warmed if r.warm)),
+                "compiles": w.get("compiles"),
+                "program_compiles": sum(self.program_compiles()
+                                        .values()),
+                "uptime_s": round(time.time() - self._t0, 3)}

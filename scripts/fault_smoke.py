@@ -216,12 +216,45 @@ def main(argv=None):
         for k, v in got["counters"].items():
             rec.counter(k, v)
 
+        # 5 — slow request: the serving plane joins the fault tier — a
+        # deterministic stall between a request's admission into the
+        # resident stream and its harvest-resolution (the slow-consumer
+        # scenario).  The daemon still answers EVERY request with
+        # success provenance; the stall shows up as latency on the
+        # victim and as a fault event in the artifact
+        from batchreactor_tpu.serving.client import SolveClient
+        from batchreactor_tpu.serving.scheduler import Scheduler
+        from batchreactor_tpu.serving.server import ServingServer
+        from batchreactor_tpu.serving.session import SolverSession
+
+        fixtures = os.path.join(REPO, "tests", "fixtures")
+        session = SolverSession.from_spec(
+            {"mechanism": {"mech": os.path.join(fixtures, "h2o2.dat"),
+                           "therm": os.path.join(fixtures, "therm.dat")},
+             "solver": {"segment_steps": 64, "stats": True},
+             "serve": {"resident": 4, "refill": 1, "buckets": [4],
+                       "poll_every": 1}}, recorder=rec)
+        inject.arm("slow_request:delay=0.4,request=victim")
+        comp = {"H2": 0.3, "O2": 0.15, "N2": 0.55}
+        with session:
+            sched = Scheduler(session)
+            with ServingServer(session, sched) as srv:
+                client = SolveClient(srv.url)
+                rs = [client.solve({"id": rid, "T": [1150.0 + 50.0 * i],
+                                    "X": comp, "t1": 5e-5})
+                      for i, rid in enumerate(["pre", "victim", "post"])]
+        assert all(r["provenance"] == ["success"] for r in rs), rs
+        assert rs[1]["elapsed_ms"] >= 400, rs[1]["elapsed_ms"]
+        print(f"[fault-smoke] slow request: victim stalled "
+              f"{rs[1]['elapsed_ms']:.0f}ms between admission and "
+              f"harvest, all 3 answered success", file=sys.stderr)
+
     disarm_flight()
     rep = report.build_report(recorder=rec,
                               meta={"smoke": "fault-injection",
                                     "faults": ["hang_fetch",
                                                "corrupt_chunk", "nan_lane",
-                                               "kill"]})
+                                               "kill", "slow_request"]})
     export.write_jsonl(args.out, rep)
     _spans, events, counters = rec.snapshot()
     kinds = sorted({e["attrs"].get("kind") for e in events
@@ -230,7 +263,7 @@ def main(argv=None):
                       "counters": counters}))
     # the artifact must carry every injected fault kind
     missing = {"hung_fetch", "corrupt_chunk", "lane_quarantine",
-               "dead_host_reassign"} - set(kinds)
+               "dead_host_reassign", "slow_request"} - set(kinds)
     assert not missing, f"fault kinds missing from the artifact: {missing}"
     return 0
 

@@ -122,6 +122,21 @@ docs/performance.md "Newton setup economy") reuse the carried
 factorization across `jac_window` boundaries until `|c/c0 - 1| >
 stale_tol` (default 0.3) or a Newton convergence failure forces a
 refresh."""),
+    ("Serving", "batchreactor_tpu.serving",
+     ["validate_request", "Request", "error_response", "ok_response",
+      "load_spec", "SessionSpec", "SolverSession", "Scheduler",
+      "RequestResult", "Overloaded", "Draining", "ServingServer",
+      "serve_jsonl", "SolveClient", "ServeError", "poisson_trace"],
+     """\
+Sweep-as-a-service (docs/serving.md): a resident daemon answering a
+live stream of `(T, p, X, t1, rtol/atol)` requests from one warm,
+continuously-batched device program — warm AOT executables
+(`scripts/warm_cache.py --spec serve.json`), the streaming admission
+driver's live feed (`parallel/sweep.py` `_feed`/`_on_harvest`),
+explicit `overloaded`/`draining` backpressure, SIGTERM graceful drain,
+and the live `/metrics` plane.  Entry points: `scripts/serve.py`
+(HTTP + stdin-JSONL) and `scripts/serve_bench.py` (seeded Poisson
+load, the round-10 latency/throughput evidence)."""),
     ("Kinetics kernels", "batchreactor_tpu.ops.rhs",
      ["make_gas_rhs", "make_gas_jac", "make_surface_rhs",
       "make_surface_jac", "make_udf_rhs"]),

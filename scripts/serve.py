@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""The resident solver daemon: sweep-as-a-service (docs/serving.md).
+
+Loads a session spec (``serve.json`` — the SAME file
+``scripts/warm_cache.py --spec`` pre-bakes programs for), warms the AOT
+program set, and serves a live request stream from one warm,
+continuously-batched device program:
+
+  # HTTP daemon on an ephemeral port (the bound port prints as JSON)
+  python scripts/serve.py --spec serve.json
+
+  # fixed port, skip in-process warmup (a warmed persistent cache
+  # makes the first request cheap anyway)
+  python scripts/serve.py --spec serve.json --port 8371 --no-warmup
+
+  # stdin-JSONL mode: one request per line in, one response per line
+  # out (out-of-order; correlate by id).  Drain contract is EOF (close
+  # stdin); SIGTERM keeps its default disposition here, dumping the
+  # flight ring before terminating
+  python scripts/serve.py --spec serve.json --jsonl < requests.jsonl
+
+Endpoints: ``POST /solve`` (schema: docs/serving.md), ``GET /healthz``,
+``GET /metrics`` (the PR-9 live plane — ``br_sweep_occupancy`` and the
+``serve_*`` queue gauges move between mid-flight scrapes).  In HTTP
+mode SIGTERM (or SIGINT) drains: in-flight and queued requests are
+answered, new ones are rejected with ``draining``, the flight recorder
+dumps a ``flight_*.jsonl`` postmortem, and the process exits 0 — run
+it under ``resilience.run_guarded`` (SIGTERM-with-grace) like every
+supervised driver in this repo.  In JSONL mode the drain trigger is
+EOF (the parent owns stdin); SIGTERM terminates with a flight dump.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--spec", required=True,
+                    help="session spec JSON (serve.json — shared with "
+                         "warm_cache.py --spec)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="HTTP port (0 = ephemeral; the bound port is "
+                         "printed in the startup JSON line)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--jsonl", action="store_true",
+                    help="stdin-JSONL mode instead of HTTP")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the in-process AOT warmup pass")
+    ap.add_argument("--cache-dir",
+                    default=os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+                    help="persistent compilation cache directory")
+    ap.add_argument("--flight-dir", default=".",
+                    help="directory for flight_*.jsonl postmortem dumps")
+    args = ap.parse_args(argv)
+
+    # the cache dir must be pinned BEFORE jax compiles anything
+    from batchreactor_tpu import aot
+
+    if args.cache_dir:
+        aot.configure_cache(args.cache_dir)
+
+    from batchreactor_tpu.obs.live import arm_flight, flight_dump
+    from batchreactor_tpu.serving.scheduler import Scheduler
+    from batchreactor_tpu.serving.server import ServingServer, serve_jsonl
+    from batchreactor_tpu.serving.session import SolverSession
+
+    session = SolverSession.from_spec(args.spec)
+    if not args.no_warmup:
+        session.warmup(cache_dir=args.cache_dir,
+                       log=lambda m: print(m, file=sys.stderr))
+    scheduler = Scheduler(session)
+
+    # HTTP mode drains on SIGTERM/SIGINT: OUR handler goes in first,
+    # then arm_flight wraps it — the SIGTERM path therefore dumps the
+    # flight ring and THEN chains into the drain trigger (the handler
+    # only sets an event; the heavy teardown runs on the main thread).
+    # JSONL mode's drain contract is EOF instead — the parent owns
+    # stdin, and a blocked readline cannot observe an event — so the
+    # signal dispositions stay default there (SIGTERM still dumps the
+    # flight ring via arm_flight's handler before terminating).
+    stop = threading.Event()
+
+    def _on_term(_signum, _frame):
+        stop.set()
+
+    if not args.jsonl:
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+    arm_flight(recorder=session.recorder, dir=args.flight_dir,
+               install_signal=True)
+
+    with session:
+        if args.jsonl:
+            scheduler.start()
+            accepted, rejected = serve_jsonl(session, scheduler,
+                                             sys.stdin, sys.stdout)
+            print(json.dumps({"served": {"accepted": accepted,
+                                         "rejected": rejected,
+                                         "compiles": session
+                                         .compile_summary()["compiles"]}}),
+                  file=sys.stderr)
+            return 0
+        with ServingServer(session, scheduler, port=args.port,
+                           host=args.host) as srv:
+            print(json.dumps({"serving": {
+                "url": srv.url, "port": srv.port, "pid": os.getpid(),
+                "fingerprint": session.fingerprint,
+                "bucket_cap": session.bucket_cap,
+                "warmed": (None if session.warmed is None else
+                           [r.key for r in session.warmed])}}),
+                  flush=True)
+            stop.wait()
+            print("[serve] drain requested; answering in-flight work",
+                  file=sys.stderr)
+            # ServingServer.close drains the scheduler (every accepted
+            # request answers) before stopping the HTTP thread
+        flight_dump("serve-drain")
+        w = session.compile_summary()
+        print(json.dumps({"drained": {
+            "compiles": w["compiles"], "retraces": w["retraces"]}}),
+            file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Serving load generator: seeded Poisson trace -> cond/s + latency.
+
+The PERF.md round-10 evidence format for the serving plane: stand up a
+daemon (in-process by default, over REAL localhost HTTP; ``--url``
+targets an external one), warm its AOT program set, fire a SEEDED
+open-loop Poisson request trace through ``serving.client``, and report
+sustained cond/s, p50/p95/p99 latency, scheduler rejections, and the
+``compiles == 0`` check over the serving window.
+
+  # 40 requests at ~20 req/s against the vendored h2o2 spec
+  python scripts/serve_bench.py --spec tests/fixtures/serve_h2o2.json \\
+      --requests 40 --rate 20 --seed 0 --out /tmp/serve_bench.json
+
+  # CI smoke flags: scrape /metrics mid-trace, require every request
+  # answered with per-lane success provenance
+  python scripts/serve_bench.py --spec ... --scrape-out /tmp/serve.prom \\
+      --require-success
+
+The trace randomizes T within ``--T-lo/--T-hi`` and lane counts within
+``--lanes`` (e.g. ``1,4``) from the seed's own rng, so two runs of one
+seed issue identical schedules AND identical conditions — a throughput
+delta is the server's, not the load's.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--spec", help="session spec JSON (required unless "
+                                   "--url targets a running daemon)")
+    ap.add_argument("--url", help="bench an already-running daemon "
+                                  "instead of standing one up")
+    ap.add_argument("--requests", type=int, default=40)
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="mean request arrivals per second")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--lanes", default="1,4",
+                    help="lane-count choices per request, comma list")
+    ap.add_argument("--T-lo", type=float, default=1100.0)
+    ap.add_argument("--T-hi", type=float, default=1500.0)
+    ap.add_argument("--comp", default="H2=0.3,O2=0.15,N2=0.55",
+                    help="inlet mole fractions, SP=x comma-separated")
+    ap.add_argument("--t1", type=float, default=5e-5,
+                    help="integration horizon per request [s]")
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--cache-dir",
+                    default=os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+    ap.add_argument("--out", help="write the summary JSON here too")
+    ap.add_argument("--scrape-out",
+                    help="save a MID-TRACE /metrics scrape here (the CI "
+                         "serve-smoke artifact)")
+    ap.add_argument("--require-success", action="store_true",
+                    help="exit 1 unless every request is answered ok "
+                         "with all-success per-lane provenance")
+    args = ap.parse_args(argv)
+    if not args.url and not args.spec:
+        ap.error("--spec (in-process daemon) or --url (external) needed")
+
+    from batchreactor_tpu.serving.client import (SolveClient,
+                                                 poisson_trace,
+                                                 run_trace, summarize)
+
+    comp = {}
+    for part in args.comp.split(","):
+        name, _, val = part.partition("=")
+        comp[name.strip()] = float(val)
+    lane_choices = [int(v) for v in args.lanes.split(",")]
+
+    def make_request(i, rng):
+        k = rng.choice(lane_choices)
+        return {"id": f"bench-{args.seed}-{i}",
+                "T": [round(rng.uniform(args.T_lo, args.T_hi), 3)
+                      for _ in range(k)],
+                "X": comp, "t1": args.t1}
+
+    trace = poisson_trace(args.requests, args.rate, args.seed,
+                          make_request)
+
+    session = server = None
+    if args.url:
+        url = args.url
+    else:
+        from batchreactor_tpu import aot
+
+        if args.cache_dir:
+            aot.configure_cache(args.cache_dir)
+        from batchreactor_tpu.serving.scheduler import Scheduler
+        from batchreactor_tpu.serving.server import ServingServer
+        from batchreactor_tpu.serving.session import SolverSession
+
+        session = SolverSession.from_spec(args.spec)
+        if not args.no_warmup:
+            session.warmup(cache_dir=args.cache_dir,
+                           log=lambda m: print(m, file=sys.stderr))
+        session.__enter__()
+        server = ServingServer(session, Scheduler(session)).start()
+        url = server.url
+
+    client = SolveClient(url)
+    scrapes = []
+    answered = [0]
+
+    def on_result(_rec):
+        answered[0] += 1
+        # one mid-trace scrape once the stream is demonstrably hot
+        if args.scrape_out and len(scrapes) < 1 and answered[0] >= max(
+                2, args.requests // 4):
+            try:
+                scrapes.append(client.metrics())
+            except OSError:
+                pass
+
+    print(f"[serve-bench] {args.requests} requests @ ~{args.rate}/s "
+          f"(seed {args.seed}) -> {url}", file=sys.stderr)
+    t0 = time.perf_counter()
+    records = run_trace(client, trace, on_result=on_result)
+    wall = time.perf_counter() - t0
+    if args.scrape_out and not scrapes:
+        try:
+            scrapes.append(client.metrics())
+        except OSError:
+            pass
+
+    summary = summarize(records, wall)
+    summary["seed"] = args.seed
+    summary["rate_hz"] = args.rate
+    summary["t1"] = args.t1
+    all_success = all(
+        r and r["ok"]
+        and all(p == "success"
+                for p in (r["response"] or {}).get("provenance", ["x"]))
+        for r in records)
+    summary["all_success"] = bool(all_success)
+
+    if server is not None:
+        server.close()
+        w = session.compile_summary()
+        # program_compiles is the warm-serving contract (0 after
+        # warmup); "compiles" totals additionally count sub-ms host
+        # eager-op programs on the unarmed serve-host label
+        summary["program_compiles"] = session.program_compiles()
+        summary["compiles"] = w["compiles"]
+        summary["retraces"] = w["retraces"]
+        summary["cache_hits"] = w["cache_hits"]
+        session.__exit__(None, None, None)
+    if scrapes and args.scrape_out:
+        with open(args.scrape_out, "w") as fh:
+            fh.write(scrapes[-1])
+        print(f"[serve-bench] mid-trace scrape -> {args.scrape_out}",
+              file=sys.stderr)
+    print(json.dumps(summary, indent=1))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(summary, fh, indent=1)
+    if args.require_success and not all_success:
+        bad = [r["id"] for r in records
+               if not (r and r["ok"])][:8]
+        print(f"[serve-bench] FAILED requests (first 8): {bad}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

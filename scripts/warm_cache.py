@@ -20,11 +20,22 @@ executables are backend-specific.
   # inspect the manifest (no compiles, no device)
   python scripts/warm_cache.py --cache-dir .jax_cache --list
 
+  # warm a SERVING session's program set from its spec file — the same
+  # serve.json scripts/serve.py loads, so the warmer and the daemon
+  # provably share one bucket-ladder/solver-config fingerprint
+  python scripts/warm_cache.py --spec serve.json
+
+  # coverage check: flag manifest entries the serving spec expects but
+  # the cache is missing (or that went stale under a jax upgrade)
+  python scripts/warm_cache.py --spec serve.json --list
+
 Programs key on mechanism fingerprint x solver config x bucket x flag
 set; the warmed flag set must MATCH the session's sweep call (method,
 tolerances, jac_window, segment_steps, telemetry/stats, ignition
 observer) — this CLI mirrors ``batch_reactor_sweep``'s construction
-path exactly, so matching the CLI flags to the sweep kwargs suffices.
+path exactly, so matching the CLI flags to the sweep kwargs suffices;
+``--spec`` goes further and derives the flag set from the daemon's own
+``SolverSession.warmup_specs()``, making drift structurally impossible.
 Non-gas chemistry modes warm through the ``batchreactor_tpu.aot.warmup``
 API directly.
 """
@@ -73,6 +84,71 @@ def list_manifest(cache_dir):
     return 0
 
 
+def warm_from_spec(args):
+    """``--spec serve.json``: derive the warmup specs from the DAEMON'S
+    own session object (serving.session.SolverSession.warmup_specs), so
+    the warmed program keys are the served program keys by
+    construction.  With ``--list``, no compiles: the expected keys
+    (aot.spec_keys — same derivation, no execution) are checked against
+    the manifest and missing/stale entries flagged."""
+    # the cache dir must be pinned BEFORE jax compiles anything
+    from batchreactor_tpu import aot
+
+    aot.configure_cache(args.cache_dir)
+    from batchreactor_tpu.serving.session import SolverSession
+
+    session = SolverSession.from_spec(args.spec)
+    specs = session.warmup_specs()
+    if args.list:
+        man = aot.load_manifest(args.cache_dir)
+        entries = man.get("entries", {})
+        cur_jax = man.get("jax")
+        missing = stale = 0
+        print(f"spec {args.spec}: fingerprint "
+              f"{session.fingerprint[:12]}..., "
+              f"{len(specs)} rungs (cap {session.bucket_cap})")
+        for spec in specs:
+            for key, bucket in aot.spec_keys(spec):
+                e = entries.get(key)
+                if e is None:
+                    print(f"  {key}: bucket={bucket}  [MISSING: the "
+                          f"daemon would compile this]")
+                    missing += 1
+                elif cur_jax is not None and e.get("jax") != cur_jax:
+                    print(f"  {key}: bucket={bucket}  [STALE: warmed "
+                          f"under jax {e.get('jax')}]")
+                    stale += 1
+                else:
+                    print(f"  {key}: bucket={bucket}  warm "
+                          f"(compiles={e['compiles']}, "
+                          f"hits={e['cache_hits']})")
+        if missing or stale:
+            print(f"  {missing} missing / {stale} stale — run "
+                  f"warm_cache.py --spec {args.spec} (no --list)")
+            return 1
+        print("  cache covers the spec")
+        return 0
+    import jax
+
+    print(f"warming serving spec {args.spec} "
+          f"({len(specs)} rungs, cap {session.bucket_cap}) on "
+          f"{jax.default_backend()} (cache: {args.cache_dir})",
+          file=sys.stderr)
+    results = session.warmup(cache_dir=args.cache_dir,
+                             log=lambda m: print(m, file=sys.stderr))
+    warm = sum(r.warm for r in results)
+    print(json.dumps({
+        "programs": len(results),
+        "already_warm": warm,
+        "compiled": len(results) - warm,
+        "compile_s": round(sum(r.compile_s for r in results), 3),
+        "fingerprint": session.fingerprint,
+        "cache_dir": os.path.abspath(args.cache_dir),
+        "keys": [r.key for r in results],
+    }))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="pre-compile canonical bucketed sweep programs into "
@@ -111,13 +187,22 @@ def main(argv=None):
                                            os.path.join(REPO, ".jax_cache")),
                     help="managed persistent-cache directory")
     ap.add_argument("--list", action="store_true",
-                    help="print the cache manifest and exit (no compiles)")
+                    help="print the cache manifest and exit (no compiles); "
+                         "with --spec additionally flag entries the "
+                         "session spec expects but the manifest lacks")
+    ap.add_argument("--spec",
+                    help="warm a serving session's program set from its "
+                         "serve.json (serving.session.load_spec grammar) "
+                         "— the daemon and the warmer then share one "
+                         "fingerprint by construction")
     args = ap.parse_args(argv)
 
+    if args.spec:
+        return warm_from_spec(args)
     if args.list:
         return list_manifest(args.cache_dir)
     if not args.mech or not args.therm:
-        ap.error("--mech and --therm are required (or use --list)")
+        ap.error("--mech and --therm are required (or use --list/--spec)")
 
     # the cache dir must be pinned BEFORE jax compiles anything
     from batchreactor_tpu import aot

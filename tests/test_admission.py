@@ -376,3 +376,87 @@ def test_api_admission_knobs(h2o2):
     assert ctrs["admitted_lanes"] == 2          # 5 lanes, 3 resident
     assert adm["telemetry"]["meta"]["admission"] is True
     assert 0 < ctrs["lane_attempts"] <= ctrs["lane_capacity"]
+
+
+# --------------------------------------------------------------------------
+# live backlog feed (_feed: the serving scheduler's driver hook)
+# --------------------------------------------------------------------------
+class TestLiveFeed:
+    def test_feed_requires_admission(self):
+        y0s, cfgs = _decay_setup(B=4)
+        with pytest.raises(ValueError, match="_feed"):
+            ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                     segment_steps=16,
+                                     _feed=lambda n, idle: None)
+
+    @pytest.mark.parametrize("stats", [False, True])
+    def test_fed_backlog_bit_exact_vs_static(self, stats):
+        """Lanes appended through the live feed solve BIT-EXACT to the
+        same lanes handed over as a static backlog up front (same
+        resident bucket), and land at their sequential global indices
+        — the serving daemon's correctness contract at the driver
+        level."""
+        y0s, cfgs = _decay_setup(B=6)
+        obs, init = _decay_observer()
+        kw = dict(segment_steps=16, max_segments=400, poll_every=1,
+                  admission=2, refill=1, stats=stats, observer=obs,
+                  observer_init=init)
+        ref = ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                       **kw)
+        # live variant: 2 lanes up front, the other 4 arrive through
+        # the feed in two blocks (out of segment-boundary sync with
+        # the static run's admissions — admission timing must not
+        # matter)
+        blocks = [(np.asarray(y0s)[2:4], {"k": np.asarray(cfgs["k"])[2:4]}),
+                  (np.asarray(y0s)[4:6], {"k": np.asarray(cfgs["k"])[4:6]})]
+        calls = {"idle_seen": False}
+
+        def feed(n_space, idle):
+            assert n_space >= 1
+            calls["idle_seen"] |= bool(idle)
+            if blocks:
+                return blocks.pop(0)
+            return None
+
+        live = ensemble_solve_segmented(
+            _decay_rhs, jnp.asarray(np.asarray(y0s)[:2]),
+            0.0, 1.0, {"k": jnp.asarray(np.asarray(cfgs["k"])[:2])},
+            _feed=feed, **kw)
+        assert not blocks          # every block was pulled in
+        _assert_bit_exact(ref, live, "fed vs static backlog")
+
+    def test_feed_zero_rows_while_idle_closes(self):
+        """The block-or-close contract: an idle stream handed 0 rows
+        treats the feed as closed instead of spinning on an empty
+        program."""
+        y0s, cfgs = _decay_setup(B=2)
+        idle_flags = []
+
+        def feed(n_space, idle):
+            idle_flags.append(bool(idle))
+            return (np.zeros((0, 2)), {"k": np.zeros((0,))})
+
+        res = ensemble_solve_segmented(
+            _decay_rhs, y0s, 0.0, 1.0, cfgs, segment_steps=16,
+            max_segments=400, poll_every=1, admission=2, refill=1,
+            _feed=feed)
+        assert np.all(np.asarray(res.status) == SUCCESS)
+        # free slots poll the feed (idle=False, stream still running);
+        # the FIRST idle consultation closes it — exactly one, and last
+        assert idle_flags.count(True) == 1 and idle_flags[-1] is True
+
+    def test_fed_lanes_counter(self):
+        from batchreactor_tpu.obs.recorder import Recorder
+
+        y0s, cfgs = _decay_setup(B=4)
+        blocks = [(np.asarray(y0s)[2:4],
+                   {"k": np.asarray(cfgs["k"])[2:4]})]
+        rec = Recorder()
+        ensemble_solve_segmented(
+            _decay_rhs, jnp.asarray(np.asarray(y0s)[:2]), 0.0, 1.0,
+            {"k": jnp.asarray(np.asarray(cfgs["k"])[:2])},
+            segment_steps=16, max_segments=400, poll_every=1,
+            admission=2, refill=1, recorder=rec,
+            _feed=lambda n, idle: blocks.pop(0) if blocks else None)
+        _s, _e, counters = rec.snapshot()
+        assert counters["fed_lanes"] == 2

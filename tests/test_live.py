@@ -477,3 +477,36 @@ def test_api_timeline_and_live_validation():
                                chem=Chemistry(gaschem=True),
                                thermo_obj=th, md=gm, telemetry=True,
                                timeline=1)
+
+
+def test_two_concurrent_ephemeral_metrics_servers():
+    """port=0 regression (the serving satellite): two servers in one
+    process bind DISTINCT ephemeral ports, each serving its own
+    registry concurrently, and each exposes its bound port on the
+    instance and as a recorder event — so daemons, tests, and CI never
+    collide on a fixed port."""
+    recs = [obs.Recorder(), obs.Recorder()]
+    regs = [L.LiveRegistry(recorder=recs[i], meta={"n": i})
+            for i in range(2)]
+    regs[0].publish("sweep", gauges={"which": 100.0})
+    regs[1].publish("sweep", gauges={"which": 200.0})
+    logs = []
+    with L.MetricsServer(regs[0], port=0) as a, \
+            L.MetricsServer(regs[1], port=0,
+                            log=logs.append) as b:
+        assert a.port != b.port and a.port > 0 and b.port > 0
+        ta = urllib.request.urlopen(a.url + "/metrics",
+                                    timeout=10).read().decode()
+        tb = urllib.request.urlopen(b.url + "/metrics",
+                                    timeout=10).read().decode()
+        assert "br_sweep_which 100.0" in ta
+        assert "br_sweep_which 200.0" in tb
+        hz = json.loads(urllib.request.urlopen(
+            b.url + "/healthz", timeout=10).read())
+        assert hz["meta"] == {"n": 1}
+    # the bound port surfaced in logs and as a recorder event
+    assert logs and "/metrics" in logs[0]
+    for i, srv in enumerate((a, b)):
+        _s, events, _c = recs[i].snapshot()
+        bound = [e for e in events if e["name"] == "metrics_server_bound"]
+        assert len(bound) == 1 and bound[0]["attrs"]["port"] > 0

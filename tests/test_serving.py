@@ -1,0 +1,709 @@
+"""serving/ — the resident sweep-as-a-service daemon (docs/serving.md).
+
+Three tiers, matching the subsystem's layering:
+
+* **schema** — the versioned request grammar's loud validation;
+* **scheduler invariants** against a fake session (no device work):
+  request -> lane packing round-trip, OUT-OF-ORDER harvest resolving
+  the right futures, backpressure rejection at the queue bound,
+  drain-on-shutdown answering every accepted request exactly once,
+  pack-key isolation, the live-feed path, and the ``slow_request``
+  fault injection;
+* **end-to-end over real HTTP** on the vendored h2o2 fixture: N
+  concurrent requests against a live daemon return results BIT-EXACT
+  vs a direct ``batch_reactor_sweep`` call on the same conditions,
+  with ``compiles == 0`` on the armed program labels after warmup
+  (CompileWatch-asserted) and the live gauges observably moving
+  between mid-flight ``/metrics`` scrapes; plus the SIGTERM graceful
+  drain of ``scripts/serve.py`` (subprocess: answers accepted work,
+  rejects new work with ``draining``, exits 0).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from batchreactor_tpu.obs.recorder import Recorder  # noqa: E402
+from batchreactor_tpu.resilience import inject  # noqa: E402
+from batchreactor_tpu.serving import schema  # noqa: E402
+from batchreactor_tpu.serving.scheduler import (Draining,  # noqa: E402
+                                                Overloaded, Scheduler)
+
+
+# --------------------------------------------------------------------------
+# schema
+# --------------------------------------------------------------------------
+def _req(**over):
+    base = {"id": "r1", "T": [1200.0, 1300.0],
+            "X": {"H2": 0.3, "O2": 0.15, "N2": 0.55}, "t1": 1e-4}
+    base.update(over)
+    return base
+
+
+class TestSchema:
+    def test_roundtrip_broadcast(self):
+        r = schema.validate_request(_req(p=2e5, rtol=1e-7))
+        assert r.n_lanes == 2 and r.id == "r1"
+        np.testing.assert_array_equal(r.T, [1200.0, 1300.0])
+        np.testing.assert_array_equal(r.p, [2e5, 2e5])
+        np.testing.assert_array_equal(r.X["H2"], [0.3, 0.3])
+        assert r.pack_key() == (1e-4, 1e-7, 1e-10)
+
+    def test_default_id_and_defaults(self):
+        obj = _req()
+        del obj["id"]
+        r = schema.validate_request(obj, default_id="auto-7",
+                                    rtol_default=2e-6, atol_default=1e-9)
+        assert r.id == "auto-7" and r.rtol == 2e-6 and r.atol == 1e-9
+
+    @pytest.mark.parametrize("mutate,match", [
+        (dict(T=[]), "must not be empty"),
+        (dict(T=-5.0), "positive Kelvin"),
+        (dict(T="hot"), "must be a number"),
+        (dict(T=[[1200.0]]), "FLAT"),
+        (dict(p=0.0), "positive Pa"),
+        (dict(X={}), "non-empty"),
+        (dict(X={"H2": -0.1}), "non-negative"),
+        (dict(X={"H2": 0.0}), "sum"),
+        (dict(X={"H2": [0.3, 0.0]}), "lane 1"),
+        (dict(t1=0.0), "positive"),
+        (dict(n_save=16), "n_save"),
+        (dict(v=2), "schema version"),
+        (dict(bogus=1), "unknown request key"),
+        (dict(T=[1.0, 2.0], p=[1e5, 1e5, 1e5]), "disagree on lane count"),
+    ])
+    def test_loud_validation(self, mutate, match):
+        with pytest.raises(ValueError, match=match):
+            schema.validate_request(_req(**mutate))
+
+    def test_species_check(self):
+        with pytest.raises(ValueError, match="XE"):
+            schema.validate_request(_req(X={"XE": 1.0}),
+                                    species=("H2", "O2", "N2"))
+
+    def test_max_lanes_bound(self):
+        with pytest.raises(ValueError, match="exceeds the per-request"):
+            schema.validate_request(_req(T=[1.0] * 9), max_lanes=8)
+
+    def test_missing_id_without_default(self):
+        obj = _req()
+        del obj["id"]
+        with pytest.raises(ValueError, match="id"):
+            schema.validate_request(obj)
+
+    def test_response_builders(self):
+        ok = schema.ok_response("a", {"lanes": 1})
+        assert ok["status"] == "ok" and ok["v"] == schema.SCHEMA_VERSION
+        err = schema.error_response("a", "overloaded", "full")
+        assert err["error"]["code"] == "overloaded"
+        with pytest.raises(ValueError, match="error code"):
+            schema.error_response("a", "nope", "x")
+
+
+# --------------------------------------------------------------------------
+# scheduler invariants (fake session: no device, no HTTP)
+# --------------------------------------------------------------------------
+from batchreactor_tpu.solver.sdirk import SUCCESS  # noqa: E402
+
+_SPEC = dict(max_queue_lanes=16, idle_timeout_s=0.05, coalesce_s=0.0,
+             rtol=1e-6, atol=1e-10, request_timeout_s=10.0,
+             max_lanes_per_request=None)
+
+
+class FakeSession:
+    """The scheduler-facing session surface (request_lanes / stream /
+    spec / bucket_cap), with a scripted driver: lanes "solve" to
+    ``y0 + 1000`` at ``t = t1``, harvested in a configurable order and
+    chunking — so the un-shuffle bookkeeping is what's under test, not
+    the solver."""
+
+    def __init__(self, harvest="fifo", chunk=3, hold=None, fail=False,
+                 **spec_over):
+        self.spec = types.SimpleNamespace(**{**_SPEC, **spec_over})
+        self.bucket_cap = 4
+        self.recorder = Recorder()
+        self.registry = None
+        self.streams = []          # (t1, rtol, atol) per epoch
+        self.harvest = harvest
+        self.chunk = chunk
+        self.hold = hold           # threading.Event gating the epoch
+        self.fail = fail
+
+    def request_lanes(self, req):
+        k = req.n_lanes
+        # distinctive per-lane payloads: y0 = (T, Asv)
+        y0 = np.stack([np.asarray(req.T), np.asarray(req.Asv)], axis=1)
+        return y0, {"T": np.asarray(req.T), "Asv": np.asarray(req.Asv)}
+
+    def stream(self, y0s, cfgs, *, t1, rtol, atol, on_harvest, feed):
+        self.streams.append((t1, rtol, atol))
+        if self.hold is not None:
+            self.hold.wait(5.0)
+        if self.fail:
+            raise RuntimeError("injected stream death")
+        rows = {g: np.asarray(y0s)[g] for g in range(len(y0s))}
+        pending = list(rows)
+        while True:
+            order = list(pending)
+            if self.harvest == "reverse":
+                order = order[::-1]
+            elif self.harvest == "scramble":
+                order = order[1::2] + order[0::2]
+            for i in range(0, len(order), self.chunk):
+                gids = np.asarray(order[i:i + self.chunk], dtype=np.int64)
+                if not gids.size:
+                    continue
+                k = gids.size
+                on_harvest(gids, {
+                    "t": np.full((k,), t1),
+                    "y": np.stack([rows[g] + 1000.0 for g in gids]),
+                    "status": np.full((k,), int(SUCCESS), dtype=np.int32),
+                    "h": np.full((k,), 1e-6),
+                    "n_accepted": np.full((k,), 7, dtype=np.int64),
+                    "n_rejected": np.zeros((k,), dtype=np.int64)})
+            pending = []
+            if feed is None:
+                break
+            got = feed(4, True)
+            if got is None:
+                break
+            y_new, _cfg_new = got
+            base = len(rows)
+            for j in range(np.asarray(y_new).shape[0]):
+                rows[base + j] = np.asarray(y_new)[j]
+                pending.append(base + j)
+            if not pending:
+                break
+
+
+def _request(rid, T, t1=1e-4, **over):
+    return schema.validate_request(
+        _req(id=rid, T=T, t1=t1, **over))
+
+
+def _results(futures, timeout=10.0):
+    return [f.result(timeout=timeout) for f in futures]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_inject():
+    yield
+    inject.disarm()
+
+
+class TestSchedulerInvariants:
+    def test_packing_round_trip(self):
+        """Requests with distinct lane vectors come back in request
+        lane order, regardless of how they were packed together."""
+        sess = FakeSession()
+        sched = Scheduler(sess).start()
+        futs = [sched.submit(_request("a", [1000.0, 1100.0, 1200.0])),
+                sched.submit(_request("b", [2000.0])),
+                sched.submit(_request("c", [3000.0, 3100.0]))]
+        ra, rb, rc = _results(futs)
+        sched.drain(5.0)
+        np.testing.assert_array_equal(ra.y[:, 0],
+                                      [2000.0, 2100.0, 2200.0])
+        np.testing.assert_array_equal(rb.y[:, 0], [3000.0])
+        np.testing.assert_array_equal(rc.y[:, 0], [4000.0, 4100.0])
+        assert all(p == "success" for r in (ra, rb, rc)
+                   for p in r.provenance)
+        np.testing.assert_array_equal(ra.t, [1e-4] * 3)
+        assert ra.n_accepted.tolist() == [7, 7, 7]
+
+    @pytest.mark.parametrize("order", ["reverse", "scramble"])
+    def test_out_of_order_harvest(self, order):
+        """Harvests arriving in arbitrary gid order (and arbitrary
+        chunking) still resolve each future with ITS lanes, in ITS
+        order."""
+        sess = FakeSession(harvest=order, chunk=2)
+        sched = Scheduler(sess).start()
+        futs = [sched.submit(_request(f"r{i}",
+                                      [1000.0 * (i + 1) + j
+                                       for j in range(1 + i % 3)]))
+                for i in range(5)]
+        res = _results(futs)
+        sched.drain(5.0)
+        for i, r in enumerate(res):
+            np.testing.assert_array_equal(
+                r.y[:, 0], [1000.0 * (i + 1) + j + 1000.0
+                            for j in range(1 + i % 3)])
+
+    def test_backpressure_overloaded(self):
+        """The queue bound rejects loudly (never silent queueing), and
+        everything ACCEPTED is still answered."""
+        hold = threading.Event()
+        sess = FakeSession(hold=hold, max_queue_lanes=4)
+        sched = Scheduler(sess).start()
+        futs = [sched.submit(_request("a", [1000.0, 1100.0]))]
+        # worker may seed "a" into the held epoch; fill the queue with
+        # whatever fits, then the bound must trip
+        accepted = []
+        with pytest.raises(Overloaded):
+            for i in range(9):
+                accepted.append(
+                    sched.submit(_request(f"q{i}", [1500.0 + i])))
+        _s, _e, counters = sess.recorder.snapshot()
+        assert counters["serve_rejects_overload"] >= 1
+        hold.set()
+        for r in _results(futs + accepted):
+            assert all(p == "success" for p in r.provenance)
+        sched.drain(5.0)
+
+    def test_drain_answers_exactly_once_then_rejects(self):
+        hold = threading.Event()
+        sess = FakeSession(hold=hold)
+        sched = Scheduler(sess).start()
+        futs = [sched.submit(_request(f"d{i}", [1000.0 + i]))
+                for i in range(6)]
+        t = threading.Thread(target=lambda: (time.sleep(0.05),
+                                             hold.set()))
+        t.start()
+        drained = sched.drain(10.0)
+        t.join()
+        assert drained
+        res = _results(futs, timeout=1.0)   # all already resolved
+        assert len(res) == 6
+        with pytest.raises(Draining):
+            sched.submit(_request("late", [999.0]))
+        _s, _e, counters = sess.recorder.snapshot()
+        assert counters["serve_answered"] == 6
+        assert counters["serve_rejects_draining"] == 1
+
+    def test_pack_key_isolation(self):
+        """Distinct (t1, rtol, atol) never share an epoch; both keys
+        answer."""
+        sess = FakeSession()
+        sched = Scheduler(sess).start()
+        futs = [sched.submit(_request("a", [1000.0], t1=1e-4)),
+                sched.submit(_request("b", [1001.0], t1=2e-4)),
+                sched.submit(_request("c", [1002.0], t1=1e-4,
+                                      rtol=1e-8))]
+        res = _results(futs)
+        sched.drain(5.0)
+        assert res[0].t[0] == 1e-4 and res[1].t[0] == 2e-4
+        keys = {(t1, rtol) for t1, rtol, _ in sess.streams}
+        assert keys == {(1e-4, 1e-6), (2e-4, 1e-6), (1e-4, 1e-8)}
+
+    def test_feed_joins_resident_epoch(self):
+        """Requests arriving while an epoch is resident ride its live
+        feed instead of a fresh dispatch (idle_timeout holds the
+        stream open)."""
+        sess = FakeSession(idle_timeout_s=1.0)
+        sched = Scheduler(sess).start()
+        f1 = sched.submit(_request("a", [1000.0]))
+        f1.result(5.0)
+        # the epoch is now idle-parked inside feed(); this lands there
+        f2 = sched.submit(_request("b", [2000.0, 2100.0]))
+        r2 = f2.result(5.0)
+        sched.drain(5.0)
+        np.testing.assert_array_equal(r2.y[:, 0], [3000.0, 3100.0])
+        assert len(sess.streams) == 1   # ONE resident epoch served both
+        _s, _e, counters = sess.recorder.snapshot()
+        assert counters["serve_epochs"] == 1
+
+    def test_stream_death_answers_with_error(self):
+        """A dead stream must answer its admitted requests (internal
+        error), not strand their futures — and the scheduler survives
+        for the next epoch."""
+        sess = FakeSession(fail=True)
+        sched = Scheduler(sess).start()
+        fut = sched.submit(_request("a", [1000.0]))
+        with pytest.raises(RuntimeError, match="stream ended"):
+            fut.result(5.0)
+        sess.fail = False
+        ok = sched.submit(_request("b", [1200.0])).result(5.0)
+        assert ok.provenance == ["success"]
+        sched.drain(5.0)
+
+    def test_slow_request_injection(self):
+        """The slow_request fault stalls the matched request between
+        admission and harvest-resolution; everything still answers."""
+        inject.arm("slow_request:delay=0.3,request=slow")
+        sess = FakeSession()
+        sched = Scheduler(sess).start()
+        t0 = time.perf_counter()
+        f_slow = sched.submit(_request("slow", [1000.0]))
+        f_fast = sched.submit(_request("fast", [1100.0]))
+        r_slow = f_slow.result(5.0)
+        f_fast.result(5.0)
+        wall = time.perf_counter() - t0
+        sched.drain(5.0)
+        assert r_slow.provenance == ["success"]
+        assert wall >= 0.3 and r_slow.elapsed_s >= 0.3
+        _s, events, counters = sess.recorder.snapshot()
+        assert counters["serve_stalls"] == 1
+        assert any(e["name"] == "fault"
+                   and e["attrs"].get("kind") == "slow_request"
+                   for e in events)
+
+
+# --------------------------------------------------------------------------
+# end-to-end: real session, real HTTP, vendored h2o2 fixture
+# --------------------------------------------------------------------------
+_COMP = {"H2": 0.3, "O2": 0.15, "N2": 0.55}
+
+
+def _session_spec(lib_dir, segment_steps=8, **serve_over):
+    # segment_steps=8: every lane spans MANY segments, so the live
+    # plane publishes at many poll boundaries — the gauge-motion
+    # assertion below is structural, not a wall-clock race
+    # coalesce_s=2.0: the e2e fires its whole request set concurrently
+    # and compares bit-exact against one direct sweep at the TOP bucket
+    # — the window guarantees every request joins the seed (ends early
+    # once the queue fills the resident program), so a straggler thread
+    # on a loaded runner cannot drop the epoch onto a smaller bucket's
+    # ulp class
+    # single-rung ladder [8]: the bit-exact comparison needs both the
+    # daemon epoch and the direct sweep to run ONE program shape —
+    # the daemon holds its resident bucket while the feed is open (no
+    # up-shift path), while a feed-less direct sweep down-shifts its
+    # drain tail, and down-shifted tails differ at the documented ulp
+    serve = {"resident": 8, "refill": 1, "buckets": [8],
+             "poll_every": 1, "max_queue_lanes": 64,
+             "idle_timeout_s": 0.3, "coalesce_s": 2.0}
+    serve.update(serve_over)
+    return {"mechanism": {"mech": f"{lib_dir}/h2o2.dat",
+                          "therm": f"{lib_dir}/therm.dat"},
+            "solver": {"segment_steps": segment_steps, "stats": True},
+            "serve": serve}
+
+
+@pytest.fixture(scope="module")
+def h2o2_session(lib_dir):
+    from batchreactor_tpu.serving.session import SolverSession
+
+    session = SolverSession.from_spec(_session_spec(lib_dir))
+    session.warmup()
+    with session:
+        yield session
+
+
+class TestServingEndToEnd:
+    def test_http_single_request_bit_exact_and_warm(self, h2o2_session):
+        """Acceptance, deterministic half: one 8-lane request over real
+        HTTP returns results BIT-EXACT vs the direct
+        batch_reactor_sweep on the same conditions (identical packing
+        order => identical lane positions => identical programs), with
+        zero armed-label compiles after warmup."""
+        import batchreactor_tpu as br
+        from batchreactor_tpu.serving.client import SolveClient
+        from batchreactor_tpu.serving.server import ServingServer
+
+        session = h2o2_session
+        N, t1 = 8, 5e-5
+        Ts = [1150.0 + 37.0 * i for i in range(N)]
+        sched = Scheduler(session)
+        with ServingServer(session, sched) as srv:
+            resp = SolveClient(srv.url).solve(
+                {"id": "bitexact", "T": Ts, "X": _COMP, "t1": t1})
+        out = br.batch_reactor_sweep(
+            _COMP, np.asarray(Ts), 1e5, t1,
+            chem=br.Chemistry(gaschem=True), thermo_obj=session.thermo,
+            md=session.gm, segment_steps=8, admission=8, refill=1,
+            buckets=(8,), poll_every=1)
+        assert resp["solver_status"] == ["Success"] * N
+        assert resp["provenance"] == ["success"] * N
+        np.testing.assert_array_equal(resp["t"], np.asarray(out["t"]))
+        for sp in session.species:
+            np.testing.assert_array_equal(
+                resp["x"][sp], np.asarray(out["x"][sp]), err_msg=sp)
+        prog = session.program_compiles()
+        assert all(v == 0 for v in prog.values()), prog
+        assert session.compile_summary()["retraces"] == 0
+
+    def test_http_concurrent_requests_and_live_scrapes(self,
+                                                       h2o2_session):
+        """Acceptance, concurrent half: N concurrent single-lane
+        requests coalesce onto one resident stream; every answer
+        matches the direct sweep to the repo's real-chemistry
+        admission-equivalence convention (rtol 1e-12 — arrival order
+        varies lane positions, the documented cross-position ulp
+        class), and the live gauges observably move between mid-flight
+        /metrics scrapes."""
+        import batchreactor_tpu as br
+        from batchreactor_tpu.serving.client import SolveClient
+        from batchreactor_tpu.serving.server import ServingServer
+
+        session = h2o2_session
+        N, t1 = 8, 5e-5
+        Ts = [1150.0 + 37.0 * i for i in range(N)]
+        # injected stalls spread across the harvests keep the stream
+        # observably in-flight long enough for distinct mid-flight
+        # scrapes (the stall sits in the harvest path — lanes park at
+        # different segments, so successive stalls expose successive
+        # harvested/occupancy states)
+        inject.arm("slow_request:delay=0.06,count=6")
+        sched = Scheduler(session)
+        responses = [None] * N
+        scrapes = []
+        with ServingServer(session, sched) as srv:
+            client = SolveClient(srv.url)
+            stop = threading.Event()
+
+            def scraper():
+                while not stop.is_set():
+                    try:
+                        scrapes.append(client.metrics())
+                    except OSError:
+                        pass
+                    stop.wait(0.02)
+
+            scr = threading.Thread(target=scraper, daemon=True)
+            scr.start()
+
+            def fire(i):
+                responses[i] = client.solve(
+                    {"id": f"e{i}", "T": [Ts[i]], "X": _COMP, "t1": t1})
+
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(N)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            stop.set()
+            scr.join()
+            health = client.healthz()
+        assert health["serving"]["fingerprint"] == session.fingerprint
+
+        # ---- bit-exact vs the direct sweep on the same conditions ----
+        out = br.batch_reactor_sweep(
+            _COMP, np.asarray(Ts), 1e5, t1,
+            chem=br.Chemistry(gaschem=True), thermo_obj=session.thermo,
+            md=session.gm, segment_steps=8, admission=8, refill=1,
+            buckets=(8,), poll_every=1)
+        for i, resp in enumerate(responses):
+            assert resp["status"] == "ok" and resp["lanes"] == 1
+            assert resp["solver_status"] == ["Success"]
+            assert resp["provenance"] == ["success"]
+            assert resp["t"][0] == float(out["t"][i])
+            for sp in session.species:
+                np.testing.assert_allclose(
+                    resp["x"][sp][0], float(out["x"][sp][i]),
+                    rtol=1e-12, err_msg=f"lane {i} species {sp}")
+            assert resp["n_accepted"][0] > 0
+
+        # ---- still zero armed-label compiles ------------------------
+        prog = session.program_compiles()
+        assert all(v == 0 for v in prog.values()), prog
+
+        # ---- live gauges moved between mid-flight scrapes ------------
+        def gauge(text, name):
+            for ln in text.splitlines():
+                if ln.startswith(f"br_sweep_{name} "):
+                    return float(ln.split()[-1])
+            return None
+
+        states = {(gauge(s, "harvested_lanes"),
+                   gauge(s, "backlog_depth"), gauge(s, "occupancy"))
+                  for s in scrapes}
+        moving = {st for st in states
+                  if any(v is not None for v in st)}
+        assert len(moving) >= 2, (len(scrapes), states)
+
+    def test_request_level_stats_and_counters(self, h2o2_session):
+        from batchreactor_tpu.serving.client import SolveClient
+        from batchreactor_tpu.serving.server import ServingServer
+
+        session = h2o2_session
+        sched = Scheduler(session)
+        with ServingServer(session, sched) as srv:
+            client = SolveClient(srv.url)
+            resp = client.solve({"id": "s1", "T": [1250.0, 1350.0],
+                                 "X": _COMP, "t1": 5e-5})
+        assert resp["stats"]["newton_iters"][0] > 0
+        assert len(resp["stats"]["jac_builds"]) == 2
+        _s, _e, counters = session.recorder.snapshot()
+        assert counters["serve_answered"] >= 1
+        assert counters["serve_lanes"] >= 2
+
+    def test_http_invalid_and_overload_codes(self, h2o2_session):
+        from batchreactor_tpu.serving.client import (ServeError,
+                                                     SolveClient)
+        from batchreactor_tpu.serving.server import ServingServer
+
+        session = h2o2_session
+        sched = Scheduler(session, max_queue_lanes=1)
+        with ServingServer(session, sched) as srv:
+            client = SolveClient(srv.url)
+            with pytest.raises(ServeError) as ei:
+                client.solve({"id": "bad", "T": [1200.0],
+                              "X": {"XE": 1.0}, "t1": 1e-5})
+            assert ei.value.code == "invalid"
+            # hold the worker with a stall so the 1-lane queue bound
+            # trips deterministically on the second in-flight request
+            inject.arm("slow_request:delay=0.6,count=1")
+            codes = []
+
+            def fire(i):
+                try:
+                    client.solve({"id": f"o{i}", "T": [1200.0 + i],
+                                  "X": _COMP, "t1": 5e-5})
+                    codes.append("ok")
+                except ServeError as e:
+                    codes.append(e.code)
+
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(6)]
+            for th in threads:
+                th.start()
+                time.sleep(0.03)
+            for th in threads:
+                th.join()
+            assert "overloaded" in codes, codes
+
+    def test_jsonl_front_end(self, h2o2_session):
+        import io
+
+        from batchreactor_tpu.serving.server import serve_jsonl
+
+        session = h2o2_session
+        sched = Scheduler(session).start()
+        lines = [json.dumps({"id": "j1", "T": [1200.0], "X": _COMP,
+                             "t1": 5e-5}),
+                 json.dumps({"id": "j2", "T": "bogus", "X": _COMP,
+                             "t1": 5e-5}),
+                 json.dumps({"T": [1300.0], "X": _COMP, "t1": 5e-5})]
+        out = io.StringIO()
+        accepted, rejected = serve_jsonl(session, sched,
+                                         io.StringIO("\n".join(lines)),
+                                         out)
+        assert (accepted, rejected) == (2, 1)
+        got = {}
+        for ln in out.getvalue().splitlines():
+            obj = json.loads(ln)
+            got[obj["id"]] = obj
+        assert got["j1"]["status"] == "ok"
+        assert got["j2"]["status"] == "error"
+        assert got["j2"]["error"]["code"] == "invalid"
+        auto = [o for rid, o in got.items() if rid not in ("j1", "j2")]
+        assert len(auto) == 1 and auto[0]["status"] == "ok"
+
+    def test_warmup_specs_match_served_programs(self, h2o2_session):
+        """warm_cache --spec coverage invariant: the keys the spec
+        DERIVES (aot.spec_keys, no execution) are exactly the keys the
+        warmup pass COMPILED — the warmer and the daemon share one
+        fingerprint by construction."""
+        from batchreactor_tpu import aot
+
+        expected = {k for spec in h2o2_session.warmup_specs()
+                    for k, _b in aot.spec_keys(spec)}
+        warmed = {r.key for r in h2o2_session.warmed}
+        assert expected == warmed and len(expected) == 1
+
+
+class TestServeDaemonSubprocess:
+    def test_sigterm_graceful_drain(self, lib_dir, tmp_path):
+        """Acceptance: SIGTERM during an in-flight trace answers all
+        accepted requests, rejects new ones with `draining`, dumps a
+        flight recorder postmortem, and exits 0."""
+        from batchreactor_tpu.serving.client import (ServeError,
+                                                     SolveClient)
+
+        spec = _session_spec(lib_dir, resident=4, buckets=[4],
+                             coalesce_s=0.0)
+        spec_path = tmp_path / "serve.json"
+        spec_path.write_text(json.dumps(spec))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": REPO,
+               # two slow requests hold the stream so the drain window
+               # is wide and deterministic
+               "BR_FAULT_INJECT": "slow_request:delay=1.2,count=2"}
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
+             "--spec", str(spec_path), "--no-warmup",
+             "--flight-dir", str(tmp_path)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        try:
+            startup = {}
+
+            def read_startup():
+                startup["line"] = proc.stdout.readline()
+
+            t = threading.Thread(target=read_startup, daemon=True)
+            t.start()
+            t.join(120)
+            assert startup.get("line"), "daemon never printed its " \
+                                        "startup line"
+            info = json.loads(startup["line"])["serving"]
+            client = SolveClient(info["url"], timeout=120)
+            results = []
+
+            def fire(i):
+                try:
+                    results.append(
+                        ("ok", client.solve(
+                            {"id": f"d{i}", "T": [1200.0 + 10 * i],
+                             "X": _COMP, "t1": 5e-5})))
+                except ServeError as e:
+                    results.append((e.code, None))
+
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(3)]
+            for th in threads:
+                th.start()
+            # let the requests be accepted and the stalls engage, then
+            # pull the plug mid-flight
+            time.sleep(1.0)
+            proc.send_signal(signal.SIGTERM)
+            # new work must now reject with `draining` (retry until the
+            # flag lands; the stalled stream holds the window open)
+            saw_draining = False
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not saw_draining:
+                try:
+                    client.solve({"id": "late", "T": [1500.0],
+                                  "X": _COMP, "t1": 5e-5})
+                except ServeError as e:
+                    saw_draining = e.code == "draining"
+                except OSError:
+                    break     # server already down: drain completed
+                time.sleep(0.05)
+            for th in threads:
+                th.join(120)
+            rc = proc.wait(timeout=120)
+            out, err = proc.communicate(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        assert rc == 0, f"daemon exited {rc}:\n{err[-2000:]}"
+        assert saw_draining, "no `draining` rejection observed"
+        oks = [r for code, r in results if code == "ok"]
+        assert len(oks) == 3, results     # every accepted answered
+        assert all(r["provenance"] == ["success"] for r in oks)
+        flights = list(tmp_path.glob("flight_*.jsonl"))
+        assert flights, "SIGTERM left no flight recorder dump"
+
+    def test_warm_cache_spec_list_flags_missing(self, lib_dir,
+                                                tmp_path):
+        """--list --spec against an empty cache flags every expected
+        program key as MISSING and exits 1 (the coverage probe)."""
+        spec = _session_spec(lib_dir, buckets=[4], resident=4)
+        spec_path = tmp_path / "serve.json"
+        spec_path.write_text(json.dumps(spec))
+        cache = tmp_path / "cache"
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "warm_cache.py"),
+             "--spec", str(spec_path), "--list",
+             "--cache-dir", str(cache)],
+            cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                           "PYTHONPATH": REPO},
+            capture_output=True, text=True, timeout=300)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "MISSING" in r.stdout
+        assert "fingerprint" in r.stdout
