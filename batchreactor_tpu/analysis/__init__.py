@@ -9,12 +9,22 @@ solvers must stay pure, vmap-able, fixed-shape JAX programs.
   reaches the device (:mod:`.reachability`) and runs the registered
   rules with per-line ``# brlint: disable=RULE`` suppressions and a
   JSON baseline for pre-existing debt.
-* **Tier B** (:mod:`.jaxpr_audit`) — traces the four RHS chemistry
-  modes and both solvers' step programs on the tiny vendored fixtures
-  and walks the jaxprs for host callbacks, host transfers, and dtype
-  leaks the AST cannot see.
+* **Tier B** (:mod:`.jaxpr_audit`) — traces the registered program
+  contracts on the tiny vendored fixtures and walks the jaxprs for
+  host callbacks, host transfers, and dtype leaks the AST cannot see
+  (served by the tier-C engine since the contract registry landed).
+* **Tier C** (:mod:`.contracts` + :mod:`.concurrency`) — (a) the
+  program-contract registry: every traced program declares its
+  purity/no-op-fork/kernel-presence obligations at its definition site
+  (``@program_contract``), ONE engine evaluates them, and a
+  completeness check fails when an armed CompileWatch label has no
+  contract; plus the fingerprint-completeness and counter-registry
+  audits.  (b) the host-concurrency lint: lock discipline, lock
+  ordering, blocking-under-lock, and donation-aliasing over the
+  threaded host modules (serving/, obs/live.py, resilience/watchdog.py,
+  parallel/sweep.py).
 
-CLI: ``python scripts/brlint.py batchreactor_tpu/`` (see
+CLI: ``python scripts/brlint.py batchreactor_tpu/`` / ``--tier C`` (see
 docs/development.md for the rule catalogue and suppression policy).
 """
 
@@ -23,6 +33,14 @@ from .core import (Finding, Baseline, all_rules, lint_file, lint_paths,
 from . import rules_ast  # noqa: F401,E402  (registers the tier-A rules:
 #                          without this import the registry is empty and
 #                          lint_paths would vacuously scan clean)
+from .concurrency import (  # noqa: E402
+    CONCURRENCY_RULES, lint_concurrency_file, lint_concurrency_paths)
+from .contracts import (  # noqa: E402  (stdlib-only at module scope;
+    #                      jax loads lazily inside the engine)
+    ProgramContract, all_contracts, program_contract, run_contracts)
 
 __all__ = ["Finding", "Baseline", "all_rules", "lint_file", "lint_paths",
-           "load_suppressions"]
+           "load_suppressions", "CONCURRENCY_RULES",
+           "lint_concurrency_file", "lint_concurrency_paths",
+           "ProgramContract", "all_contracts", "program_contract",
+           "run_contracts"]

@@ -1,13 +1,27 @@
-"""brlint command line: package scan (tier A) + jaxpr audit (tier B).
+"""brlint command line: tiered JAX tracer-safety / host-concurrency
+static analysis.
+
+* **Tier A** — AST scan of the given paths (the five tracer-safety
+  rules, :mod:`.rules_ast`); runs whenever paths are passed.
+* **Tier B** — ``--jaxpr``: the traced-program audit, now served by the
+  tier-C contract registry engine (:mod:`.contracts`) without the
+  repo-level registry audits — the historical surface, kept as a
+  stable alias.
+* **Tier C** — ``--contracts`` runs the program-contract registry
+  engine (every ``@program_contract``-registered traced program, the
+  CompileWatch-label completeness check, and the fingerprint/counter
+  registry audits); ``--concurrency`` runs the host-concurrency lint
+  (:mod:`.concurrency`) over the threaded host modules; ``--tier C``
+  is shorthand for both (plus the tier-A scan of any paths given).
 
 Exit codes: 0 clean (or fully baselined), 1 findings, 2 usage error.
 
 Examples (docs/development.md):
-  python scripts/brlint.py batchreactor_tpu/
+  python scripts/brlint.py batchreactor_tpu/            # tier A
+  python scripts/brlint.py --jaxpr                      # tier B
+  python scripts/brlint.py --tier C --json              # full tier C
+  python scripts/brlint.py --concurrency                # host lint only
   python scripts/brlint.py batchreactor_tpu/ --baseline brlint_baseline.json
-  python scripts/brlint.py --jaxpr                  # tier B on fixtures
-  python scripts/brlint.py batchreactor_tpu/ --json
-  python scripts/brlint.py batchreactor_tpu/ --write-baseline debt.json
 """
 
 import argparse
@@ -21,48 +35,83 @@ from . import rules_ast  # noqa: F401  (registers the tier-A rules)
 def _build_parser():
     p = argparse.ArgumentParser(
         prog="brlint",
-        description="JAX tracer-safety / recompilation-hazard linter for "
-                    "batchreactor_tpu (see docs/development.md)")
-    p.add_argument("paths", nargs="*", help="files or directories to scan")
+        description="JAX tracer-safety / recompilation-hazard / host-"
+                    "concurrency linter for batchreactor_tpu (see "
+                    "docs/development.md)")
+    p.add_argument("paths", nargs="*", help="files or directories to "
+                                            "scan (tier A)")
+    p.add_argument("--tier", choices=["A", "B", "C", "a", "b", "c"],
+                   help="run a whole tier: A = AST scan of paths, "
+                        "B = --jaxpr, C = --contracts + --concurrency "
+                        "(plus the tier-A scan of any paths given)")
     p.add_argument("--select", help="comma-separated rule names to run "
                                     "(default: all)")
     p.add_argument("--list-rules", action="store_true",
-                   help="print the rule catalogue and exit")
+                   help="print the rule catalogue (tier A + "
+                        "concurrency) and exit")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="machine-readable output")
+                   help="machine-readable output (CI uploads this as "
+                        "the findings artifact)")
     p.add_argument("--baseline", metavar="FILE",
-                   help="tracked-debt file: only findings absent from it "
-                        "fail the scan; stale entries are reported")
+                   help="tracked-debt file: only source findings "
+                        "(tier A + concurrency) absent from it fail "
+                        "the scan; stale entries are reported")
     p.add_argument("--write-baseline", metavar="FILE",
-                   help="record current findings as the new baseline and "
-                        "exit 0")
+                   help="record current source findings as the new "
+                        "baseline and exit 0")
     p.add_argument("--jaxpr", action="store_true",
-                   help="run the tier-B jaxpr audit (traces the four RHS "
-                        "modes and both solver step programs on the "
-                        "vendored fixtures; needs a working jax backend)")
+                   help="tier B: trace and audit every registered "
+                        "program contract on the vendored fixtures "
+                        "(needs a working jax backend; the legacy "
+                        "surface of --contracts, minus the registry "
+                        "audits)")
+    p.add_argument("--contracts", action="store_true",
+                   help="tier C: program-contract registry engine — "
+                        "every registered traced program, the "
+                        "CompileWatch-label completeness check, and "
+                        "the fingerprint/counter registry audits")
+    p.add_argument("--concurrency", action="store_true",
+                   help="tier C: host-concurrency lint (lock "
+                        "discipline, lock ordering, blocking-under-"
+                        "lock, donation aliasing) over the threaded "
+                        "host modules (serving/, obs/live.py, "
+                        "resilience/watchdog.py, parallel/sweep.py)")
     p.add_argument("--fixtures", default=None,
-                   help="fixture directory for --jaxpr (default: "
-                        "tests/fixtures next to the package)")
+                   help="fixture directory for --jaxpr/--contracts "
+                        "(default: tests/fixtures next to the package)")
     return p
 
 
 def main(argv=None):
     args = _build_parser().parse_args(argv)
 
+    from .concurrency import CONCURRENCY_RULES, lint_concurrency_paths
+
+    if args.tier:
+        tier = args.tier.upper()
+        if tier == "B":
+            args.jaxpr = True
+        elif tier == "C":
+            args.contracts = True
+            args.concurrency = True
+
     if args.list_rules:
         for name, rule in sorted(all_rules().items()):
-            print(f"{name:24s} {rule.rule_doc}")
+            print(f"{name:28s} {rule.rule_doc}")
+        for name, doc in sorted(CONCURRENCY_RULES.items()):
+            print(f"{name:28s} [concurrency] {doc}")
         return 0
 
-    if not args.paths and not args.jaxpr:
-        print("brlint: nothing to do (pass paths and/or --jaxpr)",
-              file=sys.stderr)
+    run_traced = args.jaxpr or args.contracts
+    if not args.paths and not run_traced and not args.concurrency:
+        print("brlint: nothing to do (pass paths and/or --jaxpr/"
+              "--contracts/--concurrency/--tier)", file=sys.stderr)
         return 2
 
     select = None
     if args.select:
         select = {s.strip() for s in args.select.split(",") if s.strip()}
-        unknown = select - set(all_rules())
+        unknown = select - set(all_rules()) - set(CONCURRENCY_RULES)
         if unknown:
             print(f"brlint: unknown rules {sorted(unknown)}",
                   file=sys.stderr)
@@ -71,15 +120,23 @@ def main(argv=None):
     findings, n_suppressed, sources = [], 0, {}
     if args.paths:
         findings, n_suppressed, sources = lint_paths(args.paths, select)
+    if args.concurrency:
+        # explicit paths scope BOTH tiers; bare --concurrency scans the
+        # default threaded-host module set
+        cf, cns, csources = lint_concurrency_paths(
+            paths=args.paths or None, select=select)
+        findings += cf
+        n_suppressed += cns
+        sources.update(csources)
 
     if args.write_baseline:
-        if args.jaxpr:
+        if run_traced:
             # a combined run would return before the audit and leave the
             # user believing the hot path was traced clean; baselines are
-            # a tier-A (source-fingerprint) concept anyway
+            # a source-fingerprint concept anyway
             print("brlint: --write-baseline cannot be combined with "
-                  "--jaxpr (baselines track tier-A source findings only)",
-                  file=sys.stderr)
+                  "--jaxpr/--contracts (baselines track source "
+                  "findings only)", file=sys.stderr)
             return 2
         Baseline.from_findings(findings, sources).save(args.write_baseline)
         print(f"brlint: wrote {len(findings)} finding(s) to "
@@ -92,12 +149,14 @@ def main(argv=None):
         bl = Baseline.load(args.baseline)
         findings, baselined, stale = bl.apply(findings, sources)
 
-    jaxpr_findings = []
-    if args.jaxpr:
-        from .jaxpr_audit import run_audit
+    traced_findings = []
+    if run_traced:
+        from .contracts import run_contracts
 
-        jaxpr_findings = run_audit(fixtures_dir=args.fixtures)
-        findings = findings + jaxpr_findings
+        traced_findings = run_contracts(
+            fixtures_dir=args.fixtures,
+            registry_audits=bool(args.contracts))
+        findings = findings + traced_findings
 
     if args.as_json:
         print(json.dumps({
@@ -112,8 +171,8 @@ def main(argv=None):
         for fp in stale:
             print(f"brlint: stale baseline entry {fp} (finding no longer "
                   f"produced — remove it from the baseline)")
-        tier_b = f", {len(jaxpr_findings)} from jaxpr audit" if args.jaxpr \
-            else ""
+        tier_b = (f", {len(traced_findings)} from the contract engine"
+                  if run_traced else "")
         print(f"brlint: {len(findings)} finding(s){tier_b}, "
               f"{len(baselined)} baselined, {n_suppressed} suppressed")
 

@@ -1,0 +1,747 @@
+"""brlint tier C (b): host-concurrency lint for the threaded host stack.
+
+The serving era moved real concurrency into the host layer: scheduler
+worker threads resolving futures (``serving/scheduler.py``), the
+``obs/live.py`` MetricsServer + LiveRegistry overlays scraped while
+drivers publish, wedge-watchdog worker threads, the background
+trajectory drain, and flight-recorder taps firing from any thread.  PR
+8's donation-aliasing corruption and PR 11's exactly-once answer
+contract are the bug classes that live there — and none of it was
+statically checked.  This pass lints exactly that surface, with the
+tier-A conventions (per-line ``# brlint: disable=RULE`` suppressions,
+JSON output, content-fingerprint baselines):
+
+* **shared-mutable-state map** — per class: attributes assigned in
+  ``__init__``, lock attributes (``threading.Lock/RLock/Condition``
+  constructions), and *thread-entry* methods: ``threading.Thread(
+  target=self.x)`` targets, ``do_*`` methods of HTTP handler classes,
+  methods named ``tap`` (the Recorder tap-hook convention), plus
+  anything the module declares in a ``_BRLINT_THREAD_ENTRIES`` tuple
+  (``"Class.method"`` strings — the escape hatch for entry points
+  called from *other* modules' threads, e.g. a session's
+  ``request_lanes`` called from HTTP front-end threads).  An attribute
+  is **shared** when any method reachable from an entry (transitively,
+  via ``self.m()`` calls — nested functions ride their enclosing
+  method) touches it.
+
+* ``unguarded-shared-mutation`` — every mutation site of a shared
+  attribute (assignment, aug-assignment, subscript store, or a
+  mutating method call: append/pop/update/...) outside ``__init__``
+  must be dominated by ``with self.<lock>`` on one of the class's
+  locks (or a module lock).  The ``*_locked`` naming convention is
+  honored: a method whose name ends in ``_locked`` asserts "my caller
+  holds the lock" — and ``locked-helper-outside-lock`` then flags any
+  call site of such a method that is NOT inside a lock.  Module
+  globals get the same treatment when the module owns a module-level
+  lock (the ``watchdog._SUSPECT`` / ``live._FLIGHT`` pattern).
+
+* ``blocking-call-under-lock`` — no blocking device fetch
+  (``_host_fetch`` / ``jax.device_get`` / ``block_until_ready`` /
+  ``fetch_with_deadline``), no ``future.result()``, no
+  ``thread.join()``, no ``time.sleep`` while holding a lock: any of
+  them turns every other lock-taker into a convoy (and a wedged fetch
+  under a lock deadlocks the scrape path that would have reported it).
+  ``cond.wait()`` on the *held* condition is the one exemption — that
+  is what condition variables are for.
+
+* ``lock-order-inversion`` — nested ``with`` acquisitions define a
+  lock-order edge; two edges in opposite directions anywhere in one
+  module flag a potential ABBA deadlock.
+
+* ``donation-aliasing`` — the PR-8 rule: a call into a
+  ``donate_argnums`` program donates its operand buffers, and on the
+  CPU backend ``np.asarray`` of a device array (and vice versa) can be
+  a zero-copy VIEW — so a donated operand that is a bare caller
+  argument, or derives from ``asarray`` of one, lets the donated
+  output scribble over memory the caller still reads.  Donating
+  callables are found from ``jax.jit(..., donate_argnums=...)``
+  assignments; compiled-builder indirection is declared via a
+  module-level ``_BRLINT_DONATING_BUILDERS = {"builder_name":
+  (positions...)}`` map (``parallel/sweep.py`` declares its cached
+  segment-program builder).  A donated operand must be *owned*: bound
+  through an expression containing an owning constructor
+  (``jnp.array`` / ``np.array`` / ``.copy()`` / any non-``asarray``
+  call result).  Rebinding a parameter through such an expression is
+  the blessing (``carry = (jnp.array(carry[0], copy=True),) + ...`` —
+  the exact line PR 8's corruption fix added).
+
+The analysis is module-local and name-based like the tier-A
+reachability pass: cross-module thread entry is declared, not
+inferred, and *reads* of shared state are deliberately not flagged
+(the noise floor would drown the mutations that corrupt).  The default
+scan set is the threaded host surface the serving stack stands on —
+:data:`DEFAULT_MODULES`.
+"""
+
+import ast
+import os
+
+from .core import FileContext, Finding, iter_python_files
+
+#: the threaded host modules the acceptance gate runs clean on,
+#: relative to the package root
+DEFAULT_MODULES = (
+    "serving",
+    os.path.join("obs", "live.py"),
+    os.path.join("resilience", "watchdog.py"),
+    os.path.join("parallel", "sweep.py"),
+)
+
+#: rule catalogue (name -> one-line doc), the --list surface
+CONCURRENCY_RULES = {
+    "unguarded-shared-mutation":
+        "mutation of thread-shared state outside the owning lock",
+    "locked-helper-outside-lock":
+        "*_locked helper called without holding a lock",
+    "blocking-call-under-lock":
+        "blocking fetch/.result()/join/sleep while holding a lock",
+    "lock-order-inversion":
+        "two locks acquired in opposite nesting orders (ABBA hazard)",
+    "donation-aliasing":
+        "caller-visible array donated without an owned copy",
+}
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock",
+               "threading.Condition", "threading.Semaphore",
+               "threading.BoundedSemaphore",
+               "Lock", "RLock", "Condition"}
+_MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
+                     "pop", "popleft", "appendleft", "remove",
+                     "discard", "clear", "insert", "sort", "reverse"}
+_BLOCKING_RESOLVED = {"time.sleep", "jax.device_get",
+                      "jax.block_until_ready"}
+_BLOCKING_NAMES = {"_host_fetch", "fetch_with_deadline",
+                   "block_with_deadline"}
+_BLOCKING_ATTRS = {"result", "join", "block_until_ready"}
+_ALIASING_CALLS = {"numpy.asarray", "jax.numpy.asarray",
+                   "numpy.ascontiguousarray", "numpy.broadcast_to",
+                   "jax.numpy.broadcast_to"}
+
+
+def default_paths():
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(pkg, m) for m in DEFAULT_MODULES]
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+# --------------------------------------------------------------------------
+def _self_attr(node):
+    """``self.X`` -> ``"X"``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutation_target_attr(target):
+    """The ``self.X`` attribute a store target mutates (descending
+    through subscripts: ``self.X[i] = ...`` mutates X), else None."""
+    node = target
+    while isinstance(node, (ast.Subscript, ast.Starred)):
+        node = node.value
+    return _self_attr(node)
+
+
+def _mutation_target_global(target):
+    node = target
+    while isinstance(node, (ast.Subscript, ast.Starred)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _lock_id(expr, class_locks, module_locks):
+    """Identify a lock expression: ``self.X`` (X a class lock attr) ->
+    ("self", X); bare module-lock name -> ("module", name)."""
+    attr = _self_attr(expr)
+    if attr is not None and attr in class_locks:
+        return ("self", attr)
+    if isinstance(expr, ast.Name) and expr.id in module_locks:
+        return ("module", expr.id)
+    return None
+
+
+def _lock_name(lock):
+    return (f"self.{lock[1]}" if lock[0] == "self" else lock[1])
+
+
+# --------------------------------------------------------------------------
+# per-module model
+# --------------------------------------------------------------------------
+class _ClassModel:
+    def __init__(self, node, ctx, module_locks, declared_entries):
+        self.node = node
+        self.name = node.name
+        self.methods = {n.name: n for n in node.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.init_attrs = {}
+        self.lock_attrs = set()
+        self._collect_init(ctx)
+        self.http_handler = any(
+            "RequestHandler" in (ctx.index.aliases.resolve(b) or
+                                 getattr(b, "id", "") or
+                                 getattr(b, "attr", ""))
+            for b in node.bases)
+        self.entries = self._find_entries(ctx, declared_entries)
+        self.reachable = self._close_over_calls()
+        self.module_locks = module_locks
+        self.shared = self._shared_attrs()
+
+    def _collect_init(self, ctx):
+        init = self.methods.get("__init__")
+        if init is None:
+            return
+        for n in ast.walk(init):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    self.init_attrs[attr] = n.lineno
+                    if (isinstance(n.value, ast.Call)
+                            and (ctx.index.aliases.resolve(n.value.func)
+                                 or "") in _LOCK_CTORS):
+                        self.lock_attrs.add(attr)
+
+    def _find_entries(self, ctx, declared):
+        entries = set(declared.get(self.name, ()))
+        for name, m in self.methods.items():
+            if self.http_handler and name.startswith("do_"):
+                entries.add(name)
+            if name == "tap":
+                # the Recorder tap-hook convention (obs/live.py): taps
+                # fire from whichever thread completed the span
+                entries.add(name)
+            for n in ast.walk(m):
+                if not (isinstance(n, ast.Call)
+                        and (ctx.index.aliases.resolve(n.func) or "")
+                        == "threading.Thread"):
+                    continue
+                for kw in n.keywords:
+                    if kw.arg != "target":
+                        continue
+                    attr = _self_attr(kw.value)
+                    if attr is not None and attr in self.methods:
+                        entries.add(attr)
+        return entries
+
+    def _close_over_calls(self):
+        edges = {}
+        for name, m in self.methods.items():
+            outs = set()
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call):
+                    callee = _self_attr(n.func)
+                    if callee in self.methods:
+                        outs.add(callee)
+            edges[name] = outs
+        reach, frontier = set(self.entries), list(self.entries)
+        while frontier:
+            m = frontier.pop()
+            for callee in edges.get(m, ()):
+                if callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+        return reach
+
+    def _shared_attrs(self):
+        """Attributes touched (read OR written) from thread-reachable
+        methods — the candidates whose *mutations* must be locked."""
+        shared = set()
+        for name in self.reachable:
+            m = self.methods.get(name)
+            if m is None or name == "__init__":
+                continue
+            for n in ast.walk(m):
+                attr = _self_attr(n)
+                if attr is not None:
+                    shared.add(attr)
+        return shared - self.lock_attrs
+
+
+class _ModuleModel:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        tree = ctx.tree
+        self.module_locks = set()
+        self.container_globals = set()
+        self.declared_entries = {}
+        self.donating_builders = {}
+        self.module_donating = {}    # name -> donated positions
+        for n in tree.body:
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                continue
+            t = n.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            resolved = ""
+            if isinstance(n.value, ast.Call):
+                resolved = ctx.index.aliases.resolve(n.value.func) or ""
+            if resolved in _LOCK_CTORS:
+                self.module_locks.add(t.id)
+            elif resolved in ("collections.deque", "deque", "dict",
+                              "list", "set", "collections.OrderedDict",
+                              "collections.defaultdict"):
+                self.container_globals.add(t.id)
+            elif isinstance(n.value, (ast.Dict, ast.List, ast.Set)):
+                self.container_globals.add(t.id)
+            if t.id == "_BRLINT_THREAD_ENTRIES":
+                for el in ast.walk(n.value):
+                    if (isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)
+                            and "." in el.value):
+                        cls, meth = el.value.rsplit(".", 1)
+                        self.declared_entries.setdefault(
+                            cls, set()).add(meth)
+            if t.id == "_BRLINT_DONATING_BUILDERS":
+                if isinstance(n.value, ast.Dict):
+                    for k, v in zip(n.value.keys, n.value.values):
+                        if isinstance(k, ast.Constant):
+                            self.donating_builders[str(k.value)] = \
+                                _int_tuple(v)
+            donated = _jit_donated_positions(ctx, n.value)
+            if donated is not None:
+                self.module_donating[t.id] = donated
+        self.classes = [
+            _ClassModel(n, ctx, self.module_locks, self.declared_entries)
+            for n in tree.body if isinstance(n, ast.ClassDef)]
+
+
+def _int_tuple(node):
+    return tuple(el.value for el in ast.walk(node)
+                 if isinstance(el, ast.Constant)
+                 and isinstance(el.value, int))
+
+
+def _jit_donated_positions(ctx, expr):
+    """``jax.jit(fn, donate_argnums=...)`` -> donated positions."""
+    if not isinstance(expr, ast.Call):
+        return None
+    if (ctx.index.aliases.resolve(expr.func) or "") not in ("jax.jit",
+                                                            "jit"):
+        return None
+    for kw in expr.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            return _int_tuple(kw.value)
+    return None
+
+
+# --------------------------------------------------------------------------
+# the body walker (lock stack + site collection)
+# --------------------------------------------------------------------------
+class _Sites:
+    """Everything one function body yields to the rules: mutation
+    sites, calls (with the lock stack held at each), lock-order edges,
+    and local assignments (for the donation ownership sweep)."""
+
+    def __init__(self):
+        self.mutations = []    # (node, attr_or_None, global_or_None, held)
+        self.calls = []        # (node, held)
+        self.edges = []        # (outer_lock, inner_lock, node)
+        self.assigns = []      # (target_names, value_expr, lineno)
+        self.globals_decl = set()
+
+
+def _collect_sites(fn_node, class_locks, module_locks, sites):
+    def lock_of(expr):
+        return _lock_id(expr, class_locks, module_locks)
+
+    def walk(node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # nested callable: runs later, on an unknown lock stack
+            body = node.body if isinstance(node.body, list) else [
+                node.body]
+            for child in body:
+                walk(child, [])
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = list(held)
+            for item in node.items:
+                walk(item.context_expr, held)
+                lock = lock_of(item.context_expr)
+                if lock is not None:
+                    for outer in new:
+                        if outer != lock:
+                            sites.edges.append((outer, lock, node))
+                    new.append(lock)
+            for child in node.body:
+                walk(child, new)
+            return
+        if isinstance(node, ast.Global):
+            sites.globals_decl.update(node.names)
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                flat = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t])
+                for tt in flat:
+                    attr = _mutation_target_attr(tt)
+                    g = (None if attr is not None
+                         else _mutation_target_global(tt))
+                    if attr is not None or g is not None:
+                        sites.mutations.append((node, attr, g,
+                                                list(held)))
+            names = []
+            for t in targets:
+                flat = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t])
+                names.extend(tt.id for tt in flat
+                             if isinstance(tt, ast.Name))
+            value = getattr(node, "value", None)
+            if names and value is not None:
+                sites.assigns.append((names, value, node.lineno))
+        if isinstance(node, ast.Call):
+            sites.calls.append((node, list(held)))
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _MUTATING_METHODS:
+                    attr = _mutation_target_attr(node.func.value)
+                    g = (None if attr is not None
+                         else _mutation_target_global(node.func.value))
+                    if attr is not None or g is not None:
+                        sites.mutations.append((node, attr, g,
+                                                list(held)))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in fn_node.body:
+        walk(stmt, [])
+
+
+# --------------------------------------------------------------------------
+# the rules
+# --------------------------------------------------------------------------
+def _held_any_lock(held):
+    return bool(held)
+
+
+def _class_findings(ctx, cm, findings, edges_out):
+    path = ctx.path
+    for mname, m in cm.methods.items():
+        if mname in ("__init__", "__new__"):
+            continue
+        sites = _Sites()
+        _collect_sites(m, cm.lock_attrs, cm.module_locks, sites)
+        edges_out.extend(sites.edges)
+        locked_by_name = mname.endswith("_locked")
+        have_locks = bool(cm.lock_attrs or cm.module_locks)
+        for node, attr, _g, held in sites.mutations:
+            if attr is None or attr not in cm.shared:
+                continue
+            if locked_by_name or _held_any_lock(held):
+                continue
+            lock_hint = (
+                f"with self.{sorted(cm.lock_attrs)[0]}" if cm.lock_attrs
+                else "a class lock (none declared in __init__)")
+            findings.append(Finding(
+                "unguarded-shared-mutation", path, node.lineno,
+                node.col_offset,
+                f"'{cm.name}.{attr}' is shared with thread-reachable "
+                f"code ({', '.join(sorted(cm.entries)) or 'entries'}) "
+                f"but mutated here without holding {lock_hint}"
+                + ("" if have_locks else
+                   "; add a threading.Lock in __init__"),
+                symbol=f"{cm.name}.{mname}"))
+        for node, held in sites.calls:
+            callee = _self_attr(node.func)
+            if (callee is not None and callee.endswith("_locked")
+                    and callee in cm.methods
+                    and not _held_any_lock(held)
+                    and not locked_by_name):
+                findings.append(Finding(
+                    "locked-helper-outside-lock", path, node.lineno,
+                    node.col_offset,
+                    f"self.{callee}() asserts its caller holds the "
+                    f"lock (the *_locked convention) but no lock is "
+                    f"held here", symbol=f"{cm.name}.{mname}"))
+            _blocking_check(ctx, cm, mname, node, held, findings)
+
+
+def _blocking_check(ctx, cm, mname, node, held, findings):
+    if not held:
+        return
+    resolved = ctx.index.aliases.resolve(node.func) or ""
+    blocking = None
+    if resolved in _BLOCKING_RESOLVED:
+        blocking = resolved
+    elif resolved in _BLOCKING_NAMES:
+        blocking = resolved
+    elif isinstance(node.func, ast.Name) and \
+            node.func.id in _BLOCKING_NAMES:
+        blocking = node.func.id
+    elif isinstance(node.func, ast.Attribute):
+        if node.func.attr in ("wait", "wait_for"):
+            # cond.wait() on the HELD condition releases it — the one
+            # legitimate blocking call under a lock
+            lock = _lock_id(node.func.value,
+                            cm.lock_attrs if cm else set(),
+                            cm.module_locks if cm else set())
+            if lock is not None and lock in held:
+                return
+        if node.func.attr in _BLOCKING_ATTRS:
+            blocking = f".{node.func.attr}()"
+    if blocking is None:
+        return
+    locks = ", ".join(_lock_name(x) for x in held)
+    findings.append(Finding(
+        "blocking-call-under-lock", ctx.path, node.lineno,
+        node.col_offset,
+        f"{blocking} blocks while holding {locks}: every other "
+        f"lock-taker convoys behind it (and a wedged wait here "
+        f"deadlocks the paths that would report it); move the wait "
+        f"outside the lock",
+        symbol=(f"{cm.name}.{mname}" if cm else mname)))
+
+
+def _module_global_findings(ctx, model, findings, edges_out):
+    """Lock discipline for module globals (only when the module owns a
+    module-level lock — otherwise there is no discipline to check)."""
+    if not model.module_locks:
+        return
+    for fn in [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))]:
+        in_class = any(fn in c.node.body or any(
+            fn in ast.walk(meth) for meth in c.methods.values())
+            for c in model.classes)
+        if in_class:
+            continue    # class methods handled by _class_findings
+        sites = _Sites()
+        _collect_sites(fn, set(), model.module_locks, sites)
+        edges_out.extend(sites.edges)
+        locked_by_name = fn.name.endswith("_locked")
+        for node, _attr, g, held in sites.mutations:
+            if g is None:
+                continue
+            is_decl_global = g in sites.globals_decl
+            is_container = g in model.container_globals
+            if not (is_decl_global or is_container):
+                continue
+            if (g in model.module_locks or _held_any_lock(held)
+                    or locked_by_name):
+                continue
+            findings.append(Finding(
+                "unguarded-shared-mutation", ctx.path, node.lineno,
+                node.col_offset,
+                f"module global '{g}' is mutated without holding a "
+                f"module lock ({', '.join(sorted(model.module_locks))}"
+                f" exist(s) for exactly this)", symbol=fn.name))
+        for node, held in sites.calls:
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id.endswith("_locked")
+                    and not _held_any_lock(held)
+                    and not locked_by_name):
+                findings.append(Finding(
+                    "locked-helper-outside-lock", ctx.path,
+                    node.lineno, node.col_offset,
+                    f"{node.func.id}() asserts its caller holds the "
+                    f"lock (the *_locked convention) but no lock is "
+                    f"held here", symbol=fn.name))
+            _blocking_check(ctx, None, fn.name, node, held, findings)
+
+
+def _lock_order_findings(ctx, edges, findings):
+    seen = {}
+    for outer, inner, node in edges:
+        seen.setdefault((outer, inner), node)
+    for (a, b), node in sorted(
+            seen.items(),
+            key=lambda kv: (kv[1].lineno, kv[1].col_offset)):
+        if (b, a) in seen and seen[(b, a)].lineno < node.lineno:
+            other = seen[(b, a)]
+            findings.append(Finding(
+                "lock-order-inversion", ctx.path, node.lineno,
+                node.col_offset,
+                f"{_lock_name(b)} acquired while holding "
+                f"{_lock_name(a)}, but line {other.lineno} acquires "
+                f"them in the opposite order: ABBA deadlock hazard — "
+                f"pick one order and document it"))
+
+
+def _donation_findings(ctx, model, findings):
+    """The PR-8 donation-aliasing rule (module doc).
+
+    Ownership is evaluated PER CALL SITE from the bindings strictly
+    BEFORE it in source order — a flow-insensitive sweep would let the
+    donating call's own result-rebind (``carry, aux = jitted(...,
+    carry)``) bless its operand retroactively, turning the rule into a
+    no-op for exactly the first-iteration bare-parameter donation the
+    PR-8 corruption came from.  With the pre-call view, deleting the
+    owned-copy line (``carry = (jnp.array(carry[0], copy=True),) +
+    ...``) leaves ``carry`` a bare caller argument at the call and
+    flags."""
+    donating = dict(model.module_donating)
+
+    for fn in [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda))]:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        args = fn.args
+        params = {p.arg for p in (list(args.posonlyargs)
+                                  + list(args.args)
+                                  + list(args.kwonlyargs))}
+        sites = _Sites()
+        for stmt in body:
+            _collect_sites_shallow(stmt, sites)
+        local_donating = dict(donating)
+        for names, value, _ln in sites.assigns:
+            pos = _jit_donated_positions(ctx, value)
+            if pos is None and isinstance(value, ast.Call):
+                fname = (value.func.id
+                         if isinstance(value.func, ast.Name) else None)
+                if fname in model.donating_builders:
+                    pos = model.donating_builders[fname]
+            if pos is not None and len(names) >= 1:
+                local_donating[names[0]] = pos
+
+        def expr_owned(e, owned, bound):
+            if isinstance(e, ast.Call):
+                resolved = ctx.index.aliases.resolve(e.func) or ""
+                if resolved in _ALIASING_CALLS:
+                    return any(expr_owned(a, owned, bound)
+                               for a in e.args)
+                return True     # fresh result assumed (jnp.array, .copy,
+                #                 constructors, donating calls, ...)
+            if isinstance(e, ast.Name):
+                if e.id in owned:
+                    return True
+                # a caller argument, or a local whose pre-call bindings
+                # all alias caller-visible data, is NOT owned; a name
+                # with no local binding at all (closure/global) is
+                # unknowable — assume owned to bound the noise
+                return e.id not in params and e.id not in bound
+            if isinstance(e, (ast.Attribute, ast.Subscript,
+                              ast.Starred)):
+                return expr_owned(e.value, owned, bound)
+            if isinstance(e, (ast.Tuple, ast.List, ast.BinOp)):
+                kids = (e.elts if hasattr(e, "elts")
+                        else [e.left, e.right])
+                return any(expr_owned(k, owned, bound) for k in kids)
+            if isinstance(e, ast.Constant):
+                return True
+            return False
+
+        def owned_before(lineno):
+            """(owned, bound) from the bindings strictly before
+            ``lineno`` — two sweeps over that prefix approximate a
+            fixpoint over straight-line chains (x = jnp.array(p);
+            y = x)."""
+            pre = [(names, value) for names, value, ln in sites.assigns
+                   if ln < lineno]
+            bound = params | {n for names, _v in pre for n in names}
+            owned = set()
+            for _ in range(2):
+                for names, value in pre:
+                    if expr_owned(value, owned, bound):
+                        owned.update(names)
+            return owned, bound
+
+        for node, _held in sites.calls:
+            fname = (node.func.id if isinstance(node.func, ast.Name)
+                     else None)
+            if fname is None or fname not in local_donating:
+                continue
+            owned, bound = owned_before(node.lineno)
+            for p in local_donating[fname]:
+                if p >= len(node.args):
+                    continue
+                arg = node.args[p]
+                if expr_owned(arg, owned, bound):
+                    continue
+                what = (f"'{arg.id}'" if isinstance(arg, ast.Name)
+                        else "this operand")
+                findings.append(Finding(
+                    "donation-aliasing", ctx.path, node.lineno,
+                    node.col_offset,
+                    f"{what} is donated to {fname}() (donate_argnums "
+                    f"position {p}) without an owned copy: if it views "
+                    f"caller-visible memory (np.asarray of a device "
+                    f"array is zero-copy on CPU) the donated output "
+                    f"scribbles over it — rebind through jnp.array/"
+                    f".copy() first (the PR-8 corruption class)",
+                    symbol=getattr(fn, "name", "<lambda>")))
+
+
+def _collect_sites_shallow(stmt, sites):
+    """Assignment/call collection for the donation sweep: stays inside
+    ONE function scope (nested defs run their own sweep)."""
+
+    def walk(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Assign):
+            names = []
+            for t in node.targets:
+                flat = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t])
+                names.extend(tt.id for tt in flat
+                             if isinstance(tt, ast.Name))
+            if names:
+                sites.assigns.append((names, node.value, node.lineno))
+        if isinstance(node, ast.Call):
+            sites.calls.append((node, []))
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(stmt)
+
+
+# --------------------------------------------------------------------------
+# entry points (tier-A-shaped: findings + suppressed + sources)
+# --------------------------------------------------------------------------
+def lint_concurrency_file(path, select=None):
+    """Run the concurrency rules over one file; same return shape as
+    :func:`~.core.lint_file` (findings, n_suppressed, source_lines)."""
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    lines = source.splitlines()
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 1, 0,
+                        f"could not parse: {e.msg}")], 0, lines
+    model = _ModuleModel(ctx)
+    raw, edges = [], []
+    for cm in model.classes:
+        _class_findings(ctx, cm, raw, edges)
+    _module_global_findings(ctx, model, raw, edges)
+    _lock_order_findings(ctx, edges, raw)
+    _donation_findings(ctx, model, raw)
+    # a nested function is scanned both through its enclosing function
+    # (lock stack reset) and standalone — identical findings, once each
+    seen, deduped = set(), []
+    for f in raw:
+        key = (f.rule, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(f)
+    raw = deduped
+    findings, n_suppressed = [], 0
+    for f in raw:
+        if select is not None and f.rule not in select:
+            continue
+        if ctx.suppressed(f):
+            n_suppressed += 1
+        else:
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, n_suppressed, lines
+
+
+def lint_concurrency_paths(paths=None, select=None):
+    """Scan files/directories (default: :data:`DEFAULT_MODULES` under
+    the package root); returns (findings, n_suppressed, sources) in the
+    :func:`~.core.lint_paths` shape so baselines and fingerprints
+    apply unchanged."""
+    paths = list(paths) if paths else default_paths()
+    findings, n_suppressed, sources = [], 0, {}
+    for path in iter_python_files(paths):
+        fs, ns, lines = lint_concurrency_file(path, select)
+        findings.extend(fs)
+        n_suppressed += ns
+        sources[path] = lines
+    return findings, n_suppressed, sources

@@ -1,0 +1,701 @@
+"""brlint tier C (a): the program-contract registry.
+
+PR 1 grew a jaxpr audit (tier B) that hand-wired one entry point per
+traced program into ``jaxpr_audit.run_audit``; seven PRs later that
+file carried seven bespoke audits (``economy-noop-fork``,
+``resilience-noop-fork``, ``admission-noop-fork``, ``timeline-noop-fork``,
+stats-off byte-identity, ``jaxpr-bucket-fork``, ``kernel-missing``) with
+no structural guarantee that the *next* traced program would get one.
+This module replaces the pile with a **contract system**:
+
+* every traced program registers a declarative
+  :class:`ProgramContract` **at its definition site** via the
+  :func:`program_contract` decorator (``solver/bdf.py`` registers the
+  BDF step programs, ``parallel/sweep.py`` the segment and compaction
+  programs, and so on — grep ``@program_contract`` for the census);
+* a contract's ``build(harness)`` yields **obligations** — the three
+  invariance classes every bespoke audit reduced to:
+
+  - :class:`Pure` — the traced jaxpr contains no host callback, no
+    in-loop ``device_put``, and (RHS programs only) no float-width
+    conversion;
+  - :class:`Identical` — two traces are byte-identical (the no-op-fork
+    class: ``stats=False``/``setup_economy``/``timeline=None``/
+    admission-off/resilience-armed invariance, and the bucket-fork
+    padding contract);
+  - :class:`Contains` — a required primitive is actually present
+    (the ``kernel-missing`` class: a silent fallback must not keep
+    tests green while the hand-written kernel never runs);
+
+* :func:`run_contracts` is the ONE engine: it imports the owner
+  modules (populating the registry), builds a shared fixture
+  :class:`Harness` on the tiny vendored mechanisms, evaluates every
+  obligation, and appends the **completeness check** — an AST scan of
+  the package for ``CompileWatch`` ``region(..., single_program=True)``
+  literals: a traced-program label with no registered contract fails
+  the run, so a new subsystem cannot land an armed traced program
+  without declaring its contract.
+
+Two repo-level registry audits ride the same tier (they are contracts
+about *registries*, not jaxprs):
+
+* :func:`fingerprint_registry_findings` — every knob that changes the
+  chunk npz/stats schema (``parallel/checkpoint.py`` ``SCHEMA_KNOBS``)
+  must be pinned by the resume fingerprint: the audit checks the knob
+  is not in the fingerprint's gear-exemption list AND behaviorally
+  verifies toggling it changes the hash (the PR-9 ``timeline`` case is
+  the regression fixture — exempting it fails this audit);
+* :func:`counter_registry_findings` — every counter key family in
+  ``obs/counters.py`` must be declared in its ``FAMILIES`` registry
+  with additive-vs-gauge-vs-sample semantics, and host families must
+  ride the ``obs.diff`` missing->0 convention (verified behaviorally
+  against the real ``diff`` renderer), so a future key family cannot
+  silently break report diffs.
+
+This module imports stdlib only at module scope (owners import it to
+register, and tier A must never pay a jax import); jax and the solver
+stack load lazily inside :class:`Harness` / :func:`run_contracts`.
+"""
+
+import ast
+import dataclasses
+import os
+import traceback
+
+from .core import Finding
+
+_CALLBACK_MARKERS = ("callback", "outside_call", "host_local")
+_FLOAT_WIDTHS = {"float16", "bfloat16", "float32", "float64"}
+
+
+# --------------------------------------------------------------------------
+# the jaxpr walker (shared by Pure/Contains; re-exported by jaxpr_audit)
+# --------------------------------------------------------------------------
+def _iter_eqns(jaxpr, in_loop=False):
+    """(eqn, in_loop) for every equation of a (closed) jaxpr, descending
+    into sub-jaxprs (while_loop body/cond, scan, cond branches, pjit,
+    custom_jvp...).  ``in_loop`` marks equations that execute once per
+    device iteration — the scope where a host transfer actually hurts
+    (one-time operand staging in the outer program is benign)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn, in_loop
+        child_in_loop = in_loop or eqn.primitive.name in ("while", "scan")
+        for val in eqn.params.values():
+            for sub in _sub_jaxprs(val):
+                yield from _iter_eqns(sub, child_in_loop)
+
+
+def _sub_jaxprs(val):
+    if hasattr(val, "eqns") or hasattr(val, "jaxpr"):
+        yield val
+    elif isinstance(val, (list, tuple)):
+        for v in val:
+            yield from _sub_jaxprs(v)
+
+
+def _audit_jaxpr(tag, jaxpr, check_dtype):
+    """The purity walk: host callbacks, in-loop device transfers, and
+    (``check_dtype``) float-width conversions."""
+    findings = []
+    for eqn, in_loop in _iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        if any(m in prim for m in _CALLBACK_MARKERS):
+            findings.append(Finding(
+                "jaxpr-host-callback", f"<jaxpr:{tag}>", 0, 0,
+                f"host callback primitive {prim!r} inside the traced "
+                f"program: a Python round-trip per device step"))
+        elif prim == "device_put" and in_loop:
+            findings.append(Finding(
+                "jaxpr-device-transfer", f"<jaxpr:{tag}>", 0, 0,
+                "device_put inside the traced loop body: an operand is "
+                "re-staged on device every iteration (hoist the "
+                "conversion out of the loop)"))
+        elif check_dtype and prim == "convert_element_type":
+            src = str(eqn.invars[0].aval.dtype)
+            dst = str(eqn.params.get("new_dtype", ""))
+            if (src in _FLOAT_WIDTHS and dst in _FLOAT_WIDTHS
+                    and src != dst):
+                findings.append(Finding(
+                    "jaxpr-dtype-leak", f"<jaxpr:{tag}>", 0, 0,
+                    f"float width change {src} -> {dst} in a kernel "
+                    f"program that should be uniformly f64 (x64 "
+                    f"emulation: silent precision or 10x cost leak)"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# obligations
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class Pure:
+    """The traced program must be free of host callbacks and in-loop
+    device staging; ``check_dtype`` adds the f64-uniformity walk (RHS
+    programs only — solver programs convert by design)."""
+
+    tag: str
+    jaxpr: object
+    check_dtype: bool = False
+
+
+@dataclasses.dataclass
+class Identical:
+    """Two traces (stringified jaxprs) must be byte-identical — the
+    no-op-fork / bucket-fork invariance class.  ``rule`` is the finding
+    name the legacy audit used (``economy-noop-fork``, ...)."""
+
+    rule: str
+    tag: str
+    a: str
+    b: str
+    message: str
+
+
+@dataclasses.dataclass
+class Contains:
+    """The traced program must contain a primitive whose name includes
+    ``fragment`` — the kernel-presence class (a silent fallback to a
+    library path must fail loudly)."""
+
+    rule: str
+    tag: str
+    jaxpr: object
+    fragment: str
+    message: str
+
+
+def _check_obligation(ob):
+    if isinstance(ob, Pure):
+        return _audit_jaxpr(ob.tag, ob.jaxpr, ob.check_dtype)
+    if isinstance(ob, Identical):
+        if ob.a != ob.b:
+            return [Finding(ob.rule, f"<jaxpr:{ob.tag}>", 0, 0,
+                            ob.message)]
+        return []
+    if isinstance(ob, Contains):
+        prims = {e.primitive.name for e, _ in _iter_eqns(ob.jaxpr)}
+        if not any(ob.fragment in p for p in prims):
+            return [Finding(ob.rule, f"<jaxpr:{ob.tag}>", 0, 0,
+                            ob.message)]
+        return []
+    raise TypeError(f"unknown contract obligation {type(ob).__name__}")
+
+
+# --------------------------------------------------------------------------
+# the registry
+# --------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class ProgramContract:
+    name: str          # registry key (kebab-case, the program's name)
+    build: object      # build(harness) -> iterable of obligations
+    labels: tuple      # CompileWatch single-program labels this covers
+    doc: str
+    module: str        # definition site, for reports
+
+
+_REGISTRY = {}
+
+#: modules that own traced programs and register contracts at import;
+#: the engine imports them in THIS order, so registry iteration (and
+#: therefore which contract first memoizes the shared no-op baselines)
+#: is deterministic
+OWNER_MODULES = (
+    "ops.rhs",
+    "solver.bdf",
+    "solver.sdirk",
+    "solver.linalg_pallas",
+    "sensitivity.forward",
+    "sensitivity.adjoint",
+    "parallel.sweep",
+)
+
+
+def program_contract(name, *, labels=(), doc=""):
+    """Decorator registering a traced program's contract at its
+    definition site:
+
+    >>> @program_contract("bdf-step", doc="BDF step program: pure")
+    ... def _contract_bdf_step(h):
+    ...     yield Pure("bdf-step", h.solver_jaxpr(solve))
+
+    ``name`` is the registry key; ``labels`` lists the CompileWatch
+    ``single_program`` region labels the program runs under (the
+    completeness check matches them); the builder receives the shared
+    :class:`Harness` and yields obligations.  Re-registration under the
+    same name replaces (module reload in tests)."""
+
+    def deco(fn):
+        _REGISTRY[name] = ProgramContract(
+            name=name, build=fn, labels=tuple(labels),
+            doc=doc or (fn.__doc__ or "").strip().split("\n")[0],
+            module=fn.__module__)
+        return fn
+
+    return deco
+
+
+def all_contracts():
+    """The registry as ``{name: ProgramContract}`` (import the owner
+    modules first — :func:`run_contracts` does)."""
+    return dict(_REGISTRY)
+
+
+def _import_owners():
+    import importlib
+
+    pkg = __package__.rsplit(".", 1)[0]   # batchreactor_tpu
+    for mod in OWNER_MODULES:
+        importlib.import_module(f"{pkg}.{mod}")
+
+
+# --------------------------------------------------------------------------
+# the shared fixture harness
+# --------------------------------------------------------------------------
+def _fixture_dir(fixtures_dir=None):
+    if fixtures_dir:
+        return fixtures_dir
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "tests", "fixtures")
+
+
+class Harness:
+    """Everything a contract builder needs, built once per engine run
+    on the tiny vendored fixtures (tests/fixtures: h2o2.dat + therm.dat
+    + h2oni.xml — small enough that every trace is sub-second on CPU):
+
+    * ``modes`` — the four chemistry modes as ``(tag, rhs, jac, y0,
+      cfg)``; ``rhs``/``jac``/``y0``/``cfg`` alias the gas mode (the
+      solver/segment fixtures);
+    * ``check_dtype`` — whether the f64-uniformity walk applies (off
+      under the f32 rate-exponential formulation);
+    * tracing helpers — :meth:`jaxpr`, :meth:`solver_jaxpr` /
+      :meth:`solver_jaxpr_str` (the shared ``solve(...).y`` runner both
+      solvers' contracts use), :meth:`batched`;
+    * :meth:`memo` — cross-contract memoization: the no-op-fork
+      contracts share ONE pre-machinery baseline trace through it, so
+      every before/after comparison uses the same "before".
+    """
+
+    def __init__(self, fixtures_dir=None):
+        import jax
+
+        # the package __init__ enables x64 at import, but under the
+        # light CLI entry (scripts/brlint.py loads analysis through a
+        # namespace parent, never running that init) it must be pinned
+        # here — the kernels and the dtype-leak check are defined in
+        # f64 terms.  Idempotent when the real package imported first.
+        jax.config.update("jax_enable_x64", True)
+        import jax.numpy as jnp
+
+        self.jax = jax
+        self.jnp = jnp
+        self.fixtures = _fixture_dir(fixtures_dir)
+        self._memo = {}
+
+        from ..ops.gas_kinetics import _exp32_enabled
+
+        self.check_dtype = not _exp32_enabled()
+        self.modes, self.gm, self.th = self._build_modes()
+        _tag, self.rhs, self.jac, self.y0, self.cfg = self.modes[0]
+
+    def _build_modes(self):
+        """(tag, rhs, jac, y0, cfg) for the four chemistry modes."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.gas import compile_gaschemistry
+        from ..models.surface import compile_mech
+        from ..models.thermo import create_thermo
+        from ..ops.rhs import (make_gas_jac, make_gas_rhs,
+                               make_surface_jac, make_surface_rhs,
+                               make_udf_rhs)
+        from ..utils.composition import density, mole_to_mass
+
+        fixtures = self.fixtures
+        gm = compile_gaschemistry(os.path.join(fixtures, "h2o2.dat"))
+        th = create_thermo(list(gm.species),
+                           os.path.join(fixtures, "therm.dat"))
+        sm = compile_mech(os.path.join(fixtures, "h2oni.xml"), th,
+                          list(gm.species))
+
+        T, p = 1100.0, 1e5
+        sp = list(gm.species)
+        x = np.zeros(len(sp))
+        x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = \
+            0.3, 0.2, 0.5
+        x = jnp.asarray(x, dtype=jnp.float64)
+        rho = density(x, th.molwt, T, p)
+        y_gas = rho * mole_to_mass(x, th.molwt)
+        y_coupled = jnp.concatenate(
+            [y_gas, jnp.asarray(sm.ini_covg, dtype=jnp.float64)])
+        cfg = {"T": jnp.asarray(T, dtype=jnp.float64),
+               "Asv": jnp.asarray(1.0, dtype=jnp.float64)}
+
+        def udf(t, state):
+            # traceable toy source: first-order decay toward equal mole
+            # fractions — exercises the full UDF state plumbing
+            return (1.0 / len(state["molwt"])
+                    - state["mole_frac"]) * 1e-3
+
+        modes = [
+            ("gas-rhs", make_gas_rhs(gm, th), make_gas_jac(gm, th),
+             y_gas, cfg),
+            ("surf-rhs", make_surface_rhs(sm, th),
+             make_surface_jac(sm, th), y_coupled, cfg),
+            ("coupled-rhs", make_surface_rhs(sm, th, gm=gm),
+             make_surface_jac(sm, th, gm=gm), y_coupled, cfg),
+            ("udf-rhs", make_udf_rhs(udf, th.molwt, species=th.species),
+             None, y_gas, cfg),
+        ]
+        return modes, gm, th
+
+    # ---- generic tracing helpers ------------------------------------------
+    def jaxpr(self, fn, *args):
+        return self.jax.make_jaxpr(fn)(*args)
+
+    def memo(self, key, thunk):
+        """Memoize an expensive artifact (a baseline trace string)
+        across contracts — first builder to ask computes it."""
+        if key not in self._memo:
+            self._memo[key] = thunk()
+        return self._memo[key]
+
+    def solver_run(self, solve, **skw):
+        """``y0_ -> solve(rhs, y0_, ...).y`` over the gas fixture —
+        exactly as ``api._solve`` compiles the step program (the
+        while_loop body IS the step program; sub-jaxpr descent covers
+        it).  Bounded steps: trace cost only."""
+        rhs, jac, cfg = self.rhs, self.jac, self.cfg
+
+        def run(y0_):
+            return solve(rhs, y0_, 0.0, 1e-7, cfg, rtol=1e-6,
+                         atol=1e-10, max_steps=3, n_save=0, jac=jac,
+                         **skw).y
+
+        return run
+
+    def solver_jaxpr(self, solve, **skw):
+        return self.jaxpr(self.solver_run(solve, **skw), self.y0)
+
+    def solver_jaxpr_str(self, solve, **skw):
+        key = ("solver", getattr(solve, "__module__", ""),
+               repr(sorted(skw.items())))
+        return self.memo(key,
+                         lambda: str(self.solver_jaxpr(solve, **skw)))
+
+    def batched(self, n):
+        """(y0b, cfgb): the gas fixture broadcast over ``n`` lanes."""
+        jnp = self.jnp
+        y0b = jnp.stack([self.y0] * n)
+        cfgb = {k: jnp.broadcast_to(v, (n,)) for k, v in
+                self.cfg.items()}
+        return y0b, cfgb
+
+    # ---- sensitivity fixture ----------------------------------------------
+    def sens_fixture(self):
+        """(spec, theta, rhs_theta) over two reactions of the gas
+        fixture — tiny selection, trace cost only; memoized so the
+        forward and adjoint contracts share one construction."""
+
+        def build():
+            from ..ops.rhs import make_gas_rhs
+            from ..sensitivity import params as sp
+
+            spec = sp.select(self.gm, reactions=(0, 1))
+            theta = sp.extract(self.gm, spec)
+            rhs_theta = sp.make_rhs_theta(
+                self.gm, spec, lambda m: make_gas_rhs(m, self.th))
+            return spec, theta, rhs_theta
+
+        return self.memo("sens-fixture", build)
+
+
+# --------------------------------------------------------------------------
+# completeness: every armed CompileWatch label has a contract
+# --------------------------------------------------------------------------
+def _package_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def armed_region_labels(root=None):
+    """``{label: [path:line, ...]}`` of every literal-label
+    ``*.region("<label>", ..., single_program=True, ...)`` call in the
+    package source — the CompileWatch label namespace of armed traced
+    programs (``obs/retrace.py``).  Non-literal labels (the AOT
+    registry's per-key regions) are not armed single-program regions
+    and are out of scope by construction."""
+    root = root or _package_root()
+    out = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read(), filename=path)
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "region"):
+                    continue
+                if not (node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                armed = any(
+                    kw.arg == "single_program"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in node.keywords)
+                # positional single_program=True (region(label, True))
+                armed = armed or (
+                    len(node.args) > 1
+                    and isinstance(node.args[1], ast.Constant)
+                    and node.args[1].value is True)
+                if armed:
+                    rel = os.path.relpath(path, os.path.dirname(root))
+                    out.setdefault(node.args[0].value, []).append(
+                        f"{rel}:{node.lineno}")
+    return out
+
+
+def completeness_findings(root=None):
+    """The tier-C completeness check (module doc): every armed
+    single-program CompileWatch label in the source must be covered by
+    a registered contract's ``labels``, and every contract label must
+    still exist in the source (stale contracts shrink the registry the
+    way stale baselines shrink the debt file)."""
+    findings = []
+    armed = armed_region_labels(root)
+    covered = {lbl for c in _REGISTRY.values() for lbl in c.labels}
+    for label, sites in sorted(armed.items()):
+        if label not in covered:
+            findings.append(Finding(
+                "contract-missing", f"<contracts:{label}>", 0, 0,
+                f"traced program label {label!r} (armed single_program "
+                f"CompileWatch region at {', '.join(sites)}) has no "
+                f"registered program contract; add @program_contract("
+                f"..., labels=({label!r},)) at its definition site"))
+    for name, c in sorted(_REGISTRY.items()):
+        for label in c.labels:
+            if label not in armed:
+                findings.append(Finding(
+                    "contract-stale", f"<contracts:{name}>", 0, 0,
+                    f"contract {name!r} ({c.module}) declares label "
+                    f"{label!r} but no armed single_program region "
+                    f"with that label exists in the source; drop the "
+                    f"label or re-arm the region"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# the engine
+# --------------------------------------------------------------------------
+def run_contracts(fixtures_dir=None, select=None, registry_audits=True):
+    """Tier C (a): import the owner modules (populating the registry),
+    build the shared harness, evaluate every contract's obligations,
+    and append the completeness check plus — ``registry_audits`` — the
+    fingerprint-completeness and counter-registry audits.  Returns a
+    list of :class:`~.core.Finding` (empty = every contract holds)."""
+    _import_owners()
+    findings = []
+    harness = Harness(fixtures_dir)
+    for name, contract in _REGISTRY.items():
+        if select is not None and name not in select:
+            continue
+        n_obligations = 0
+        try:
+            for ob in contract.build(harness):
+                n_obligations += 1
+                findings.extend(_check_obligation(ob))
+        except Exception as e:  # noqa: BLE001 — one broken contract
+            #                     must not silence the rest of the run
+            tb = traceback.format_exc(limit=3)
+            findings.append(Finding(
+                "contract-error", f"<contracts:{name}>", 0, 0,
+                f"contract {name!r} ({contract.module}) raised "
+                f"{type(e).__name__}: {e}\n{tb}"))
+            continue
+        if n_obligations == 0:
+            findings.append(Finding(
+                "contract-empty", f"<contracts:{name}>", 0, 0,
+                f"contract {name!r} ({contract.module}) yielded no "
+                f"obligations: it verifies nothing"))
+    if select is None:
+        findings.extend(completeness_findings())
+        if registry_audits:
+            findings.extend(fingerprint_registry_findings())
+            findings.extend(counter_registry_findings())
+    return findings
+
+
+# --------------------------------------------------------------------------
+# repo-level registry audits (tier C satellites)
+# --------------------------------------------------------------------------
+#: on-values used to toggle each schema knob when behaviorally checking
+#: that it moves the resume fingerprint
+_SCHEMA_KNOB_VALUES = {"stats": True, "timeline": 8}
+
+
+def fingerprint_registry_findings():
+    """Fingerprint-completeness audit (module doc): schema-changing
+    knobs must be pinned by the resume fingerprint."""
+    import numpy as np
+
+    from ..parallel import checkpoint as ck
+
+    findings = []
+    schema = tuple(getattr(ck, "SCHEMA_KNOBS", ()))
+    exempt = tuple(getattr(ck, "_FP_EXEMPT_KEYS", ()))
+    if not schema:
+        findings.append(Finding(
+            "fingerprint-registry", "<audit:fingerprint>", 0, 0,
+            "parallel/checkpoint.py declares no SCHEMA_KNOBS registry: "
+            "the fingerprint-completeness audit has nothing to pin"))
+        return findings
+    leaked = sorted(set(schema) & set(exempt))
+    if leaked:
+        findings.append(Finding(
+            "fingerprint-registry", "<audit:fingerprint>", 0, 0,
+            f"schema-changing knob(s) {leaked} are exempted from the "
+            f"resume fingerprint (_FP_EXEMPT_KEYS): a resume under a "
+            f"different value would silently serve chunks with a "
+            f"different npz/stats schema (the PR-9 timeline bug class)"))
+
+    # behavioral half: toggling a schema knob MUST move the hash (a
+    # knob in SCHEMA_KNOBS that the hash recipe skips some other way is
+    # the same leak with extra steps)
+    def rhs(t, y, cfg):
+        return -y
+
+    y0s = np.ones((2, 2))
+    cfgs = {"k": np.ones((2,))}
+    base = ck._sweep_fingerprint(rhs, y0s, cfgs, {})
+    for knob in schema:
+        if knob in leaked:
+            continue   # already reported structurally
+        on = {knob: _SCHEMA_KNOB_VALUES.get(knob, True)}
+        if ck._sweep_fingerprint(rhs, y0s, cfgs, on) == base:
+            findings.append(Finding(
+                "fingerprint-registry", "<audit:fingerprint>", 0, 0,
+                f"schema knob {knob!r} does not change the resume "
+                f"fingerprint when toggled: the hash recipe skips it "
+                f"(register it or fix _sweep_fingerprint)"))
+    # and the exempt gear knobs must NOT move it (results-neutral gear
+    # by contract — if one starts moving the hash, pre-knob checkpoint
+    # dirs stop resuming and the exemption list is lying)
+    gear_values = {"pipeline": False, "poll_every": 2,
+                   "fetch_deadline": 30.0, "admission": 2, "refill": 1,
+                   "live": None}
+    for knob in exempt:
+        on = {knob: gear_values.get(knob, 1)}
+        if ck._sweep_fingerprint(rhs, y0s, cfgs, on) != base:
+            findings.append(Finding(
+                "fingerprint-registry", "<audit:fingerprint>", 0, 0,
+                f"gear knob {knob!r} is listed fingerprint-exempt but "
+                f"still changes the hash: the exemption list and the "
+                f"recipe disagree"))
+    return findings
+
+
+def counter_registry_findings():
+    """Counter-registry audit (module doc): the ``obs/counters.py``
+    family registry must be complete and honest."""
+    import numpy as np
+
+    from ..obs import counters as C
+    from ..obs import report as R
+
+    findings = []
+    fams = getattr(C, "FAMILIES", None)
+    if not isinstance(fams, dict) or not fams:
+        findings.append(Finding(
+            "counter-registry", "<audit:counters>", 0, 0,
+            "obs/counters.py declares no FAMILIES registry: key-family "
+            "semantics are undeclared"))
+        return findings
+
+    # 1. reflection: every *_KEYS tuple in the module is a registered
+    #    family (GAUGE_KEYS is a semantic marker, not a family)
+    marker_attrs = {"GAUGE_KEYS"}
+    declared = {}
+    for fam, meta in fams.items():
+        for k in meta.get("keys", ()):
+            declared.setdefault(k, []).append(fam)
+    for attr in sorted(dir(C)):
+        if not attr.endswith("_KEYS") or attr in marker_attrs:
+            continue
+        keys = getattr(C, attr)
+        if not isinstance(keys, tuple):
+            continue
+        if not any(tuple(meta.get("keys", ())) == keys
+                   for meta in fams.values()):
+            findings.append(Finding(
+                "counter-registry", "<audit:counters>", 0, 0,
+                f"key family obs.counters.{attr} is not registered in "
+                f"FAMILIES: its additive-vs-gauge and missing->0 "
+                f"semantics are undeclared, so obs.diff / prometheus "
+                f"consumers cannot treat it correctly"))
+
+    # 2. no key in two families; semantics values sane
+    for k, where in sorted(declared.items()):
+        if len(where) > 1:
+            findings.append(Finding(
+                "counter-registry", "<audit:counters>", 0, 0,
+                f"counter key {k!r} is declared by multiple families "
+                f"{sorted(where)}: reductions would double-apply"))
+    for fam, meta in sorted(fams.items()):
+        if meta.get("semantics") not in ("additive", "gauge", "sample"):
+            findings.append(Finding(
+                "counter-registry", "<audit:counters>", 0, 0,
+                f"family {fam!r} declares unknown semantics "
+                f"{meta.get('semantics')!r} (additive|gauge|sample)"))
+        if meta.get("kind") == "host" and not meta.get("missing_zero"):
+            findings.append(Finding(
+                "counter-registry", "<audit:counters>", 0, 0,
+                f"host counter family {fam!r} does not declare "
+                f"missing_zero: a report that never ran the surface "
+                f"would diff as 'None -> n' instead of '0 -> n'"))
+
+    # 3. gauge marker consistency: GAUGE_KEYS == the union of declared
+    #    per-family gauges
+    declared_gauges = {k for meta in fams.values()
+                       for k in meta.get("gauges", ())}
+    if declared_gauges != set(C.GAUGE_KEYS):
+        findings.append(Finding(
+            "counter-registry", "<audit:counters>", 0, 0,
+            f"GAUGE_KEYS {sorted(C.GAUGE_KEYS)} and the FAMILIES gauge "
+            f"declarations {sorted(declared_gauges)} disagree: max-vs-"
+            f"sum reduction would differ by code path"))
+
+    # 4. behavioral: every missing_zero key diffs as 0 -> n through the
+    #    REAL renderer (the convention a future family must inherit)
+    for k in sorted(C.missing_zero_keys()):
+        out = R.diff({"counters": {}}, {"counters": {k: 1}})
+        if f"counter {k}: 0 -> 1" not in out:
+            findings.append(Finding(
+                "counter-registry", "<audit:counters>", 0, 0,
+                f"missing_zero key {k!r} does not follow the obs.diff "
+                f"missing->0 convention (got: "
+                f"{[ln for ln in out.splitlines() if k in ln]!r})"))
+
+    # 5. behavioral: sample families never enter counter totals
+    for fam, meta in sorted(fams.items()):
+        if meta.get("semantics") != "sample":
+            continue
+        probe = {k: np.zeros((1, 2)) for k in meta.get("keys", ())}
+        tot = C.totals(probe)
+        bad = sorted(set(tot or {}) & set(meta.get("keys", ())))
+        if bad:
+            findings.append(Finding(
+                "counter-registry", "<audit:counters>", 0, 0,
+                f"sample key(s) {bad} of family {fam!r} leak into "
+                f"counters.totals(): summing ring slots reports a "
+                f"number with no meaning"))
+    return findings

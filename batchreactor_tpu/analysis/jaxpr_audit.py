@@ -1,497 +1,34 @@
-"""brlint tier B: jaxpr audit of the RHS modes, solvers, and sensitivity
-programs.
+"""brlint tier B — now a thin face over the tier-C contract registry.
 
-The AST tier sees the *source*; this tier sees the *traced program* —
-the thing XLA actually compiles.  It builds the four chemistry modes
-(gas / surf / gas+surf / udf), both solvers' step programs, and the two
-sensitivity programs (the tangent-carrying forward BDF step and the
-adjoint fixed-grid gradient — sensitivity/) on the tiny vendored
-fixtures (tests/fixtures: h2o2.dat + therm.dat + h2oni.xml — small
-enough that every trace is sub-second on CPU) and walks each jaxpr,
-recursively through while/cond/scan sub-jaxprs, for three hazard
-classes the purity contract forbids in the hot loop:
+PR 1..9 grew this file one hand-wired audit per traced program: the
+four RHS modes, both solver step programs (± stats ± economy ±
+timeline), the pipelined segment program (± bucket-fork ± resilience ±
+admission no-op forks), the compaction program, the two sensitivity
+programs, and the lu32p kernel-presence check.  Those seven bespoke
+entry points are gone: every traced program now registers a declarative
+contract AT ITS DEFINITION SITE (``@program_contract`` in
+``ops/rhs.py``, ``solver/bdf.py``, ``solver/sdirk.py``,
+``solver/linalg_pallas.py``, ``sensitivity/forward.py``/``adjoint.py``,
+``parallel/sweep.py``) and ONE engine —
+:func:`~.contracts.run_contracts` — evaluates them all, plus the
+completeness check that fails when an armed CompileWatch label has no
+contract.  See :mod:`.contracts` for the obligation classes and
+docs/development.md "Authoring a program contract".
 
-* **host callbacks** (``pure_callback`` / ``io_callback`` /
-  ``debug_callback`` / ...): a Python round-trip per device step — the
-  one thing that single-handedly voids the 100x sweep headline.
-* **host transfers** (``device_put`` inside the traced program): a
-  traced operand was captured on the wrong device or re-staged
-  per-iteration.
-* **float-width conversions** in the RHS/Jacobian programs
-  (``convert_element_type`` between f32/f64): the kinetics kernels are
-  uniformly f64 under x64 — a width change means a constant or
-  intermediate silently dropped precision (the x64-emulation TPU paths
-  make this a 10x *cost* leak too, models/gas.py).  The check is
-  skipped when the f32 rate-exponential formulation is active
-  (``ops.gas_kinetics._exp32_enabled``) and never applied to solver
-  programs, whose mixed-precision Newton preconditioner converts by
-  design (solver/linalg.py).
-
-A fourth, structural audit backs the AOT program store (``aot/``): two
-lane counts padded into one bucket must trace to jaxpr-IDENTICAL
-segment programs (``jaxpr-bucket-fork``) — the compile-economy contract
-that one executable serves every B in a bucket.
-
-Two more structural audits back the Newton setup economy and the
-Pallas kernel path (solver/linalg_pallas.py):
-
-* **economy-noop-fork** — ``setup_economy=True`` at ``jac_window=1`` is
-  documented as a structural no-op (solver/bdf.py); the audit traces
-  both knob settings and requires byte-identical jaxprs, the same
-  invariance class as the PR-3 "stats=False jaxprs unchanged" contract.
-* **kernel-missing** — a ``linsolve="lu32p"`` step program must
-  actually contain the ``pallas_call`` primitive (a silent fallback to
-  the jnp path would keep tests green while the kernel never runs).
-
-A seventh audit backs the fault-tolerance layer (``resilience/``):
-
-* **resilience-noop-fork** — the wedge watchdog, fault injection, and
-  retry/quarantine machinery are host-side by contract; tracing the
-  segment program with the layer fully armed (injection plan +
-  ``BR_FETCH_DEADLINE_S``) must yield a byte-identical jaxpr.
-
-Two more back the continuous-batching admission layer
-(``parallel/sweep.py`` ``admission=``):
-
-* the compaction/admission program (``_compact_admit``) meets the same
-  purity contract as every traced program — gathers and selects only,
-  no callbacks, no in-loop staging;
-* **admission-noop-fork** — admission off must leave the segment
-  program byte-identical to the admission-less (PR-7) driver: the
-  segment program is re-traced after the admission machinery has been
-  built and must match the earlier trace byte-for-byte, guarding
-  against a future slot map or occupancy counter leaking into the
-  shared segment carry.
+``run_audit`` remains the stable tier-B entry point (the CLI ``--jaxpr``
+flag and tests/test_analysis.py call it); ``_audit_jaxpr`` /
+``_iter_eqns`` remain importable for tests that audit ad-hoc jaxprs.
 """
 
-import functools
-import os
-
-from .core import Finding
-
-_CALLBACK_MARKERS = ("callback", "outside_call", "host_local")
-_FLOAT_WIDTHS = {"float16", "bfloat16", "float32", "float64"}
-
-
-def _fixture_dir(fixtures_dir=None):
-    if fixtures_dir:
-        return fixtures_dir
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    return os.path.join(repo, "tests", "fixtures")
-
-
-def _iter_eqns(jaxpr, in_loop=False):
-    """(eqn, in_loop) for every equation of a (closed) jaxpr, descending
-    into sub-jaxprs (while_loop body/cond, scan, cond branches, pjit,
-    custom_jvp...).  ``in_loop`` marks equations that execute once per
-    device iteration — the scope where a host transfer actually hurts
-    (one-time operand staging in the outer program is benign)."""
-    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
-    for eqn in jaxpr.eqns:
-        yield eqn, in_loop
-        child_in_loop = in_loop or eqn.primitive.name in ("while", "scan")
-        for val in eqn.params.values():
-            for sub in _sub_jaxprs(val):
-                yield from _iter_eqns(sub, child_in_loop)
-
-
-def _sub_jaxprs(val):
-    if hasattr(val, "eqns") or hasattr(val, "jaxpr"):
-        yield val
-    elif isinstance(val, (list, tuple)):
-        for v in val:
-            yield from _sub_jaxprs(v)
-
-
-def _audit_jaxpr(tag, jaxpr, check_dtype):
-    findings = []
-    for eqn, in_loop in _iter_eqns(jaxpr):
-        prim = eqn.primitive.name
-        if any(m in prim for m in _CALLBACK_MARKERS):
-            findings.append(Finding(
-                "jaxpr-host-callback", f"<jaxpr:{tag}>", 0, 0,
-                f"host callback primitive {prim!r} inside the traced "
-                f"program: a Python round-trip per device step"))
-        elif prim == "device_put" and in_loop:
-            findings.append(Finding(
-                "jaxpr-device-transfer", f"<jaxpr:{tag}>", 0, 0,
-                "device_put inside the traced loop body: an operand is "
-                "re-staged on device every iteration (hoist the "
-                "conversion out of the loop)"))
-        elif check_dtype and prim == "convert_element_type":
-            src = str(eqn.invars[0].aval.dtype)
-            dst = str(eqn.params.get("new_dtype", ""))
-            if (src in _FLOAT_WIDTHS and dst in _FLOAT_WIDTHS
-                    and src != dst):
-                findings.append(Finding(
-                    "jaxpr-dtype-leak", f"<jaxpr:{tag}>", 0, 0,
-                    f"float width change {src} -> {dst} in a kernel "
-                    f"program that should be uniformly f64 (x64 "
-                    f"emulation: silent precision or 10x cost leak)"))
-    return findings
-
-
-def _build_modes(fixtures):
-    """(tag, rhs, jac, y0, cfg) for the four chemistry modes on the tiny
-    fixtures.  Import here: tier A must not pay the jax import."""
-    import jax.numpy as jnp
-    import numpy as np
-
-    from ..models.gas import compile_gaschemistry
-    from ..models.surface import compile_mech
-    from ..models.thermo import create_thermo
-    from ..ops.rhs import (make_gas_jac, make_gas_rhs, make_surface_jac,
-                           make_surface_rhs, make_udf_rhs)
-    from ..utils.composition import density, mole_to_mass
-
-    gm = compile_gaschemistry(os.path.join(fixtures, "h2o2.dat"))
-    th = create_thermo(list(gm.species), os.path.join(fixtures, "therm.dat"))
-    sm = compile_mech(os.path.join(fixtures, "h2oni.xml"), th,
-                      list(gm.species))
-
-    T, p = 1100.0, 1e5
-    sp = list(gm.species)
-    x = np.zeros(len(sp))
-    x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = 0.3, 0.2, 0.5
-    x = jnp.asarray(x, dtype=jnp.float64)
-    rho = density(x, th.molwt, T, p)
-    y_gas = rho * mole_to_mass(x, th.molwt)
-    y_coupled = jnp.concatenate([y_gas, jnp.asarray(sm.ini_covg,
-                                                    dtype=jnp.float64)])
-    cfg = {"T": jnp.asarray(T, dtype=jnp.float64),
-           "Asv": jnp.asarray(1.0, dtype=jnp.float64)}
-
-    def udf(t, state):
-        # traceable toy source: first-order decay toward equal mole
-        # fractions — exercises the full UDF state plumbing
-        return (1.0 / len(state["molwt"]) - state["mole_frac"]) * 1e-3
-
-    modes = [
-        ("gas-rhs", make_gas_rhs(gm, th), make_gas_jac(gm, th),
-         y_gas, cfg),
-        ("surf-rhs", make_surface_rhs(sm, th),
-         make_surface_jac(sm, th), y_coupled, cfg),
-        ("coupled-rhs", make_surface_rhs(sm, th, gm=gm),
-         make_surface_jac(sm, th, gm=gm), y_coupled, cfg),
-        ("udf-rhs", make_udf_rhs(udf, th.molwt, species=th.species),
-         None, y_gas, cfg),
-    ]
-    return modes, gm, th
+from .contracts import _audit_jaxpr, _iter_eqns, _sub_jaxprs  # noqa: F401
 
 
 def run_audit(fixtures_dir=None):
-    """Trace and audit every mode + both solver step programs; returns a
-    list of :class:`~.core.Finding` (empty = the hot path is clean)."""
-    import jax
+    """Trace and audit every registered program contract; returns a
+    list of :class:`~.core.Finding` (empty = the hot path is clean).
+    Equivalent to ``contracts.run_contracts`` without the repo-level
+    registry audits (the historical tier-B surface)."""
+    from .contracts import run_contracts
 
-    # the package __init__ enables x64 at import, but under the light CLI
-    # entry (scripts/brlint.py loads analysis through a namespace parent,
-    # never running that init) it must be pinned here — the kernels and
-    # the dtype-leak check are defined in f64 terms.  Idempotent when the
-    # real package imported first.
-    jax.config.update("jax_enable_x64", True)
-
-    from ..ops.gas_kinetics import _exp32_enabled
-    from ..solver import bdf, sdirk
-
-    fixtures = _fixture_dir(fixtures_dir)
-    check_dtype = not _exp32_enabled()
-    findings = []
-
-    modes, gm, th = _build_modes(fixtures)
-    for tag, rhs, jac, y0, cfg in modes:
-        jaxpr = jax.make_jaxpr(rhs)(0.0, y0, cfg)
-        findings.extend(_audit_jaxpr(tag, jaxpr, check_dtype))
-        if jac is not None:
-            jjaxpr = jax.make_jaxpr(jac)(0.0, y0, cfg)
-            findings.extend(_audit_jaxpr(
-                tag.replace("-rhs", "-jac"), jjaxpr, check_dtype))
-
-    # both solvers' step programs, traced exactly as api._solve compiles
-    # them (the while_loop body IS the step program; sub-jaxpr descent
-    # covers it) — plain AND telemetry-instrumented (stats=True, the
-    # counter block obs/ rides on `telemetry=`): the counters must be
-    # masked adds only, never host callbacks or in-loop device staging.
-    # Gas mode, bounded steps: trace cost only.
-    tag_rhs, rhs, jac, y0, cfg = modes[0]
-    for sname, solver, skw in (
-            ("bdf-step", bdf.solve, {}),
-            ("sdirk-step", sdirk.solve, {}),
-            ("bdf-step-stats", bdf.solve, {"stats": True}),
-            ("sdirk-step-stats", sdirk.solve, {"stats": True})):
-        def run(y0_, solver=solver, skw=skw):
-            return solver(rhs, y0_, 0.0, 1e-7, cfg, rtol=1e-6,
-                          atol=1e-10, max_steps=3, n_save=0, jac=jac,
-                          **skw).y
-
-        jaxpr = jax.make_jaxpr(run)(y0)
-        findings.extend(_audit_jaxpr(sname, jaxpr, check_dtype=False))
-
-    # the setup-economy step program (this PR's cross-window
-    # factorization carry): same purity contract — the carried
-    # factorization is data in the while-loop carry, never a callback
-    # or an in-loop staging — plus the structural no-op invariance:
-    # setup_economy=True at jac_window=1 must trace BYTE-IDENTICAL to
-    # the knob off (solver/bdf.py documents it as silently ignored
-    # there; a fork means the economy plumbing leaked into the default
-    # program — the same invariance class as the stats=False contract)
-    def _bdf_run(y0_, **skw):
-        return bdf.solve(rhs, y0_, 0.0, 1e-7, cfg, rtol=1e-6,
-                         atol=1e-10, max_steps=3, n_save=0, jac=jac,
-                         **skw).y
-
-    jaxpr = jax.make_jaxpr(functools.partial(
-        _bdf_run, jac_window=4, setup_economy=True, stats=True))(y0)
-    findings.extend(_audit_jaxpr("bdf-step-economy", jaxpr,
-                                 check_dtype=False))
-    j_off = str(jax.make_jaxpr(_bdf_run)(y0))
-    j_on = str(jax.make_jaxpr(functools.partial(
-        _bdf_run, setup_economy=True))(y0))
-    if j_off != j_on:
-        findings.append(Finding(
-            "economy-noop-fork", "<jaxpr:bdf-step-economy-noop>", 0, 0,
-            "setup_economy=True at jac_window=1 traces a DIFFERENT "
-            "program than the knob off: the economy carry leaked into "
-            "the structural-no-op configuration (solver/bdf.py "
-            "contract)"))
-
-    # the lu32p kernel path: the step program must be pure like every
-    # other mode AND must actually contain the pallas_call primitive —
-    # a silent fallback to the jnp LU would keep the parity tests green
-    # while the hand-written kernel never runs
-    jaxpr = jax.make_jaxpr(functools.partial(
-        _bdf_run, linsolve="lu32p"))(y0)
-    findings.extend(_audit_jaxpr("bdf-step-lu32p", jaxpr,
-                                 check_dtype=False))
-    prims = {e.primitive.name for e, _ in _iter_eqns(jaxpr)}
-    if not any("pallas" in p for p in prims):
-        findings.append(Finding(
-            "kernel-missing", "<jaxpr:bdf-step-lu32p>", 0, 0,
-            "linsolve='lu32p' step program contains no pallas_call "
-            "primitive: the blocked-LU kernel silently fell back to "
-            "the jnp path (solver/linalg_pallas.py)"))
-
-    # the two sensitivity programs (sensitivity/, docs/sensitivity.md):
-    # the tangent-carrying BDF step program and the adjoint fixed-grid
-    # gradient program — both must meet the same purity contract as the
-    # plain solve from day one.  Tiny selections / grids: trace cost only.
-    # dtype checks off, same as the solver programs (the mixed-precision
-    # Newton preconditioner converts by design).
-    from ..ops.rhs import make_gas_rhs as _mk_rhs
-    from ..sensitivity import adjoint as _adj
-    from ..sensitivity import forward as _fwd
-    from ..sensitivity import params as _sp
-
-    sspec = _sp.select(gm, reactions=(0, 1))
-    stheta = _sp.extract(gm, sspec)
-    srhs_theta = _sp.make_rhs_theta(gm, sspec, lambda m: _mk_rhs(m, th))
-
-    def run_sens_forward(y0_):
-        return _fwd.solve_forward(
-            srhs_theta, y0_, 0.0, 1e-7, stheta, cfg, rtol=1e-6,
-            atol=1e-10, max_steps=3, jac=jac).tangents
-
-    jaxpr = jax.make_jaxpr(run_sens_forward)(y0)
-    findings.extend(_audit_jaxpr("sens-forward-step", jaxpr,
-                                 check_dtype=False))
-
-    def run_sens_adjoint(y0_):
-        _, grad, _ = _adj.solve_adjoint(
-            srhs_theta, _adj.final_species_qoi(0), y0_, 0.0, 1e-7,
-            stheta, cfg, rtol=1e-6, atol=1e-10, grid_size=8, segments=2,
-            max_steps=8)
-        return grad["log_A"]
-
-    jaxpr = jax.make_jaxpr(run_sens_adjoint)(y0)
-    findings.extend(_audit_jaxpr("sens-adjoint-grad", jaxpr,
-                                 check_dtype=False))
-
-    # the pipelined segmented driver's traced segment program (parallel/
-    # sweep.py): the device-resident park/budget/accumulate control block
-    # and the on-device trajectory gather must meet the same purity
-    # contract as the solver step programs — no callbacks, no in-loop
-    # staging.  Plain AND stats-instrumented, with the saved-row gather
-    # active (seg_save > 0 exercises the compaction scatter).
-    import jax.numpy as jnp
-
-    from ..parallel import sweep as _sweep
-
-    y0b = jnp.stack([y0, y0])
-    cfgb = {k: jnp.broadcast_to(v, (2,)) for k, v in cfg.items()}
-
-    # ONE construction of the audited segment program per stats variant,
-    # shared by the purity audit and the bucket-invariance audit below —
-    # duplicating the 17-positional call would let the two audits drift
-    # onto different programs under a future signature/tolerance change
-    def _mk_seg_fn(sstats):
-        return _sweep._segment_fn(
-            rhs, 1e-6, 1e-10, 4, 1e-22, "auto", jac, None, 2, False, 1,
-            0.03, "bdf", sstats, True, 8, True)
-
-    def _run_seg(seg_fn, cfg_arg):
-        def run(c):
-            return seg_fn(0.0, jnp.asarray(1e-7, dtype=jnp.float64),
-                          cfg_arg, jnp.asarray(64, dtype=jnp.int64), c)
-
-        return run
-
-    plain_seg_fn = _mk_seg_fn(False)
-    for sname, seg_fn, sstats in (
-            ("segment-pipelined-step", plain_seg_fn, False),
-            ("segment-pipelined-step-stats", _mk_seg_fn(True), True)):
-        carry0 = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
-                                            sstats, 8)
-        jaxpr = jax.make_jaxpr(_run_seg(seg_fn, cfgb))(carry0)
-        findings.extend(_audit_jaxpr(sname, jaxpr, check_dtype=False))
-
-    # bucket invariance (aot/ program store): two different lane counts
-    # padded into ONE bucket must trace to byte-identical segment
-    # programs — the structural guarantee behind the zero-recompile
-    # contract (a divergence here means the padding path leaks the
-    # original B into the trace, silently forking the executable set the
-    # bucket ladder exists to bound).
-    from ..aot.buckets import resolve_bucket
-
-    bucket_jaxprs = {}
-    for Bx in (3, 4):
-        bucket = resolve_bucket(Bx, "pow2")
-        y0x = jnp.stack([y0] * Bx)
-        cfgx = {k: jnp.broadcast_to(v, (Bx,)) for k, v in cfg.items()}
-        y0p, cfgp, _ = _sweep.pad_to_bucket(y0x, cfgx, bucket)
-        carryx = _sweep._init_segment_carry(y0p, 0.0, "bdf", None, None,
-                                            False, 8)
-        jaxpr = jax.make_jaxpr(_run_seg(plain_seg_fn, cfgp))(carryx)
-        bucket_jaxprs.setdefault(bucket, []).append((Bx, str(jaxpr)))
-    for bucket, traced in bucket_jaxprs.items():
-        if len(traced) > 1 and len({s for _, s in traced}) != 1:
-            findings.append(Finding(
-                "jaxpr-bucket-fork", f"<jaxpr:segment-bucket-b{bucket}>",
-                0, 0,
-                f"padded segment programs for lane counts "
-                f"{[b for b, _ in traced]} in bucket {bucket} are not "
-                f"jaxpr-identical: the padding path leaks the original "
-                f"batch size into the trace (bucket-miss hazard)"))
-
-    # resilience no-op (resilience/ — docs/robustness.md): the fault-
-    # tolerance layer is host-side BY CONTRACT — watchdog deadlines,
-    # armed fault-injection plans, retry/quarantine policies must never
-    # reach a traced program.  Trace the segment program with the layer
-    # fully armed (injection plan + fetch-deadline env lever) and
-    # require byte-identity with the unarmed trace — the same invariance
-    # class as economy-noop-fork, guarding against a future deadline or
-    # injection hook leaking into the trace.
-    from ..resilience import inject as _inject
-
-    carry_r = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
-                                         False, 8)
-    j_unarmed = str(jax.make_jaxpr(_run_seg(plain_seg_fn, cfgb))(carry_r))
-    prev_deadline = os.environ.get("BR_FETCH_DEADLINE_S")
-    _inject.arm("hang_fetch:delay=0.01;nan_lane:lane=0")
-    os.environ["BR_FETCH_DEADLINE_S"] = "5"
-    try:
-        j_armed = str(jax.make_jaxpr(_run_seg(plain_seg_fn, cfgb))(carry_r))
-    finally:
-        _inject.disarm()
-        if prev_deadline is None:
-            os.environ.pop("BR_FETCH_DEADLINE_S", None)
-        else:
-            os.environ["BR_FETCH_DEADLINE_S"] = prev_deadline
-    if j_unarmed != j_armed:
-        findings.append(Finding(
-            "resilience-noop-fork", "<jaxpr:segment-resilience-noop>",
-            0, 0,
-            "arming the resilience layer (fault injection + watchdog "
-            "deadline) changed the traced segment program: the fault-"
-            "tolerance plumbing leaked into the trace (resilience/ "
-            "host-side contract, docs/robustness.md)"))
-
-    # continuous batching (parallel/sweep.py admission=): (1) the traced
-    # compaction/admission program is pure gathers + selects — the same
-    # no-callback/no-staging contract as the solver programs; (2) the
-    # segment program re-traced AFTER the admission machinery has been
-    # built AND EXECUTED (a real streaming sweep runs below, so carry
-    # construction, compaction, harvest, and refill all actually
-    # happen) must stay byte-identical to the pre-admission trace
-    # (j_unarmed above) — the admission-off program IS the admission-
-    # less driver's by construction, and this audit pins that against a
-    # future slot map or occupancy counter leaking into the shared
-    # segment program or its carry builder.
-    carry_c = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
-                                         False, 0)
-    fresh_c = _sweep._init_segment_carry(jnp.zeros_like(y0b), 0.0, "bdf",
-                                         None, None, False, 0)
-    order_c = jnp.arange(2, dtype=jnp.int32)
-
-    def run_compact(c):
-        return _sweep._compact_admit(
-            c, cfgb, order_c, y0b, cfgb, fresh_c,
-            jnp.asarray(1, dtype=jnp.int32), jnp.asarray(1,
-                                                         dtype=jnp.int32))
-
-    jaxpr = jax.make_jaxpr(run_compact)(carry_c)
-    findings.extend(_audit_jaxpr("sweep-compact-admit", jaxpr,
-                                 check_dtype=False))
-    # tiny linear-decay streaming sweep: exercises the whole admission
-    # path (seed, poll, harvest, compact/refill) in well under a second
-    stream_res = _sweep.ensemble_solve_segmented(
-        lambda t, y, cfg: -cfg["k"] * y,
-        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (4, 2)), 0.0, 1.0,
-        {"k": jnp.asarray([10.0, 20.0, 40.0, 80.0])}, segment_steps=8,
-        max_segments=80, pipeline=True, admission=2, refill=1,
-        poll_every=1, method="bdf")
-    assert int(stream_res.status.sum()) == 4  # 4 lanes, all SUCCESS(=1)
-    j_post = str(jax.make_jaxpr(_run_seg(plain_seg_fn, cfgb))(carry_r))
-    if j_post != j_unarmed:
-        findings.append(Finding(
-            "admission-noop-fork", "<jaxpr:segment-admission-noop>",
-            0, 0,
-            "the segment program traced after building and running the "
-            "admission machinery differs from the admission-less "
-            "trace: the continuous-batching plumbing leaked into the "
-            "shared segment program (parallel/sweep.py admission-off "
-            "byte-identity contract)"))
-
-    # per-lane timeline ring (obs/timeline.py, solver ``timeline=N``):
-    # (1) the instrumented solver and segment programs meet the same
-    # purity contract — the ring is masked row scatters on values the
-    # attempt already computed, never a callback or in-loop staging;
-    # (2) ``timeline=None`` byte-identity survives the timeline
-    # machinery having been built AND RUN (the economy/admission
-    # noop-fork invariance class): the stats-instrumented solver
-    # program and the plain segment program are re-traced after a real
-    # timeline sweep and must match their pre-timeline traces.
-    j_stats_before = str(jax.make_jaxpr(functools.partial(
-        _bdf_run, stats=True))(y0))
-    jaxpr = jax.make_jaxpr(functools.partial(
-        _bdf_run, stats=True, timeline=8))(y0)
-    findings.extend(_audit_jaxpr("bdf-step-timeline", jaxpr,
-                                 check_dtype=False))
-    tl_seg_fn = _sweep._segment_fn(
-        rhs, 1e-6, 1e-10, 4, 1e-22, "auto", jac, None, 0, False, 1,
-        0.03, "bdf", True, True, 0, True, timeline=8)
-    carry_t = _sweep._init_segment_carry(y0b, 0.0, "bdf", None, None,
-                                         True, 0, timeline=8)
-    jaxpr = jax.make_jaxpr(_run_seg(tl_seg_fn, cfgb))(carry_t)
-    findings.extend(_audit_jaxpr("segment-pipelined-step-timeline",
-                                 jaxpr, check_dtype=False))
-    tl_res = _sweep.ensemble_solve_segmented(
-        lambda t, y, cfg: -cfg["k"] * y,
-        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (2, 2)), 0.0, 1.0,
-        {"k": jnp.asarray([10.0, 40.0])}, segment_steps=8,
-        max_segments=200, pipeline=True, poll_every=1, method="bdf",
-        stats=True, timeline=8)
-    assert int(tl_res.status.sum()) == 2  # 2 lanes, all SUCCESS(=1)
-    j_stats_after = str(jax.make_jaxpr(functools.partial(
-        _bdf_run, stats=True))(y0))
-    j_seg_after = str(jax.make_jaxpr(_run_seg(plain_seg_fn,
-                                              cfgb))(carry_r))
-    if j_stats_after != j_stats_before or j_seg_after != j_unarmed:
-        findings.append(Finding(
-            "timeline-noop-fork", "<jaxpr:timeline-noop>", 0, 0,
-            "tracing after building and running the timeline ring "
-            "changed a timeline-off program (solver stats step or "
-            "segment program): the ring plumbing leaked into the "
-            "default trace (solver/bdf.py timeline=None byte-identity "
-            "contract)"))
-    return findings
+    return run_contracts(fixtures_dir=fixtures_dir,
+                         registry_audits=False)

@@ -118,6 +118,52 @@ SERVE_KEYS = ("serve_requests", "serve_lanes", "serve_answered",
               "serve_latency_s", "serve_warmup_s", "fed_lanes")
 
 
+#: THE counter-family registry (brlint tier-C counter-registry audit,
+#: analysis/contracts.py): every ``*_KEYS`` family above must appear
+#: here with its semantics declared, so a consumer (``obs.diff``,
+#: the Prometheus renderers, fleet merge) can treat any key correctly
+#: without per-family special cases — and a FUTURE family cannot land
+#: without declaring itself (the audit reflects over the module).
+#:
+#: ``kind``: ``device`` counters ride the solver stats carry; ``host``
+#: counters are Recorder counters.  ``semantics``: ``additive`` keys
+#: sum across lanes/segments/hosts; ``sample`` keys are slot-keyed
+#: payload buffers that must never enter counter totals; per-key
+#: ``gauges`` overrides mark high-water marks reduced by max (the
+#: ``GAUGE_KEYS`` marker is derived-equal by the audit).
+#: ``missing_zero``: the key is absent from a report whose run never
+#: exercised the surface, and ``obs.diff`` maps missing to 0 — REQUIRED
+#: for every host family (a fault-free baseline must diff cleanly
+#: against a faulted run instead of reporting "None -> n").
+FAMILIES = {
+    "solver-common": {"keys": COMMON_KEYS, "kind": "device",
+                      "semantics": "additive", "missing_zero": False},
+    "solver-bdf": {"keys": BDF_KEYS, "kind": "device",
+                   "semantics": "additive", "gauges": GAUGE_KEYS,
+                   "missing_zero": False},
+    "audit": {"keys": AUDIT_KEYS, "kind": "device",
+              "semantics": "sample", "missing_zero": False},
+    "timeline": {"keys": TIMELINE_KEYS, "kind": "device",
+                 "semantics": "sample", "missing_zero": False},
+    "fault": {"keys": FAULT_KEYS, "kind": "host",
+              "semantics": "additive", "missing_zero": True},
+    "admission": {"keys": ADMISSION_KEYS, "kind": "host",
+                  "semantics": "additive", "missing_zero": True},
+    "live": {"keys": LIVE_KEYS, "kind": "host",
+             "semantics": "additive", "missing_zero": True},
+    "serve": {"keys": SERVE_KEYS, "kind": "host",
+              "semantics": "additive", "missing_zero": True},
+}
+
+
+def missing_zero_keys():
+    """Every key the ``obs.diff`` missing->0 convention covers — the
+    union over families declaring ``missing_zero`` (diff consumes THIS,
+    so registering a family enrolls its keys automatically)."""
+    return {k for meta in FAMILIES.values() if meta.get("missing_zero")
+            for k in meta["keys"]}
+
+
 def occupancy(counters):
     """Derived occupancy gauge: ``lane_attempts / lane_capacity`` from a
     report's counter dict, or ``None`` when the pair is absent/zero (the

@@ -53,6 +53,16 @@ import time
 from .export import _metric, to_prometheus
 from .report import build_report
 
+#: brlint host-concurrency lint (analysis/concurrency.py): the registry
+#: is published from driver threads and scraped from HTTP handler
+#: threads concurrently (cross-module thread entry is declared, not
+#: inferred)
+_BRLINT_THREAD_ENTRIES = ("LiveRegistry.publish", "LiveRegistry.clear",
+                          "LiveRegistry.retire", "LiveRegistry.report",
+                          "LiveRegistry.gauges",
+                          "LiveRegistry.prometheus",
+                          "LiveRegistry.healthz")
+
 
 def resolve_live_metrics(live_metrics=None):
     """THE resolution rule for the live metrics endpoint knob (the
@@ -113,15 +123,36 @@ class LiveRegistry:
         with self._lock:
             self._overlays.pop(source, None)
 
+    def retire(self, source, counters=None):
+        """Atomically drop ``source``'s overlay AND fold its final
+        counter totals onto the recorder — the drivers' clear-on-return
+        path.  The old sequence (recorder fold, then :meth:`clear`)
+        left a window where a concurrent scrape merged the final totals
+        WITH the still-standing overlay and double-counted the whole
+        sweep; folding and clearing under the registry lock — the same
+        lock :meth:`_merged` now holds across its recorder read —
+        closes it: a scrape sees the overlay or the folded totals,
+        never both and never neither (regression:
+        tests/test_live.py)."""
+        with self._lock:
+            self._overlays.pop(source, None)
+            if self.recorder is not None:
+                for k, v in (counters or {}).items():
+                    self.recorder.counter(k, v)
+
     # ---- read side (the endpoint) -----------------------------------------
     def _merged(self):
         """(counters, gauges): recorder counters + summed overlay
         deltas; overlay gauges merged across sources (later sources
-        win on a name collision — sources are distinct by convention)."""
-        base = {}
-        if self.recorder is not None:
-            base = dict(self.recorder.snapshot()[2])
+        win on a name collision — sources are distinct by convention).
+        The recorder read happens UNDER the registry lock so it is
+        atomic with the overlay read against :meth:`retire` (lock
+        order registry -> recorder, same as retire; the recorder never
+        calls back into the registry, so the order is acyclic)."""
         with self._lock:
+            base = {}
+            if self.recorder is not None:
+                base = dict(self.recorder.snapshot()[2])
             overlays = [dict(o) for o in self._overlays.values()]
         gauges = {}
         for o in overlays:

@@ -209,13 +209,15 @@ def diff(a, b):
         return str(v)
 
     ka, kb = a.get("counters") or {}, b.get("counters") or {}
+    missing_zero = C.missing_zero_keys()
     for k in sorted(set(ka) | set(kb)):
         va, vb = ka.get(k), kb.get(k)
-        if (k in C.FAULT_KEYS or k in C.ADMISSION_KEYS
-                or k in C.LIVE_KEYS or k in C.SERVE_KEYS):
-            # fault/admission/live-plane/serving counters are absent
-            # from fault-free / admission-less / endpoint-less /
-            # serve-less reports: missing is 0, not a difference (the
+        if k in missing_zero:
+            # host counter families (fault/admission/live/serve — the
+            # counters.FAMILIES registry's missing_zero declaration,
+            # which registering a future family joins automatically)
+            # are absent from reports whose run never exercised the
+            # surface: missing is 0, not a difference (the
             # setup_reuses/cache_* convention)
             va, vb = va or 0, vb or 0
             if va == vb:

@@ -210,3 +210,26 @@ def make_udf_rhs(udf, molwt, species=None):
         return source * molwt
 
     return rhs
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contract (analysis/contracts.py): the four
+# chemistry modes and their analytic Jacobians are the innermost traced
+# programs of every solve — pure (no callbacks, no in-loop staging) and
+# f64-uniform (the dtype walk is skipped under the f32 rate-exponential
+# formulation; the harness resolves that).
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Pure, program_contract  # noqa: E402
+
+
+@program_contract(
+    "rhs-modes",
+    doc="four chemistry modes + analytic jacobians: pure, f64-uniform")
+def _contract_rhs_modes(h):
+    for tag, rhs, jac, y0, cfg in h.modes:
+        yield Pure(tag, h.jaxpr(rhs, 0.0, y0, cfg),
+                   check_dtype=h.check_dtype)
+        if jac is not None:
+            yield Pure(tag.replace("-rhs", "-jac"),
+                       h.jaxpr(jac, 0.0, y0, cfg),
+                       check_dtype=h.check_dtype)

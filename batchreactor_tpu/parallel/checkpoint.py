@@ -163,6 +163,34 @@ def _hash_callable(h, fn, depth=0):
                 h.update(repr(leaf).encode())
 
 
+#: knobs that change the persisted chunk npz / stats SCHEMA (keys or
+#: shapes of what a chunk artifact stores): they MUST be pinned by the
+#: resume fingerprint — a resume under a different value would silently
+#: concatenate chunks with different schemas.  ``stats`` has always
+#: hashed for this reason; a non-None ``timeline`` joined in PR 9 (the
+#: stat_timeline_* keys).  The brlint tier-C fingerprint-completeness
+#: audit (analysis/contracts.py) checks this registry stays disjoint
+#: from the exemption list below AND that toggling each knob really
+#: moves the hash — adding a schema-changing knob means registering it
+#: here, never exempting it.
+SCHEMA_KNOBS = ("stats", "timeline")
+
+#: segmented execution-GEAR / watchdog / observer knobs, contractually
+#: results-neutral (parallel/sweep.py): they change how segments are
+#: driven or how long the host waits, never the results or the chunk
+#: artifact schema, so a resume under a different gear or deadline —
+#: or a pre-knob checkpoint dir resumed after the knobs existed — must
+#: serve the same chunks, not raise a manifest mismatch.
+#: admission/refill (continuous batching) are in the same class: the
+#: permutation is un-shuffled on harvest, so chunk artifacts are
+#: position-identical; the admission ORDER is recorded in the manifest
+#: as operational metadata (``admission`` block), never pinned.  The
+#: tier-C audit verifies none of these moves the hash (and none of
+#: SCHEMA_KNOBS appears here).
+_FP_EXEMPT_KEYS = ("pipeline", "poll_every", "fetch_deadline",
+                   "admission", "refill", "live")
+
+
 def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
     """Content hash pinning a sweep's inputs: the rhs (code + captured
     mechanism tensors), initial states, per-lane conditions, and solver
@@ -200,24 +228,10 @@ def _sweep_fingerprint(rhs, y0s, cfgs, solve_kw):
             # too would make an explicit method="bdf" fingerprint differ
             # from the identical default-resolved configuration
             continue
-        if k in ("pipeline", "poll_every", "fetch_deadline", "admission",
-                 "refill", "live"):
-            # NOTE: ``timeline`` is deliberately NOT exempt — unlike the
-            # gear knobs it changes the persisted chunk-artifact schema
-            # (stat_timeline_* keys/shapes), so a resume under a
-            # different ring must fail loudly like any changed solver
-            # setting (``stats`` has always hashed for the same reason)
-            # segmented execution-GEAR / watchdog knobs, contractually
-            # results-neutral (parallel/sweep.py): they change how
-            # segments are driven or how long the host waits, never the
-            # results, so a resume under a different gear or deadline —
-            # or a pre-knob checkpoint dir resumed after the knobs
-            # existed — must serve the same chunks, not raise a manifest
-            # mismatch.  admission/refill (continuous batching) are in
-            # the same class: the permutation is un-shuffled on harvest,
-            # so chunk artifacts are position-identical; the admission
-            # ORDER is recorded in the manifest as operational metadata
-            # (``admission`` block), never pinned.
+        if k in _FP_EXEMPT_KEYS:
+            # results-neutral gear (module constant above; the tier-C
+            # fingerprint audit pins this list disjoint from
+            # SCHEMA_KNOBS — ``timeline`` is deliberately NOT here)
             continue
         v = solve_kw[k]
         h.update(k.encode())

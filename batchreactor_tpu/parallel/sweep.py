@@ -51,6 +51,14 @@ from ..solver.linalg import factor_zeros, resolve_linsolve
 
 _SOLVERS = {"sdirk": sdirk.solve, "bdf": bdf.solve}
 
+#: brlint host-concurrency lint (analysis/concurrency.py,
+#: donation-aliasing): programs returned by these builders DONATE the
+#: listed argument positions (jax.jit donate_argnums inside the cached
+#: builder, invisible at the call site) — `jitted = _cached_...(...)`
+#: call sites are then checked for owned-copy discipline, the PR-8
+#: corruption class
+_BRLINT_DONATING_BUILDERS = {"_cached_vsolve_segmented_ctrl": (4,)}
+
 
 def resolve_pipeline_defaults(pipeline=None, poll_every=None):
     """THE resolution rule for the segmented execution-gear knobs
@@ -171,6 +179,25 @@ def _host_fetch(x, recorder=None, deadline=None):
         return fetch_with_deadline(x, deadline, recorder,
                                    label="sweep-fetch")
     return jax.device_get(x)
+
+
+def _retire_live(live, recorder, final_counters):
+    """Clear-on-return for the drivers' live overlay: fold the final
+    counter totals onto the recorder and drop the in-flight overlay
+    ATOMICALLY (``LiveRegistry.retire``) — the old fold-then-clear
+    sequence let a concurrent scrape observe both and double-count the
+    sweep.  When the registry fronts a different recorder than the
+    driver's (no in-tree wiring does), the totals go to the driver's
+    recorder and only the clear loses atomicity."""
+    if live is not None and (final_counters is None
+                             or live.recorder is recorder):
+        live.retire("sweep", final_counters)
+        return
+    if final_counters and recorder is not None:
+        for k, v in final_counters.items():
+            recorder.counter(k, v)
+    if live is not None:
+        live.clear("sweep")
 
 
 def make_mesh(devices=None, axis="batch"):
@@ -1297,7 +1324,11 @@ class _TrajectoryDrainer:
             try:
                 self._drain(*item)
             except BaseException as e:  # noqa: BLE001 — latched for close()
-                self._exc = e
+                # single-writer latch: only this worker writes _exc;
+                # submit() reads it best-effort and close() reads it
+                # authoritatively AFTER join() (a happens-before edge),
+                # so the reference store needs no lock
+                self._exc = e  # brlint: disable=unguarded-shared-mutation
 
     def _drain(self, seg, aux):
         with span_or_null(self.recorder, "drain", segment=seg) as sp:
@@ -1321,8 +1352,13 @@ class _TrajectoryDrainer:
                 b_idx = np.searchsorted(cum, pos, side="right")
                 c_idx = pos - (cum - take)[b_idx]
                 dst = self.saved[b_idx] + c_idx
-                self.all_ts[b_idx, dst] = ts_np
-                self.all_ys[b_idx, dst] = ys_np
+                # single-writer scatter: all_ts/all_ys/saved are written
+                # ONLY by this worker thread; the main thread reads them
+                # after close() joins (a happens-before edge).  Locking
+                # every row scatter would serialize the drain against
+                # nothing — there is no second writer to exclude.
+                self.all_ts[b_idx, dst] = ts_np  # brlint: disable=unguarded-shared-mutation
+                self.all_ys[b_idx, dst] = ys_np  # brlint: disable=unguarded-shared-mutation
                 drained_ts = ts_np
             else:
                 # sharded buffers: fetch per-lane blocks, compact on host
@@ -1333,10 +1369,12 @@ class _TrajectoryDrainer:
                 src = col[None, :] < take[:, None]
                 b_idx, c_idx = np.nonzero(src)
                 dst = self.saved[b_idx] + c_idx
-                self.all_ts[b_idx, dst] = ts_np[b_idx, c_idx]
-                self.all_ys[b_idx, dst] = ys_np[b_idx, c_idx]
+                # single-writer scatter (see the compact branch above)
+                self.all_ts[b_idx, dst] = ts_np[b_idx, c_idx]  # brlint: disable=unguarded-shared-mutation
+                self.all_ys[b_idx, dst] = ys_np[b_idx, c_idx]  # brlint: disable=unguarded-shared-mutation
                 drained_ts = ts_np[b_idx, c_idx]
-            self.saved += take
+            # single-writer (worker-only) counter, read post-join
+            self.saved += take  # brlint: disable=unguarded-shared-mutation
             sp["attrs"]["rows"] = tot
             if self.recorder is not None and tot:
                 self.recorder.counter("drain_rows", tot)
@@ -1488,23 +1526,22 @@ def _run_segmented_pipelined(rhs, y0s, t1, cfgs, carry, bundle_arg, *,
     # RUNNING the carried t IS the last segment's res.t — parking never
     # touched it)
     ft = np.where(np.isnan(ft), t_np, ft)
+    # occupancy pair (docs/observability.md): useful step attempts vs
+    # the device's attempt capacity — parked lanes stepped until the
+    # next poll, early finishers inside a segment, AND dead bucket-pad
+    # lanes all read as idle capacity.  The numerator slices to the
+    # LIVE lanes (pad copies append at the end), the denominator keeps
+    # the padded B the device actually runs.  Additive across
+    # sweeps/chunks; consumers derive occupancy = lane_attempts /
+    # lane_capacity.
+    final_counters = None
     if recorder is not None and launched:
-        # occupancy pair (docs/observability.md): useful step attempts
-        # vs the device's attempt capacity — parked lanes stepped until
-        # the next poll, early finishers inside a segment, AND dead
-        # bucket-pad lanes all read as idle capacity.  The numerator
-        # slices to the LIVE lanes (pad copies append at the end), the
-        # denominator keeps the padded B the device actually runs.
-        # Additive across sweeps/chunks; consumers derive occupancy =
-        # lane_attempts / lane_capacity.
-        recorder.counter("lane_attempts",
-                         int(na[:nl_live].sum() + nr[:nl_live].sum()))
-        recorder.counter("lane_capacity",
-                         int(launched) * int(B) * int(segment_steps))
-    if live is not None:
-        # final totals just landed on the recorder: drop the in-flight
-        # overlay so the next scrape doesn't double-count this sweep
-        live.clear("sweep")
+        final_counters = {
+            "lane_attempts": int(na[:nl_live].sum()
+                                 + nr[:nl_live].sum()),
+            "lane_capacity": (int(launched) * int(B)
+                              * int(segment_steps))}
+    _retire_live(live, recorder, final_counters)
 
     if n_save:
         ts_out = jnp.asarray(drainer.all_ts, dtype=y0s.dtype)
@@ -1995,15 +2032,13 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
                                lanes=int(never.sum()), n_lanes=N)
         out_status[never] = int(sdirk.MAX_STEPS_REACHED)
         out_t[never] = float(t0)
+    final_counters = None
     if recorder is not None and launched:
-        recorder.counter("lane_attempts", int(out_acc.sum()
-                                              + out_rej.sum()))
-        recorder.counter("lane_capacity",
-                         int(capacity_lane_segs) * int(segment_steps))
-    if live is not None:
-        # final totals just landed on the recorder: drop the in-flight
-        # overlay so the next scrape doesn't double-count this sweep
-        live.clear("sweep")
+        final_counters = {
+            "lane_attempts": int(out_acc.sum() + out_rej.sum()),
+            "lane_capacity": (int(capacity_lane_segs)
+                              * int(segment_steps))}
+    _retire_live(live, recorder, final_counters)
     return sdirk.SolveResult(
         t=jnp.asarray(out_t, dtype=dtype), y=jnp.asarray(out_y),
         status=jnp.asarray(out_status),
@@ -2124,3 +2159,235 @@ def ignition_delay(ts, ys, marker, mode="peak"):
     else:
         raise ValueError(f"unknown ignition-delay mode {mode!r}")
     return jnp.take_along_axis(ts, idx[:, None], axis=-1)[:, 0]
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contracts (analysis/contracts.py) for the traced
+# sweep programs this module owns: the pipelined segment program (the
+# "sweep-segment" CompileWatch label), the compaction/admission program
+# ("sweep-compact"), and the no-op-fork invariants that pin the segment
+# program byte-identical under bucket padding, an armed resilience
+# layer, built-and-run admission machinery, and a built-and-run timeline
+# ring.
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Identical, Pure, program_contract  # noqa: E402
+
+
+def _contract_seg_tools(h):
+    """Shared segment-program fixture glue for the contracts below:
+    2-lane batched gas fixture plus constructors mirroring exactly how
+    the pipelined driver builds its traced program.  ONE construction
+    per harness (memoized) — duplicating the 17-positional call would
+    let two contracts drift onto different programs under a future
+    signature/tolerance change."""
+
+    def build():
+        y0b, cfgb = h.batched(2)
+
+        def mk_seg_fn(sstats, timeline=None, seg_save=2, n_save_total=8):
+            return _segment_fn(h.rhs, 1e-6, 1e-10, 4, 1e-22, "auto",
+                               h.jac, None, seg_save, False, 1, 0.03,
+                               "bdf", sstats, True, n_save_total, True,
+                               timeline=timeline)
+
+        def run_seg(seg_fn, cfg_arg):
+            def run(c):
+                return seg_fn(0.0, jnp.asarray(1e-7, dtype=jnp.float64),
+                              cfg_arg,
+                              jnp.asarray(64, dtype=jnp.int64), c)
+
+            return run
+
+        return y0b, cfgb, mk_seg_fn, run_seg
+
+    return h.memo("seg-tools", build)
+
+
+def _segment_baseline_str(h):
+    """The pre-machinery plain segment trace every no-op-fork contract
+    compares against — memoized, so the FIRST requester (before any
+    machinery has run) pins the baseline all later contracts share."""
+    y0b, cfgb, mk_seg_fn, run_seg = _contract_seg_tools(h)
+
+    def build():
+        carry = _init_segment_carry(y0b, 0.0, "bdf", None, None, False,
+                                    8)
+        return str(h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry))
+
+    return h.memo("segment-plain-jaxpr", build)
+
+
+@program_contract(
+    "sweep-segment", labels=("sweep-segment",),
+    doc="pipelined segment program, plain and stats-instrumented: pure")
+def _contract_segment(h):
+    # the device-resident park/budget/accumulate control block and the
+    # on-device trajectory gather meet the same purity contract as the
+    # solver step programs, with the saved-row gather active
+    # (seg_save > 0 exercises the compaction scatter)
+    y0b, cfgb, mk_seg_fn, run_seg = _contract_seg_tools(h)
+    for tag, sstats in (("segment-pipelined-step", False),
+                        ("segment-pipelined-step-stats", True)):
+        carry0 = _init_segment_carry(y0b, 0.0, "bdf", None, None,
+                                     sstats, 8)
+        yield Pure(tag, h.jaxpr(run_seg(mk_seg_fn(sstats), cfgb),
+                                carry0))
+
+
+@program_contract(
+    "sweep-segment-bucket",
+    doc="two lane counts in one bucket trace jaxpr-identical (aot/)")
+def _contract_segment_bucket(h):
+    # the structural guarantee behind the zero-recompile contract: a
+    # divergence means the padding path leaks the original B into the
+    # trace, silently forking the executable set the bucket ladder
+    # exists to bound
+    _y0b, _cfgb, mk_seg_fn, run_seg = _contract_seg_tools(h)
+    seg_fn = mk_seg_fn(False)
+    bucket_jaxprs = {}
+    for Bx in (3, 4):
+        bucket = resolve_bucket(Bx, "pow2")
+        y0x = jnp.stack([h.y0] * Bx)
+        cfgx = {k: jnp.broadcast_to(v, (Bx,)) for k, v in h.cfg.items()}
+        y0p, cfgp, _ = pad_to_bucket(y0x, cfgx, bucket)
+        carryx = _init_segment_carry(y0p, 0.0, "bdf", None, None, False,
+                                     8)
+        jaxpr = h.jaxpr(run_seg(seg_fn, cfgp), carryx)
+        bucket_jaxprs.setdefault(bucket, []).append((Bx, str(jaxpr)))
+    for bucket, traced in bucket_jaxprs.items():
+        if len(traced) > 1:
+            (b_a, j_a), (b_b, j_b) = traced[0], traced[-1]
+            yield Identical(
+                "jaxpr-bucket-fork", f"segment-bucket-b{bucket}",
+                j_a, j_b,
+                f"padded segment programs for lane counts "
+                f"{[b for b, _ in traced]} in bucket {bucket} are not "
+                f"jaxpr-identical: the padding path leaks the original "
+                f"batch size into the trace (bucket-miss hazard)")
+
+
+@program_contract(
+    "sweep-segment-resilience",
+    doc="segment program byte-identical with the fault layer armed")
+def _contract_segment_resilience(h):
+    # the fault-tolerance layer (resilience/ — docs/robustness.md) is
+    # host-side BY CONTRACT: watchdog deadlines, armed fault-injection
+    # plans, retry/quarantine policies must never reach a traced
+    # program.  Trace with the layer fully armed (injection plan +
+    # fetch-deadline env lever) and require byte-identity.
+    from ..resilience import inject as _inject
+
+    y0b, cfgb, mk_seg_fn, run_seg = _contract_seg_tools(h)
+    j_unarmed = _segment_baseline_str(h)
+    carry = _init_segment_carry(y0b, 0.0, "bdf", None, None, False, 8)
+    prev_deadline = os.environ.get("BR_FETCH_DEADLINE_S")
+    _inject.arm("hang_fetch:delay=0.01;nan_lane:lane=0")
+    os.environ["BR_FETCH_DEADLINE_S"] = "5"
+    try:
+        j_armed = str(h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry))
+    finally:
+        _inject.disarm()
+        if prev_deadline is None:
+            os.environ.pop("BR_FETCH_DEADLINE_S", None)
+        else:
+            os.environ["BR_FETCH_DEADLINE_S"] = prev_deadline
+    yield Identical(
+        "resilience-noop-fork", "segment-resilience-noop",
+        j_unarmed, j_armed,
+        "arming the resilience layer (fault injection + watchdog "
+        "deadline) changed the traced segment program: the fault-"
+        "tolerance plumbing leaked into the trace (resilience/ "
+        "host-side contract, docs/robustness.md)")
+
+
+@program_contract(
+    "sweep-compact", labels=("sweep-compact",),
+    doc="compaction/admission program: pure gathers and selects")
+def _contract_compact(h):
+    y0b, cfgb, _mk_seg_fn, _run_seg = _contract_seg_tools(h)
+    carry_c = _init_segment_carry(y0b, 0.0, "bdf", None, None, False, 0)
+    fresh_c = _init_segment_carry(jnp.zeros_like(y0b), 0.0, "bdf", None,
+                                  None, False, 0)
+    order_c = jnp.arange(2, dtype=jnp.int32)
+
+    def run_compact(c):
+        return _compact_admit(
+            c, cfgb, order_c, y0b, cfgb, fresh_c,
+            jnp.asarray(1, dtype=jnp.int32),
+            jnp.asarray(1, dtype=jnp.int32))
+
+    yield Pure("sweep-compact-admit", h.jaxpr(run_compact, carry_c))
+
+
+@program_contract(
+    "sweep-admission",
+    doc="segment program byte-identical after admission ran")
+def _contract_admission(h):
+    # the segment program re-traced AFTER the admission machinery has
+    # been built AND EXECUTED (a real streaming sweep runs here, so
+    # carry construction, compaction, harvest, and refill all actually
+    # happen) must stay byte-identical to the pre-admission baseline —
+    # guarding against a future slot map or occupancy counter leaking
+    # into the shared segment program or its carry builder.
+    y0b, cfgb, mk_seg_fn, run_seg = _contract_seg_tools(h)
+    j_base = _segment_baseline_str(h)
+    # tiny linear-decay streaming sweep: exercises the whole admission
+    # path (seed, poll, harvest, compact/refill) in well under a second
+    stream_res = ensemble_solve_segmented(
+        lambda t, y, cfg: -cfg["k"] * y,
+        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (4, 2)), 0.0, 1.0,
+        {"k": jnp.asarray([10.0, 20.0, 40.0, 80.0])}, segment_steps=8,
+        max_segments=80, pipeline=True, admission=2, refill=1,
+        poll_every=1, method="bdf")
+    assert int(stream_res.status.sum()) == 4  # 4 lanes, all SUCCESS(=1)
+    carry = _init_segment_carry(y0b, 0.0, "bdf", None, None, False, 8)
+    j_post = str(h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry))
+    yield Identical(
+        "admission-noop-fork", "segment-admission-noop",
+        j_base, j_post,
+        "the segment program traced after building and running the "
+        "admission machinery differs from the admission-less trace: "
+        "the continuous-batching plumbing leaked into the shared "
+        "segment program (parallel/sweep.py admission-off "
+        "byte-identity contract)")
+
+
+@program_contract(
+    "sweep-timeline",
+    doc="timeline ring: instrumented programs pure; timeline=None "
+        "byte-identity survives the ring having run")
+def _contract_timeline(h):
+    # (1) the instrumented solver and segment programs meet the same
+    # purity contract — the ring is masked row scatters on values the
+    # attempt already computed; (2) timeline=None byte-identity
+    # survives the timeline machinery having been built AND RUN (the
+    # economy/admission noop-fork invariance class).
+    y0b, cfgb, mk_seg_fn, run_seg = _contract_seg_tools(h)
+    j_stats_before = h.solver_jaxpr_str(bdf.solve, stats=True)
+    j_seg_before = _segment_baseline_str(h)
+    yield Pure("bdf-step-timeline",
+               h.solver_jaxpr(bdf.solve, stats=True, timeline=8))
+    tl_seg_fn = mk_seg_fn(True, timeline=8, seg_save=0, n_save_total=0)
+    carry_t = _init_segment_carry(y0b, 0.0, "bdf", None, None, True, 0,
+                                  timeline=8)
+    yield Pure("segment-pipelined-step-timeline",
+               h.jaxpr(run_seg(tl_seg_fn, cfgb), carry_t))
+    tl_res = ensemble_solve_segmented(
+        lambda t, y, cfg: -cfg["k"] * y,
+        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (2, 2)), 0.0, 1.0,
+        {"k": jnp.asarray([10.0, 40.0])}, segment_steps=8,
+        max_segments=200, pipeline=True, poll_every=1, method="bdf",
+        stats=True, timeline=8)
+    assert int(tl_res.status.sum()) == 2  # 2 lanes, all SUCCESS(=1)
+    msg = ("tracing after building and running the timeline ring "
+           "changed a timeline-off program (solver stats step or "
+           "segment program): the ring plumbing leaked into the "
+           "default trace (solver/bdf.py timeline=None byte-identity "
+           "contract)")
+    j_stats_after = str(h.solver_jaxpr(bdf.solve, stats=True))
+    yield Identical("timeline-noop-fork", "timeline-noop-solver",
+                    j_stats_before, j_stats_after, msg)
+    carry = _init_segment_carry(y0b, 0.0, "bdf", None, None, False, 8)
+    j_seg_after = str(h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry))
+    yield Identical("timeline-noop-fork", "timeline-noop-segment",
+                    j_seg_before, j_seg_after, msg)

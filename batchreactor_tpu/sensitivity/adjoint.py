@@ -282,3 +282,27 @@ def solve_adjoint(rhs_theta, qoi_fn, y0, t0, t1, theta, cfg, *,
            "truncated": prim.n_accepted > grid_size, "ts": tk,
            "stats": prim.stats}
     return qoi, grad, aux
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contract (analysis/contracts.py): the adjoint
+# fixed-grid gradient program (IFT custom_vjp stages + checkpointed
+# segments) — same purity contract; tiny grid, trace cost only.
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Pure, program_contract  # noqa: E402
+
+
+@program_contract(
+    "sens-adjoint-grad",
+    doc="adjoint fixed-grid gradient program: pure")
+def _contract_sens_adjoint(h):
+    _spec, theta, rhs_theta = h.sens_fixture()
+
+    def run(y0_):
+        _, grad, _ = solve_adjoint(
+            rhs_theta, final_species_qoi(0), y0_, 0.0, 1e-7, theta,
+            h.cfg, rtol=1e-6, atol=1e-10, grid_size=8, segments=2,
+            max_steps=8)
+        return grad["log_A"]
+
+    yield Pure("sens-adjoint-grad", h.jaxpr(run, h.y0))

@@ -101,3 +101,26 @@ def solve_forward(rhs_theta, y0, t0, t1, theta, cfg, *, rtol=1e-6,
             jax.block_until_ready(res.y)
             sp["attrs"]["n_accepted"] = int(res.n_accepted)
     return res
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contract (analysis/contracts.py): the
+# tangent-carrying forward BDF step program must meet the same purity
+# contract as the plain solve from day one (this audit caught an
+# in-loop index-staging device_put in params.apply when it first ran).
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Pure, program_contract  # noqa: E402
+
+
+@program_contract(
+    "sens-forward-step",
+    doc="tangent-carrying forward BDF step program: pure")
+def _contract_sens_forward(h):
+    _spec, theta, rhs_theta = h.sens_fixture()
+
+    def run(y0_):
+        return solve_forward(rhs_theta, y0_, 0.0, 1e-7, theta, h.cfg,
+                             rtol=1e-6, atol=1e-10, max_steps=3,
+                             jac=h.jac).tangents
+
+    yield Pure("sens-forward-step", h.jaxpr(run, h.y0))

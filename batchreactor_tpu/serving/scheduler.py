@@ -43,6 +43,13 @@ from concurrent.futures import Future
 
 import numpy as np
 
+#: brlint host-concurrency lint (analysis/concurrency.py): the producer
+#: surface is called from arbitrary front-end threads (HTTP handler
+#: threads, the JSONL reader) — declared here because cross-module
+#: thread entry is declared, not inferred
+_BRLINT_THREAD_ENTRIES = ("Scheduler.submit", "Scheduler.drain",
+                          "Scheduler.depth", "Scheduler.start")
+
 
 class SchedulerReject(RuntimeError):
     """A request the scheduler refused; ``code`` is the response error
@@ -137,9 +144,15 @@ class Scheduler:
 
     # ---- producer side ----------------------------------------------------
     def start(self):
-        if not self._started:
-            self._started = True
-            self._worker.start()
+        # under the lock: two front-end threads racing an unguarded
+        # check-then-set could both see _started False and double-start
+        # the worker (Thread.start raises RuntimeError on the loser) —
+        # caught by the brlint host-concurrency lint, regression in
+        # tests/test_serving.py
+        with self._cond:
+            if not self._started:
+                self._started = True
+                self._worker.start()
         return self
 
     def submit(self, request):

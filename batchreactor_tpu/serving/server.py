@@ -32,6 +32,11 @@ from concurrent import futures
 from . import schema
 from .scheduler import SchedulerReject
 
+#: brlint host-concurrency lint (analysis/concurrency.py): the request
+#: plumbing runs on HTTP handler threads (each connection is its own
+#: thread — cross-module thread entry is declared, not inferred)
+_BRLINT_THREAD_ENTRIES = ("ServingServer.solve", "ServingServer.healthz")
+
 
 class _ServeHandler(http.server.BaseHTTPRequestHandler):
     front = None    # bound per-server via a subclass (ServingServer)

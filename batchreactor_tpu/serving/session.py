@@ -35,6 +35,15 @@ import numpy as np
 
 from .schema import Request  # noqa: F401  (re-exported for callers)
 
+#: brlint host-concurrency lint (analysis/concurrency.py): these run on
+#: other modules' threads — request packing on HTTP front-end threads,
+#: the stream on the scheduler worker, the health block on handler
+#: threads (cross-module thread entry is declared, not inferred)
+_BRLINT_THREAD_ENTRIES = ("SolverSession.request_lanes",
+                          "SolverSession.stream",
+                          "SolverSession.render_result",
+                          "SolverSession.healthz_extra")
+
 #: spec keys, per section — unknown keys are loud errors (the schema.py
 #: convention: a typo'd knob must not be silently ignored)
 _MECH_KEYS = ("mech", "therm")
@@ -217,12 +226,17 @@ class SolverSession:
     def __enter__(self):
         if not self._watch_entered:
             self._watch.__enter__()
-            self._watch_entered = True
+            # lifecycle flag, main thread only: set before the
+            # scheduler/front-ends start and cleared after they drain
+            # (scripts/serve.py ordering); stream() only reads it.  A
+            # GIL-atomic bool store needs no lock at that phase.
+            self._watch_entered = True  # brlint: disable=unguarded-shared-mutation
         return self
 
     def __exit__(self, *exc):
         if self._watch_entered:
-            self._watch_entered = False
+            # lifecycle flag, main thread only (see __enter__)
+            self._watch_entered = False  # brlint: disable=unguarded-shared-mutation
             self._watch.__exit__(*exc)
 
     def compile_summary(self):
@@ -302,8 +316,12 @@ class SolverSession:
         from ..aot import warmup as aot_warmup
 
         t0 = time.perf_counter()
-        self.warmed = aot_warmup(self.warmup_specs(), cache_dir=cache_dir,
-                                 log=log)
+        # startup lifecycle, main thread only: warmup completes before
+        # the scheduler/HTTP front-ends start (scripts/serve.py
+        # ordering); healthz_extra only reads the reference, and a
+        # GIL-atomic list-reference store cannot tear
+        self.warmed = aot_warmup(  # brlint: disable=unguarded-shared-mutation
+            self.warmup_specs(), cache_dir=cache_dir, log=log)
         if self.recorder is not None:
             self.recorder.counter("serve_warmup_s",
                                   time.perf_counter() - t0)

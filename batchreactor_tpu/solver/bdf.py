@@ -964,3 +964,42 @@ def solve(
         tangents=tangents, it_matrix=M_out, accept_ring=ring_out,
         stats=stats_out,
     )
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contracts (analysis/contracts.py).  The counters
+# (stats=True) must be masked adds only — never host callbacks or
+# in-loop device staging; dtype checks stay off for solver programs,
+# whose mixed-precision Newton preconditioner converts by design
+# (solver/linalg.py).
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Identical, Pure, program_contract  # noqa: E402
+
+
+@program_contract(
+    "bdf-step",
+    doc="BDF step program, plain and stats-instrumented: pure")
+def _contract_bdf_step(h):
+    yield Pure("bdf-step", h.solver_jaxpr(solve))
+    yield Pure("bdf-step-stats", h.solver_jaxpr(solve, stats=True))
+
+
+@program_contract(
+    "bdf-step-economy",
+    doc="setup-economy carry: pure; structural no-op at jac_window=1")
+def _contract_bdf_economy(h):
+    # the carried factorization is data in the while-loop carry, never a
+    # callback or an in-loop staging
+    yield Pure("bdf-step-economy",
+               h.solver_jaxpr(solve, jac_window=4, setup_economy=True,
+                              stats=True))
+    # setup_economy=True at jac_window=1 is documented as a structural
+    # no-op (solve docstring): byte-identity with the knob off — the
+    # same invariance class as the PR-3 stats=False contract
+    yield Identical(
+        "economy-noop-fork", "bdf-step-economy-noop",
+        h.solver_jaxpr_str(solve),
+        h.solver_jaxpr_str(solve, setup_economy=True),
+        "setup_economy=True at jac_window=1 traces a DIFFERENT program "
+        "than the knob off: the economy carry leaked into the "
+        "structural-no-op configuration (solver/bdf.py contract)")

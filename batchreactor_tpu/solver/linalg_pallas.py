@@ -195,3 +195,28 @@ def lu32p_solve(lu_piv, b):
     bp = jnp.zeros((npad,), dtype=jnp.float32).at[:n].set(
         b.astype(jnp.float32))
     return lu_solve((LU, piv), bp)[:n]
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contract (analysis/contracts.py): the lu32p
+# step program must be pure like every other mode AND must actually
+# contain the pallas_call primitive — a silent fallback to the jnp LU
+# would keep the parity tests green while the hand-written kernel never
+# runs.
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Contains, Pure, program_contract  # noqa: E402
+
+
+@program_contract(
+    "bdf-step-lu32p",
+    doc="Pallas blocked-LU step program: pure, kernel actually present")
+def _contract_lu32p(h):
+    from .bdf import solve   # in-builder: bdf imports linalg imports here
+
+    jaxpr = h.solver_jaxpr(solve, linsolve="lu32p")
+    yield Pure("bdf-step-lu32p", jaxpr)
+    yield Contains(
+        "kernel-missing", "bdf-step-lu32p", jaxpr, "pallas",
+        "linsolve='lu32p' step program contains no pallas_call "
+        "primitive: the blocked-LU kernel silently fell back to the "
+        "jnp path (solver/linalg_pallas.py)")

@@ -479,3 +479,20 @@ def solve(
         observed=obs if observer is not None else None,
         err_prev=err_prev, stats=stats_out,
     )
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contract (analysis/contracts.py): the SDIRK
+# step program, plain and stats-instrumented — same purity contract as
+# the BDF step (dtype checks off: the Newton preconditioner converts by
+# design).
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Pure, program_contract  # noqa: E402
+
+
+@program_contract(
+    "sdirk-step",
+    doc="SDIRK step program, plain and stats-instrumented: pure")
+def _contract_sdirk_step(h):
+    yield Pure("sdirk-step", h.solver_jaxpr(solve))
+    yield Pure("sdirk-step-stats", h.solver_jaxpr(solve, stats=True))

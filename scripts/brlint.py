@@ -3,15 +3,20 @@
 
   python scripts/brlint.py batchreactor_tpu/            # tier-A AST scan
   python scripts/brlint.py --jaxpr                      # tier-B jaxpr audit
+  python scripts/brlint.py --tier C --json              # tier C: contracts
+                                                        #   + concurrency
+  python scripts/brlint.py --concurrency                # host-race lint only
   python scripts/brlint.py batchreactor_tpu/ --baseline brlint_baseline.json
 
 The implementation lives in batchreactor_tpu/analysis/ (rule catalogue and
-suppression policy: docs/development.md).  Tier A is a stdlib-only AST scan
-and must stay runnable on a host with no (or a broken/wedged) jax install —
-so this shim loads the analysis subpackage through a lightweight namespace
-parent instead of the real ``batchreactor_tpu/__init__``, which imports jax
-and the full solver stack at module scope.  Tier B (--jaxpr) imports jax
-lazily inside the audit and should run under JAX_PLATFORMS=cpu in CI.
+suppression policy: docs/development.md).  Tier A and the concurrency lint
+are stdlib-only AST scans and must stay runnable on a host with no (or a
+broken/wedged) jax install — so this shim loads the analysis subpackage
+through a lightweight namespace parent instead of the real
+``batchreactor_tpu/__init__``, which imports jax and the full solver stack
+at module scope.  The traced tiers (--jaxpr / --contracts) import jax
+lazily inside the contract engine and should run under JAX_PLATFORMS=cpu
+in CI.
 """
 
 import os

@@ -137,6 +137,23 @@ explicit `overloaded`/`draining` backpressure, SIGTERM graceful drain,
 and the live `/metrics` plane.  Entry points: `scripts/serve.py`
 (HTTP + stdin-JSONL) and `scripts/serve_bench.py` (seeded Poisson
 load, the round-10 latency/throughput evidence)."""),
+    ("Static analysis (brlint)", "batchreactor_tpu.analysis",
+     ["lint_paths", "lint_file", "Baseline", "Finding", "all_rules",
+      "program_contract", "run_contracts", "all_contracts",
+      "lint_concurrency_paths", "lint_concurrency_file"],
+     """\
+The tiered lint gate (docs/development.md): tier A is the AST
+tracer-safety scan; tier C is (a) the **program-contract registry** —
+every traced program registers purity/no-op-fork/kernel-presence
+obligations at its definition site via `@program_contract`, one engine
+(`run_contracts`) evaluates them all, and a completeness check fails
+when an armed CompileWatch label has no contract — plus the
+fingerprint-completeness and counter-registry audits, and (b) the
+**host-concurrency lint** (`lint_concurrency_paths`) over the threaded
+serving stack: lock discipline, `*_locked` call-site checking, lock
+ordering, blocking-under-lock, and the PR-8 donation-aliasing rule.
+CLI: `scripts/brlint.py` (`--tier C`, `--contracts`,
+`--concurrency`)."""),
     ("Kinetics kernels", "batchreactor_tpu.ops.rhs",
      ["make_gas_rhs", "make_gas_jac", "make_surface_rhs",
       "make_surface_jac", "make_udf_rhs"]),

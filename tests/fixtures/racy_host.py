@@ -1,0 +1,77 @@
+"""Deliberately-racy fixture for the brlint host-concurrency lint
+(tests/test_analysis.py): one seeded violation per rule class, plus the
+clean twins that must NOT flag.  Never imported by the package — the
+lint parses it as source only.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+_registry_lock = threading.Lock()
+_other_lock = threading.Lock()
+_REGISTRY = {}
+
+
+class RacyWorker:
+    """Seeded class: a worker thread mutates shared state unguarded,
+    blocks under the lock, and calls a *_locked helper bare."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+        self.result = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.count += 1                     # RACE: no lock held
+            self.items.append(self.count)       # RACE: no lock held
+            with self._lock:
+                time.sleep(0.1)                 # BLOCKING under lock
+            self._flush_locked()                # _locked helper, bare
+
+    def _flush_locked(self):
+        self.result = list(self.items)
+
+    def ok_mutation(self):
+        with self._lock:
+            self.count = 0                      # guarded: must NOT flag
+
+
+def inconsistent_order_a():
+    with _registry_lock:
+        with _other_lock:                       # order: registry -> other
+            return dict(_REGISTRY)
+
+
+def inconsistent_order_b():
+    with _other_lock:
+        with _registry_lock:                    # ABBA: other -> registry
+            _REGISTRY.clear()
+
+
+def unguarded_global(key, value):
+    _REGISTRY[key] = value                      # RACE: module lock exists
+
+
+def guarded_global(key, value):
+    with _registry_lock:
+        _REGISTRY[key] = value                  # guarded: must NOT flag
+
+
+_STEP = jax.jit(lambda c: c, donate_argnums=(0,))
+
+
+def donate_caller_buffer(y0s):
+    # the PR-8 corruption class: the caller's array is donated as-is —
+    # on the CPU backend the donated output scribbles over its memory
+    return _STEP(np.asarray(y0s))           # RACE: donated alias
+
+
+def donate_owned_copy(y0s):
+    carry = np.array(y0s, copy=True)
+    return _STEP(carry)                         # owned: must NOT flag

@@ -555,3 +555,351 @@ def test_chip_session_probes_before_coupled(monkeypatch):
     labels = [e[1] for e in events if e[0] == "run"]
     # the wedge was detected right after smoke: coupled never launched
     assert labels == ["tpu-smoke-tier"]
+
+
+# --- tier C (a): the program-contract registry ----------------------------
+
+class TestContracts:
+    def test_registry_census(self):
+        """Every traced-program family owns a contract registered at its
+        definition site (the ISSUE-12 census); importing the owners
+        populates the registry."""
+        from batchreactor_tpu.analysis.contracts import (_import_owners,
+                                                         all_contracts)
+
+        _import_owners()
+        names = set(all_contracts())
+        expected = {
+            "rhs-modes", "bdf-step", "bdf-step-economy", "bdf-step-lu32p",
+            "sdirk-step", "sens-forward-step", "sens-adjoint-grad",
+            "sweep-segment", "sweep-segment-bucket",
+            "sweep-segment-resilience", "sweep-compact",
+            "sweep-admission", "sweep-timeline"}
+        assert expected <= names, expected - names
+
+    def test_definition_site_registration(self):
+        """Contracts live with the programs they pin, not in analysis/."""
+        from batchreactor_tpu.analysis.contracts import (_import_owners,
+                                                         all_contracts)
+
+        _import_owners()
+        contracts = all_contracts()
+        assert contracts["bdf-step"].module.endswith("solver.bdf")
+        assert contracts["sweep-segment"].module.endswith("parallel.sweep")
+        assert contracts["rhs-modes"].module.endswith("ops.rhs")
+        assert contracts["bdf-step-lu32p"].module.endswith(
+            "solver.linalg_pallas")
+
+    def test_completeness_passes_on_package(self):
+        """Every armed single_program CompileWatch label in the source
+        has a registered contract (the acceptance gate)."""
+        from batchreactor_tpu.analysis.contracts import (
+            _import_owners, armed_region_labels, completeness_findings)
+
+        _import_owners()
+        labels = armed_region_labels()
+        # the two armed traced-program labels of the serving-era tree
+        assert {"sweep-segment", "sweep-compact"} <= set(labels)
+        assert completeness_findings() == []
+
+    def test_completeness_catches_unregistered_label(self, tmp_path):
+        """An armed single_program region whose label has no contract
+        must fail the run — a new subsystem cannot land an armed traced
+        program silently."""
+        from batchreactor_tpu.analysis.contracts import (
+            _import_owners, completeness_findings)
+
+        _import_owners()
+        mod = tmp_path / "newsub.py"
+        mod.write_text(textwrap.dedent("""
+            def run(watch, fn, x):
+                with watch.region("new-frontier", single_program=True):
+                    return fn(x)
+            """))
+        found = completeness_findings(root=str(tmp_path))
+        missing = [f for f in found if f.rule == "contract-missing"]
+        assert len(missing) == 1
+        assert "new-frontier" in missing[0].message
+
+    def test_identity_and_contains_obligations(self):
+        """The engine's obligation checks fire (unit level, no solver
+        tracing needed)."""
+        import jax
+        import jax.numpy as jnp
+
+        from batchreactor_tpu.analysis.contracts import (
+            Contains, Identical, _check_obligation)
+
+        bad = _check_obligation(Identical("economy-noop-fork", "t",
+                                          "jaxpr-a", "jaxpr-b", "forked"))
+        assert [f.rule for f in bad] == ["economy-noop-fork"]
+        assert _check_obligation(Identical("x", "t", "same", "same",
+                                           "m")) == []
+        jaxpr = jax.make_jaxpr(lambda x: x + 1.0)(jnp.zeros(()))
+        bad = _check_obligation(Contains("kernel-missing", "t", jaxpr,
+                                         "pallas", "no kernel"))
+        assert [f.rule for f in bad] == ["kernel-missing"]
+
+    def test_broken_contract_reports_not_crashes(self, monkeypatch):
+        """One raising contract becomes a contract-error finding; the
+        rest of the registry still runs."""
+        from batchreactor_tpu.analysis import contracts as C
+
+        def boom(h):
+            raise RuntimeError("fixture exploded")
+            yield  # pragma: no cover
+
+        fake = {"boom-prog": C.ProgramContract(
+            "boom-prog", boom, (), "", "test")}
+        monkeypatch.setattr(C, "_REGISTRY", fake)
+        monkeypatch.setattr(C, "_import_owners", lambda: None)
+        monkeypatch.setattr(
+            C, "Harness", lambda fixtures_dir=None: object())
+        found = C.run_contracts(registry_audits=False)
+        rules = [f.rule for f in found]
+        assert "contract-error" in rules
+        assert any("fixture exploded" in f.message for f in found)
+
+
+# --- tier C (a): the repo-level registry audits ---------------------------
+
+class TestFingerprintAudit:
+    def test_clean_on_tree(self):
+        from batchreactor_tpu.analysis.contracts import \
+            fingerprint_registry_findings
+
+        assert fingerprint_registry_findings() == []
+
+    def test_exempting_timeline_fails(self, monkeypatch):
+        """The PR-9 regression fixture: removing timeline's fingerprint
+        pin (= adding it to the gear-exemption list) must fail the
+        audit."""
+        from batchreactor_tpu.analysis.contracts import \
+            fingerprint_registry_findings
+        from batchreactor_tpu.parallel import checkpoint as ck
+
+        monkeypatch.setattr(ck, "_FP_EXEMPT_KEYS",
+                            ck._FP_EXEMPT_KEYS + ("timeline",))
+        found = fingerprint_registry_findings()
+        assert any(f.rule == "fingerprint-registry"
+                   and "timeline" in f.message for f in found)
+
+    def test_schema_knobs_actually_pin(self):
+        """Behavioral half: toggling each schema knob moves the hash;
+        toggling each gear knob does not."""
+        import numpy as np
+
+        from batchreactor_tpu.parallel import checkpoint as ck
+
+        def rhs(t, y, cfg):
+            return -y
+
+        y0s, cfgs = np.ones((2, 2)), {"k": np.ones((2,))}
+        base = ck._sweep_fingerprint(rhs, y0s, cfgs, {})
+        assert ck._sweep_fingerprint(rhs, y0s, cfgs,
+                                     {"timeline": 8}) != base
+        assert ck._sweep_fingerprint(rhs, y0s, cfgs,
+                                     {"stats": True}) != base
+        assert ck._sweep_fingerprint(rhs, y0s, cfgs,
+                                     {"poll_every": 7}) == base
+        assert ck._sweep_fingerprint(rhs, y0s, cfgs,
+                                     {"admission": 4}) == base
+
+
+class TestCounterAudit:
+    def test_clean_on_tree(self):
+        from batchreactor_tpu.analysis.contracts import \
+            counter_registry_findings
+
+        assert counter_registry_findings() == []
+
+    def test_unregistered_family_fails(self, monkeypatch):
+        """A future FOO_KEYS family that skips FAMILIES must fail the
+        audit (the can't-silently-break-diffs satellite)."""
+        from batchreactor_tpu.analysis.contracts import \
+            counter_registry_findings
+        from batchreactor_tpu.obs import counters as C
+
+        monkeypatch.setattr(C, "FRONTIER_KEYS", ("frontier_events",),
+                            raising=False)
+        found = counter_registry_findings()
+        assert any("FRONTIER_KEYS" in f.message for f in found)
+
+    def test_host_family_must_declare_missing_zero(self, monkeypatch):
+        from batchreactor_tpu.analysis.contracts import \
+            counter_registry_findings
+        from batchreactor_tpu.obs import counters as C
+
+        fams = {k: dict(v) for k, v in C.FAMILIES.items()}
+        fams["serve"]["missing_zero"] = False
+        monkeypatch.setattr(C, "FAMILIES", fams)
+        found = counter_registry_findings()
+        assert any("serve" in f.message and "missing_zero" in f.message
+                   for f in found)
+
+    def test_diff_consumes_registry(self):
+        """obs.diff's missing->0 coverage is derived from FAMILIES, so
+        a registered family is enrolled by construction."""
+        from batchreactor_tpu.obs import counters as C
+        from batchreactor_tpu.obs import report as R
+
+        for key in sorted(C.missing_zero_keys()):
+            out = R.diff({"counters": {}}, {"counters": {key: 3}})
+            assert f"counter {key}: 0 -> 3" in out
+
+
+# --- tier C (b): the host-concurrency lint --------------------------------
+
+RACY = FIXTURES / "racy_host.py"
+
+
+class TestConcurrencyLint:
+    def _findings(self):
+        from batchreactor_tpu.analysis.concurrency import \
+            lint_concurrency_file
+
+        findings, _, _ = lint_concurrency_file(str(RACY))
+        return findings
+
+    def test_racy_fixture_catches_all_rule_classes(self):
+        rules = {f.rule for f in self._findings()}
+        assert rules == {"unguarded-shared-mutation",
+                         "blocking-call-under-lock",
+                         "locked-helper-outside-lock",
+                         "lock-order-inversion",
+                         "donation-aliasing"}
+
+    def test_seeded_lines_flag_and_clean_twins_do_not(self):
+        src = RACY.read_text().splitlines()
+        findings = self._findings()
+        flagged = {f.line for f in findings}
+        # every seeded line carries a RACE/BLOCKING/ABBA/bare marker
+        seeded = {i for i, ln in enumerate(src, 1)
+                  if "# RACE" in ln or "# BLOCKING" in ln
+                  or "# ABBA" in ln or "helper, bare" in ln}
+        assert seeded <= flagged
+        # the clean twins never flag
+        clean = {i for i, ln in enumerate(src, 1) if "must NOT flag" in ln}
+        assert not (clean & flagged)
+
+    def test_donation_rule_blesses_owned_copy(self):
+        findings = [f for f in self._findings()
+                    if f.rule == "donation-aliasing"]
+        assert len(findings) == 1
+        assert findings[0].symbol == "donate_caller_buffer"
+
+    def test_suppression_applies(self, tmp_path):
+        from batchreactor_tpu.analysis.concurrency import \
+            lint_concurrency_file
+
+        code = textwrap.dedent("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self.n += 1  # brlint: disable=unguarded-shared-mutation
+            """)
+        f = tmp_path / "w.py"
+        f.write_text(code)
+        findings, n_suppressed, _ = lint_concurrency_file(str(f))
+        assert findings == [] and n_suppressed == 1
+
+    def test_threaded_host_modules_scan_clean(self):
+        """THE acceptance gate: the serving-era threaded stack runs the
+        concurrency lint clean (modulo justified suppressions)."""
+        from batchreactor_tpu.analysis.concurrency import \
+            lint_concurrency_paths
+
+        findings, _, sources = lint_concurrency_paths()
+        assert findings == [], "\n".join(f.render() for f in findings)
+        scanned = {os.path.basename(p) for p in sources}
+        assert {"scheduler.py", "session.py", "server.py", "live.py",
+                "watchdog.py", "sweep.py"} <= scanned
+
+    def test_declared_thread_entries_extend_reachability(self, tmp_path):
+        """_BRLINT_THREAD_ENTRIES pulls cross-module entry points into
+        the shared-state map (the scheduler.submit convention)."""
+        from batchreactor_tpu.analysis.concurrency import \
+            lint_concurrency_file
+
+        code = textwrap.dedent("""
+            import threading
+
+            _BRLINT_THREAD_ENTRIES = ("Q.push",)
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+
+                def push(self, x):
+                    self.items.append(x)
+            """)
+        f = tmp_path / "q.py"
+        f.write_text(code)
+        findings, _, _ = lint_concurrency_file(str(f))
+        assert [f.rule for f in findings] == ["unguarded-shared-mutation"]
+        # without the declaration the same class scans clean
+        f.write_text(code.replace('_BRLINT_THREAD_ENTRIES = ("Q.push",)',
+                                  ""))
+        findings, _, _ = lint_concurrency_file(str(f))
+        assert findings == []
+
+    def test_cli_concurrency_flag(self, capsys):
+        assert brlint_main(["--concurrency"]) == 0
+        assert brlint_main([str(RACY.parent / "racy_host.py"),
+                            "--concurrency"]) == 1
+
+    def test_cli_list_rules_includes_concurrency(self, capsys):
+        assert brlint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "donation-aliasing" in out
+        assert "unguarded-shared-mutation" in out
+
+    def test_donation_rule_catches_pr8_bare_param_shape(self, tmp_path):
+        """The motivating regression: a bare caller parameter donated
+        through a declared donating BUILDER inside a relaunch loop.
+        The donating call's own result-rebind must NOT bless its
+        operand retroactively (ownership is evaluated from bindings
+        BEFORE the call site) — with the owned-copy line present the
+        scan is clean, with it deleted the call site flags."""
+        from batchreactor_tpu.analysis.concurrency import \
+            lint_concurrency_file
+
+        template = textwrap.dedent("""
+            import jax
+            import jax.numpy as jnp
+
+            _BRLINT_DONATING_BUILDERS = {{"_cached_builder": (1,)}}
+
+            def drive(cfgs, carry):
+                jitted = _cached_builder(cfgs)
+            {bless}    for _seg in range(4):
+                    carry, aux = jitted(cfgs, carry)
+                return carry
+            """)
+        bless = ("    carry = (jnp.array(carry[0], copy=True),)"
+                 " + tuple(carry[1:])\n")
+        f = tmp_path / "drive.py"
+        f.write_text(template.format(bless=bless))
+        findings, _, _ = lint_concurrency_file(str(f))
+        assert findings == [], "\n".join(x.render() for x in findings)
+        f.write_text(template.format(bless=""))
+        findings, _, _ = lint_concurrency_file(str(f))
+        assert [x.rule for x in findings] == ["donation-aliasing"]
+        assert "'carry'" in findings[0].message
+
+    def test_sweep_declares_its_donating_builder(self):
+        """parallel/sweep.py must keep the _BRLINT_DONATING_BUILDERS
+        declaration for its cached donating segment-program builder —
+        without it the drivers' donated-carry call sites are invisible
+        to the donation rule."""
+        from batchreactor_tpu.parallel import sweep
+
+        assert sweep._BRLINT_DONATING_BUILDERS == {
+            "_cached_vsolve_segmented_ctrl": (4,)}

@@ -510,3 +510,75 @@ def test_two_concurrent_ephemeral_metrics_servers():
         _s, events, _c = recs[i].snapshot()
         bound = [e for e in events if e["name"] == "metrics_server_bound"]
         assert len(bound) == 1 and bound[0]["attrs"]["port"] > 0
+
+
+def test_retire_folds_and_clears_atomically():
+    """The clear-on-return fix (this PR's host-concurrency audit): the
+    drivers' final recorder fold and the overlay drop happen under ONE
+    registry lock (``LiveRegistry.retire``), so a concurrent scrape can
+    never sum the final totals WITH the still-standing overlay (the old
+    fold-then-clear double count) or see neither."""
+    rec = obs.Recorder()
+    reg = L.LiveRegistry(recorder=rec)
+    reg.publish("sweep", counters={"lane_attempts": 100,
+                                   "lane_capacity": 200})
+    assert reg.report()["counters"]["lane_attempts"] == 100
+    reg.retire("sweep", {"lane_attempts": 100, "lane_capacity": 200})
+    # folded exactly once, overlay gone
+    assert reg.report()["counters"]["lane_attempts"] == 100
+    assert rec.snapshot()[2]["lane_attempts"] == 100
+    # idempotent for an absent source, counters still fold
+    reg.retire("nope", {"lane_attempts": 1})
+    assert rec.snapshot()[2]["lane_attempts"] == 101
+
+
+def test_retire_never_double_counts_under_concurrent_scrapes():
+    """Stress the race window: scrapes run concurrently with
+    publish->retire cycles; with the atomic retire no merged read may
+    ever exceed the running final total (the double-count signature)."""
+    rec = obs.Recorder()
+    reg = L.LiveRegistry(recorder=rec)
+    N, VAL = 60, 1000
+    overshoot = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            seen = reg._merged()[0].get("lane_attempts", 0)
+            folded = rec.snapshot()[2].get("lane_attempts", 0)
+            # a scrape may see the in-flight overlay OR the folded
+            # total, never both summed: bounded by folded + one sweep
+            if seen > folded + VAL:
+                overshoot.append((seen, folded))
+
+    threads = [threading.Thread(target=scraper) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for _ in range(N):
+        reg.publish("sweep", counters={"lane_attempts": VAL})
+        reg.retire("sweep", {"lane_attempts": VAL})
+    stop.set()
+    for t in threads:
+        t.join()
+    assert overshoot == []
+    assert rec.snapshot()[2]["lane_attempts"] == N * VAL
+
+
+def test_sweep_driver_retires_overlay_with_final_totals():
+    """End-to-end: a live= pipelined sweep folds its final occupancy
+    pair through retire — totals land exactly once and the overlay is
+    gone at return."""
+    rec = obs.Recorder()
+    reg = L.LiveRegistry(recorder=rec)
+    res = S.ensemble_solve_segmented(
+        lambda t, y, cfg: -cfg["k"] * y,
+        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (2, 2)), 0.0, 1.0,
+        {"k": jnp.asarray([10.0, 40.0])}, segment_steps=8,
+        max_segments=200, pipeline=True, poll_every=1, method="bdf",
+        recorder=rec, live=reg)
+    assert int(np.asarray(res.status).sum()) == 2
+    counters = rec.snapshot()[2]
+    assert counters["lane_attempts"] > 0
+    # overlay retired: the merged view equals the recorder exactly
+    assert reg._merged()[0]["lane_attempts"] == counters["lane_attempts"]
+    assert reg.gauges() == {}

@@ -203,6 +203,34 @@ def _disarm_inject():
 
 
 class TestSchedulerInvariants:
+    def test_concurrent_start_is_safe(self):
+        """Regression for the brlint host-concurrency finding this PR
+        fixed: ``start()`` used an unguarded check-then-set, so two
+        front-end threads racing it could both see ``_started`` False
+        and double-start the worker (``Thread.start`` raises
+        RuntimeError on the loser).  Under the lock every racer returns
+        the same started scheduler."""
+        for _ in range(20):
+            sess = FakeSession()
+            sched = Scheduler(sess)
+            barrier = threading.Barrier(8)
+            errors = []
+
+            def go():
+                try:
+                    barrier.wait(5.0)
+                    sched.start()
+                except BaseException as e:  # noqa: BLE001 — the assert
+                    errors.append(e)
+            threads = [threading.Thread(target=go) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(5.0)
+            assert errors == []
+            assert sched._worker.is_alive()
+            sched.drain(5.0)
+
     def test_packing_round_trip(self):
         """Requests with distinct lane vectors come back in request
         lane order, regardless of how they were packed together."""
