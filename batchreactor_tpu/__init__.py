@@ -38,6 +38,12 @@ if os.environ.get("BR_PLATFORM"):
 from .models.thermo import ThermoTable, create_thermo  # noqa: E402
 from .models.gas import GasMechanism, compile_gaschemistry  # noqa: E402
 from .models.surface import SurfaceMechanism, compile_mech  # noqa: E402
+from .models.padding import (  # noqa: E402
+    mech_shape_class,
+    pad_gas_mechanism,
+    pad_states,
+    pad_thermo,
+)
 from .api import (  # noqa: E402
     Chemistry,
     SensitivityProblem,
@@ -63,6 +69,10 @@ __all__ = [
     "batch_reactor_sweep",
     "InputData",
     "input_data",
+    "mech_shape_class",
+    "pad_gas_mechanism",
+    "pad_states",
+    "pad_thermo",
     "sensitivity",
     "obs",
 ]

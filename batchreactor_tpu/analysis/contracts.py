@@ -201,6 +201,7 @@ _REGISTRY = {}
 #: is deterministic
 OWNER_MODULES = (
     "ops.rhs",
+    "models.padding",
     "solver.bdf",
     "solver.sdirk",
     "solver.linalg_pallas",

@@ -31,7 +31,10 @@ from .buckets import POW2, bucket_ladder, normalize_buckets, resolve_bucket
 _REGISTRY_NAMES = ("warmup", "spec_keys", "configure_cache",
                    "reset_persistent_cache",
                    "program_key", "mechanism_fingerprint", "load_manifest",
-                   "manifest_path", "WarmupResult")
+                   "manifest_path", "WarmupResult",
+                   "bundle_shape_signature", "merge_manifests",
+                   "touch_keys", "pin_keys", "enforce_capacity",
+                   "cache_stats")
 
 __all__ = ["POW2", "bucket_ladder", "normalize_buckets", "resolve_bucket",
            *_REGISTRY_NAMES]

@@ -112,29 +112,46 @@ def mechanism_fingerprint(*callables, extra=None):
     return h.hexdigest()
 
 
-def program_key(mech_fp, method, bucket, flags):
+def program_key(mech_fp, method, bucket, flags, mech_shape=None):
     """The registry/manifest key: ``{method}-b{bucket}-{digest12}`` over
     mechanism fingerprint x solver-config flag set x bucket.  Human-
     greppable prefix, content-addressed tail; the same (mechanism,
-    config, bucket) triple keys identically across processes."""
+    config, bucket) triple keys identically across processes.
+
+    ``mech_shape=(S, R)`` — mechanism-as-operand programs (the
+    ``rhs_bundle`` specs) — extends the B-only key to the (B, S, R)
+    ladder: the prefix grows ``-s{S}r{R}`` and the shape joins the
+    digest, so every rung of the mechanism-shape ladder is its own
+    manifest entry while the legacy B-only key format is byte-identical
+    for every pre-existing spec."""
     h = hashlib.sha256()
     h.update(mech_fp.encode())
     h.update(str(method).encode())
     h.update(str(int(bucket)).encode())
+    shape_tag = ""
+    if mech_shape is not None:
+        s_b, r_b = (int(mech_shape[0]), int(mech_shape[1]))
+        h.update(f"mech_shape=({s_b},{r_b})".encode())
+        shape_tag = f"-s{s_b}r{r_b}"
     for k in sorted(flags):
         h.update(f"{k}={flags[k]!r}".encode())
-    return f"{method}-b{int(bucket)}-{h.hexdigest()[:12]}"
+    return f"{method}-b{int(bucket)}{shape_tag}-{h.hexdigest()[:12]}"
 
 
-def manifest_path(cache_dir):
-    return os.path.join(cache_dir, _MANIFEST)
+def manifest_path(cache_dir, tag=None):
+    """Manifest file path; ``tag`` names a per-worker part manifest
+    (``warm_cache.py --fanout`` — merged by :func:`merge_manifests`)."""
+    if tag is None:
+        return os.path.join(cache_dir, _MANIFEST)
+    safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in str(tag))
+    return os.path.join(cache_dir, f"br_aot_manifest.{safe}.json")
 
 
-def load_manifest(cache_dir):
+def load_manifest(cache_dir, tag=None):
     """The on-disk manifest dict (empty skeleton when absent/corrupt —
     a damaged manifest must not block warming, which rewrites it)."""
     try:
-        with open(manifest_path(cache_dir)) as f:
+        with open(manifest_path(cache_dir, tag)) as f:
             man = json.load(f)
         if man.get("schema") == SCHEMA:
             return man
@@ -143,11 +160,60 @@ def load_manifest(cache_dir):
     return {"schema": SCHEMA, "entries": {}}
 
 
-def _save_manifest(cache_dir, man):
-    tmp = manifest_path(cache_dir) + ".tmp"
+def _save_manifest(cache_dir, man, tag=None):
+    # crash-atomic (PR-7 chunk convention): tmp + os.replace, so a
+    # SIGTERM mid-save can never leave a torn manifest
+    path = manifest_path(cache_dir, tag)
+    tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(man, f, indent=1, sort_keys=True)
-    os.replace(tmp, manifest_path(cache_dir))
+    os.replace(tmp, path)
+
+
+def _fold_entry(dst, src):
+    """Fold one manifest entry into another: counters add, gauges and
+    timestamps max, identity fields last-writer-wins."""
+    for k in ("warmups", "compiles", "cache_hits", "cache_misses"):
+        dst[k] = int(dst.get(k, 0)) + int(src.get(k, 0))
+    dst["compile_s"] = round(float(dst.get("compile_s", 0.0))
+                             + float(src.get("compile_s", 0.0)), 3)
+    for k in ("last_warmed", "last_used", "created"):
+        vals = [v for v in (dst.get(k), src.get(k)) if v]
+        if vals:
+            dst[k] = max(vals) if k != "created" else min(vals)
+    for k in ("bucket", "method", "flags", "jax", "package", "s_bucket",
+              "r_bucket"):
+        if k in src:
+            dst[k] = src[k]
+    dst["pinned"] = bool(dst.get("pinned")) or bool(src.get("pinned"))
+    return dst
+
+
+def merge_manifests(cache_dir, tags, prune=True):
+    """Fold per-worker part manifests (``manifest_path(dir, tag)``) into
+    the main manifest, crash-atomically: the parts are read, the fold is
+    written via tmp + ``os.replace``, and only THEN (``prune``) are the
+    parts deleted — a crash at any point loses no counters, at worst it
+    double-folds a part on retry (counters are operational telemetry,
+    warmth itself lives in the compilation cache files).  Returns the
+    merged manifest."""
+    man = load_manifest(cache_dir)
+    for tag in tags:
+        part = load_manifest(cache_dir, tag)
+        for key, e in part.get("entries", {}).items():
+            dst = man["entries"].setdefault(key, {})
+            _fold_entry(dst, e)
+        for k in ("jax", "package"):
+            if part.get(k):
+                man[k] = part[k]
+    _save_manifest(cache_dir, man)
+    if prune:
+        for tag in tags:
+            try:
+                os.remove(manifest_path(cache_dir, tag))
+            except OSError:
+                pass
+    return man
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,14 +233,31 @@ class WarmupResult:
 def _flag_set(kw):
     """The JSON-able solver-config flag set that joins the program key:
     every kwarg that shapes the traced program.  Callables key through
-    the mechanism fingerprint instead (their repr is address-noise)."""
+    the mechanism fingerprint instead (their repr is address-noise), and
+    ``rhs_bundle`` keys through the bundle SHAPE signature folded into
+    the fingerprint by :func:`_resolve_spec` (its array repr would be
+    content-addressed — the opposite of the operand sharing it buys)."""
     flags = {}
     for k in sorted(kw):
         v = kw[k]
-        if callable(v):
+        if callable(v) or k == "rhs_bundle":
             continue
         flags[k] = repr(v)
     return flags
+
+
+def bundle_shape_signature(bundle):
+    """The static shape class of a mechanism-operand bundle: treedef
+    repr (meta fields — canonical species/equation names, kernel flags)
+    plus per-leaf (shape, dtype).  Two bundles with equal signatures are
+    jit-cache-compatible operands of one compiled program."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(bundle)
+    return (str(treedef),
+            tuple((tuple(getattr(x, "shape", ())),
+                   str(getattr(x, "dtype", type(x).__name__)))
+                  for x in leaves))
 
 
 def _resolve_spec(spec):
@@ -183,8 +266,10 @@ def _resolve_spec(spec):
     backlog-needs-admission contract, and derives the mechanism
     fingerprint — so the --list coverage probe structurally cannot
     drift from the warming pass.  Returns ``(rhs, y0, cfg, lanes,
-    buckets, backlog, kw, method, mech_fp)`` with ``kw`` the remaining
-    sweep kwargs (== the flag set)."""
+    buckets, backlog, kw, method, mech_fp, mech_shape)`` with ``kw``
+    the remaining sweep kwargs (== the flag set) and ``mech_shape`` the
+    ``(S, R)`` operand-bundle shape rung (``None`` for closure-mode
+    specs)."""
     import jax
 
     from .buckets import normalize_buckets
@@ -209,13 +294,33 @@ def _resolve_spec(spec):
             "warmup spec: backlog > 1 needs admission= in the spec "
             "(only the streaming driver runs a backlog through a "
             "fixed resident program)")
+    # mechanism-as-operand specs (api.py mech_operands): ``rhs`` is the
+    # shared builder and the mechanism tensors ride ``rhs_bundle`` — the
+    # fingerprint is the SHAPE CLASS, not mechanism content, so every
+    # mechanism padded onto this (S, R) rung resolves to ONE key.
+    # Closure-mode specs keep the EXACT pre-PR extra (not wrapped in any
+    # container): their fingerprints — and therefore every legacy
+    # manifest key — must stay byte-identical across this upgrade.
+    bundle = kw.get("rhs_bundle")
+    mech_shape = None
+    extra = jax.tree_util.tree_map(repr, kw.get("observer_init"))
+    if bundle is not None:
+        if not kw.get("segment_steps"):
+            raise ValueError(
+                "warmup spec: rhs_bundle needs segment_steps > 0 (the "
+                "bundle mode is a segmented-driver feature)")
+        gm_b = bundle[0]
+        if gm_b is not None:
+            mech_shape = (len(gm_b.species), len(gm_b.equations))
+        extra = (extra, bundle_shape_signature(bundle))
     mech_fp = mechanism_fingerprint(
-        rhs, kw.get("jac"), kw.get("observer"),
-        extra=jax.tree_util.tree_map(repr, kw.get("observer_init")))
-    return rhs, y0, cfg, lanes, buckets, backlog, kw, method, mech_fp
+        rhs, kw.get("jac"), kw.get("observer"), extra=extra)
+    return (rhs, y0, cfg, lanes, buckets, backlog, kw, method, mech_fp,
+            mech_shape)
 
 
-def warmup(specs, *, cache_dir=None, configure=True, log=None):
+def warmup(specs, *, cache_dir=None, configure=True, log=None,
+           manifest_tag=None):
     """Pre-compile the canonical bucket programs for the given sweep
     specs; returns a list of :class:`WarmupResult` (one per program).
 
@@ -265,18 +370,22 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None):
     man = None
     if configure:
         cache_dir = configure_cache(cache_dir)
-        man = load_manifest(cache_dir)
+        # manifest_tag (warm_cache.py --fanout): each concurrent worker
+        # writes its own PART manifest and the parent merges them
+        # crash-atomically (merge_manifests) — concurrent load+save of
+        # ONE file would silently drop the loser's counters
+        man = load_manifest(cache_dir, manifest_tag)
         man["jax"] = jax.__version__
         man["package"] = _pkg_version
     results = []
     for spec in specs:
-        (rhs, y0, cfg, lanes, buckets, backlog, kw, method,
-         mech_fp) = _resolve_spec(spec)
+        (rhs, y0, cfg, lanes, buckets, backlog, kw, method, mech_fp,
+         mech_shape) = _resolve_spec(spec)
         y0 = jnp.asarray(y0)
         seg = int(kw.get("segment_steps", 0) or 0)
         for bucket in bucket_ladder(lanes, buckets):
             flags = _flag_set(kw)
-            key = program_key(mech_fp, method, bucket, flags)
+            key = program_key(mech_fp, method, bucket, flags, mech_shape)
             # backlog > 1 streams extra lanes through the bucket-slot
             # resident program so the compaction step traces too; the
             # resident shape (and therefore the program key) is still
@@ -339,8 +448,12 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None):
                 e["jax"] = jax.__version__
                 e["package"] = _pkg_version
                 e["last_warmed"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+                e["last_used"] = e["last_warmed"]
+                if mech_shape is not None:
+                    e["s_bucket"] = int(mech_shape[0])
+                    e["r_bucket"] = int(mech_shape[1])
     if man is not None:
-        _save_manifest(cache_dir, man)
+        _save_manifest(cache_dir, man, manifest_tag)
     return results
 
 
@@ -354,8 +467,109 @@ def spec_keys(spec):
     so the probe structurally cannot drift from the warming pass."""
     from .buckets import bucket_ladder
 
-    (_rhs, _y0, _cfg, lanes, buckets, _backlog, kw, method,
-     mech_fp) = _resolve_spec(spec)
+    (_rhs, _y0, _cfg, lanes, buckets, _backlog, kw, method, mech_fp,
+     mech_shape) = _resolve_spec(spec)
     flags = _flag_set(kw)
-    return [(program_key(mech_fp, method, b, flags), b)
+    return [(program_key(mech_fp, method, b, flags, mech_shape), b)
             for b in bucket_ladder(lanes, buckets)]
+
+
+# --------------------------------------------------------------------------
+# registry lifecycle: use-tracking, pin policy, LRU eviction, cache stats
+# (the program set became user-extensible with mechanism uploads —
+# docs/serving.md — so the manifest needs a bounded-growth policy)
+# --------------------------------------------------------------------------
+def touch_keys(cache_dir, keys):
+    """Mark manifest entries as used NOW (the LRU clock the serving
+    session store advances when a mechanism's programs serve a
+    request).  Unknown keys are ignored — a warm cache may predate its
+    manifest entry."""
+    man = load_manifest(cache_dir)
+    now = time.strftime("%Y-%m-%dT%H:%M:%S")
+    hit = False
+    for key in keys:
+        e = man["entries"].get(key)
+        if e is not None:
+            e["last_used"] = now
+            hit = True
+    if hit:
+        _save_manifest(cache_dir, man)
+    return man
+
+
+def pin_keys(cache_dir, keys, pinned=True):
+    """Pin (or unpin) manifest entries: pinned programs are exempt from
+    :func:`enforce_capacity` eviction — the operator's hold on the
+    mechanisms a session must never re-compile.  Returns the keys that
+    actually changed."""
+    man = load_manifest(cache_dir)
+    changed = []
+    for key in keys:
+        e = man["entries"].get(key)
+        if e is not None and bool(e.get("pinned")) != bool(pinned):
+            e["pinned"] = bool(pinned)
+            changed.append(key)
+    if changed:
+        _save_manifest(cache_dir, man)
+    return changed
+
+
+def enforce_capacity(cache_dir, max_programs, recorder=None):
+    """LRU-evict unpinned manifest entries beyond ``max_programs``.
+
+    Eviction order: least-recently-used first (``last_used``, falling
+    back to ``last_warmed``/``created``); pinned entries never evict —
+    a cap smaller than the pinned set keeps every pinned entry and
+    reports the overflow honestly.  Returns the evicted key list and
+    counts it on ``recorder`` as ``aot_evictions`` (obs FAMILIES,
+    missing->0).  Manifest-level eviction is the REGISTRY's forget: the
+    underlying XLA cache files are content-addressed and unmapped to
+    keys, so bytes on disk are reclaimed by a cache-dir purge, which
+    ``scripts/warm_cache.py --list`` sizes (total_cache_bytes)."""
+    max_programs = int(max_programs)
+    if max_programs < 0:
+        raise ValueError(f"max_programs must be >= 0, got {max_programs}")
+    man = load_manifest(cache_dir)
+    entries = man.get("entries", {})
+    if len(entries) <= max_programs:
+        return []
+    evictable = sorted(
+        (k for k, e in entries.items() if not e.get("pinned")),
+        key=lambda k: (entries[k].get("last_used")
+                       or entries[k].get("last_warmed")
+                       or entries[k].get("created") or ""))
+    n_over = len(entries) - max_programs
+    evicted = evictable[:n_over]
+    for key in evicted:
+        del entries[key]
+    if evicted:
+        _save_manifest(cache_dir, man)
+        if recorder is not None:
+            recorder.counter("aot_evictions", len(evicted))
+    return evicted
+
+
+def cache_stats(cache_dir):
+    """Cache-health summary for ``warm_cache.py --list``: entry counts,
+    NEVER-HIT entries (zero persistent-cache hits since creation — a
+    warmed program no session ever loaded is a candidate for eviction),
+    pinned keys, and the cache directory's total bytes on disk."""
+    man = load_manifest(cache_dir)
+    entries = man.get("entries", {})
+    never_hit = sorted(k for k, e in entries.items()
+                       if not int(e.get("cache_hits", 0)))
+    pinned = sorted(k for k, e in entries.items() if e.get("pinned"))
+    total = n_files = 0
+    try:
+        for root, _dirs, files in os.walk(cache_dir):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                    n_files += 1
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return {"entries": len(entries), "never_hit": never_hit,
+            "pinned": pinned, "total_cache_bytes": int(total),
+            "cache_files": int(n_files)}

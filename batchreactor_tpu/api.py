@@ -42,6 +42,7 @@ import contextlib
 import dataclasses
 import functools
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -742,6 +743,39 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
 # while cached); bounded FIFO eviction.
 _SWEEP_FNS = {}
 
+# padded (mechanism, thermo) pairs per (source ids, bucket shape): the
+# padded bundles must be IDENTITY-stable across calls for the same reason
+# as _SWEEP_FNS — a fresh padded pytree per sweep would rebuild closures
+# and recompile.  Strong refs to the sources keep the ids valid.  Unlike
+# _SWEEP_FNS (main-thread sweep calls), this cache is reached from
+# concurrent HTTP upload threads (serving SessionStore.add_upload ->
+# SolverSession.__init__), so mutation holds a lock — an unlocked
+# check-then-pop would let two uploads pop one FIFO key and KeyError.
+_PADDED_MECHS = {}
+_PADDED_MECHS_LOCK = threading.Lock()
+
+
+def _padded_mech(gm, thermo_obj, s_pad, r_pad, canonical):
+    """Identity-cached ``(gm_padded, thermo_padded)`` for a (mechanism,
+    bucket-shape) pair (cache rationale above)."""
+    from .models.padding import pad_gas_mechanism, pad_thermo
+
+    key = (id(gm), id(thermo_obj), int(s_pad), int(r_pad), bool(canonical))
+    with _PADDED_MECHS_LOCK:
+        hit = _PADDED_MECHS.get(key)
+        if hit is not None and hit[0] is gm and hit[1] is thermo_obj:
+            return hit[2], hit[3]
+    gm_pad = pad_gas_mechanism(gm, s_pad, r_pad, canonical=canonical)
+    th_pad = pad_thermo(thermo_obj, s_pad, canonical=canonical)
+    with _PADDED_MECHS_LOCK:
+        hit = _PADDED_MECHS.get(key)
+        if hit is not None and hit[0] is gm and hit[1] is thermo_obj:
+            return hit[2], hit[3]  # concurrent builder won the race
+        if len(_PADDED_MECHS) >= 32:
+            _PADDED_MECHS.pop(next(iter(_PADDED_MECHS)))
+        _PADDED_MECHS[key] = (gm, thermo_obj, gm_pad, th_pad)
+    return gm_pad, th_pad
+
 
 def _sweep_fns(mode, udf, gm, sm, thermo_obj, kc_compat, asv_quirk,
                marker_idx, ignition_mode, jac_mode="analytic"):
@@ -785,7 +819,9 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         analytic_jac=True, telemetry=False, pipeline=None,
                         poll_every=None, buckets=None, fetch_deadline=None,
                         quarantine=None, admission=None, refill=None,
-                        timeline=None, live_metrics=None):
+                        timeline=None, live_metrics=None,
+                        species_buckets=None, reaction_buckets=None,
+                        mech_operands=False):
     """Ensemble analog of the programmatic ``batch_reactor`` form: one lane
     per condition, solved in a single mesh-sharded XLA program.
 
@@ -878,6 +914,36 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     session with ``scripts/warm_cache.py`` (:mod:`batchreactor_tpu.aot`).
     The knob is validated here, up front; the resolved bucket lands in
     the telemetry meta as ``bucket``.
+
+    ``species_buckets``/``reaction_buckets`` (gas chemistry only;
+    docs/performance.md "Mechanism-shape economy") extend the same
+    bucketing discipline to the OTHER two program-shape axes: the
+    mechanism is padded onto the smallest ``(S, R)`` rung — same
+    ``buckets`` grammar per axis — with dead species carrying zero
+    mass, masked production rates, and identity Newton rows/cols, and
+    dead reactions carrying zeroed rate constants (models/padding.py
+    inertness contract).  Solver step counts and order histograms are
+    IDENTICAL padded vs unpadded (the live component count rides the
+    traced ``cfg`` as an operand, so the error norms see the live
+    denominator); padded live results match the dedicated-shape run to
+    quasi-Newton roundoff (~1e-13 relative — XLA reassociates
+    reductions across tensor shapes, the PR-8 down-shift ulp caveat's
+    sibling), with production rates themselves bit-exact.  Live-species
+    results are stripped before ``x``/``report``/telemetry.
+
+    ``mech_operands=True`` (gas, ``segment_steps > 0``, no ``mesh``/
+    ``quarantine``) additionally lifts the padded mechanism tensors
+    from closed-over compile-time constants to TRACED PROGRAM OPERANDS
+    (the segmented driver's bundle mode): two mechanisms padded onto
+    one ``(S, R)`` rung then run the SAME compiled executable — the
+    second mechanism in a warmed bucket compiles nothing (CompileWatch
+    ``sweep-segment compiles: 1 -> 0``), which is what lets the serving
+    daemon front-end arbitrary uploaded mechanisms (docs/serving.md).
+    The species/reaction ladders default to ``"pow2"`` under
+    ``mech_operands`` (an unbucketed operand program would only ever
+    match exact-shape re-parses).  With every one of these knobs off,
+    the traced programs are byte-identical to the knobs not existing
+    (tier-C ``mech-pad-noop-fork``).
 
     ``fetch_deadline`` (segmented runs only — explicit with
     ``segment_steps=0`` raises, the pipeline/poll_every loudness
@@ -995,6 +1061,32 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     # spec — aot/buckets.py is the one validation point), before any
     # mechanism parsing happens
     buckets = normalize_buckets(buckets)
+    # mechanism-shape knobs: same grammar, same one validation point.
+    # Operand mode defaults both ladders to pow2 (docstring): an
+    # unbucketed operand program would only match exact-shape re-parses.
+    if mech_operands:
+        if species_buckets is None:
+            species_buckets = "pow2"
+        if reaction_buckets is None:
+            reaction_buckets = "pow2"
+    species_buckets = normalize_buckets(species_buckets)
+    reaction_buckets = normalize_buckets(reaction_buckets)
+    mech_padding = (species_buckets is not None
+                    or reaction_buckets is not None)
+    if mech_operands:
+        if segment_steps <= 0:
+            raise ValueError(
+                "mech_operands=True runs the segmented driver's bundle "
+                "mode; set segment_steps > 0 or drop the knob")
+        if mesh is not None:
+            raise ValueError(
+                "mech_operands=True is single-mesh-free (the operand "
+                "bundle is not sharded); drop mesh= or the knob")
+        if qpol is not None:
+            raise ValueError(
+                "mech_operands=True is incompatible with quarantine= "
+                "(the recovery ladder re-solves through closure-mode "
+                "programs); drop one of them")
     if chem.userchem and (chem.gaschem or chem.surfchem):
         # the reference's du assembly is an exclusive 4-way branch
         # (/root/reference/src/BatchReactor.jl:362-373): user mode never
@@ -1059,6 +1151,26 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                          "and/or userchem")
     species = thermo_obj.species
 
+    # mechanism-shape padding (models/padding.py): the kernel-side
+    # bundles swap for padded twins; `species`/`thermo_obj` stay LIVE —
+    # they drive output naming and the [:, :ng] result strip below
+    mech_shape = None
+    gm_kernel, th_kernel = gm, thermo_obj
+    if mech_padding:
+        if mode != "gas":
+            raise ValueError(
+                "species_buckets/reaction_buckets/mech_operands support "
+                "gas chemistry only (the surface/coupled/udf state "
+                "layouts have no padding contract yet); drop the knobs "
+                f"for mode {mode!r}")
+        s_pad = (resolve_bucket(len(species), species_buckets)
+                 if species_buckets is not None else len(species))
+        r_pad = (resolve_bucket(gm.n_reactions, reaction_buckets)
+                 if reaction_buckets is not None else gm.n_reactions)
+        gm_kernel, th_kernel = _padded_mech(gm, thermo_obj, s_pad, r_pad,
+                                            canonical=mech_operands)
+        mech_shape = (s_pad, r_pad)
+
     T = jnp.atleast_1d(jnp.asarray(T, dtype=jnp.float64))
     Asv = jnp.asarray(Asv, dtype=jnp.float64)
     B = max(T.shape[0], Asv.shape[0] if Asv.ndim else 1,
@@ -1078,6 +1190,15 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     y0s = sweep_solution_vectors(jnp.asarray(X), thermo_obj.molwt, T, p,
                                  ini_covg=covg0)
     cfgs = {"T": T, "Asv": Asv}
+    if mech_shape is not None:
+        # dead species: zero initial mass + the live-count norm operand
+        # (solver/sdirk.py NLIVE_KEY contract) — what keeps step counts
+        # and order histograms identical padded vs unpadded
+        from .models.padding import NLIVE_KEY, pad_states
+
+        y0s = pad_states(y0s, mech_shape[0])
+        cfgs[NLIVE_KEY] = jnp.full((B,), float(len(species)),
+                                   dtype=jnp.float64)
     marker_idx = None
     if ignition_marker is not None:
         key = ignition_marker.upper()
@@ -1085,6 +1206,11 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
             raise KeyError(f"ignition_marker {ignition_marker!r} not in "
                            f"species list")
         marker_idx = idx[key]
+    if mech_operands and analytic_jac is not True:
+        raise ValueError(
+            "mech_operands=True builds its analytic Jacobian inside the "
+            "bundle builder; analytic_jac is not configurable there — "
+            "drop the argument")
     if isinstance(analytic_jac, str):
         if analytic_jac != "remat":
             raise ValueError(f"analytic_jac must be True, False, or "
@@ -1094,10 +1220,21 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         # truthiness, not identity: np.True_/0/1 behaved as booleans here
         # before the remat mode existed and must keep doing so
         jac_mode = "analytic" if analytic_jac else "fwd"
-    rhs, jac, observer, obs0 = _sweep_fns(mode, chem.udf, gm, sm,
-                                          thermo_obj, kc_compat, asv_quirk,
+    rhs, jac, observer, obs0 = _sweep_fns(mode, chem.udf, gm_kernel, sm,
+                                          th_kernel, kc_compat, asv_quirk,
                                           marker_idx, ignition_mode,
                                           jac_mode)
+    mech_bundle = None
+    if mech_operands:
+        # mechanism-as-operand: the SAME cached builder the file-driven
+        # segmented path uses (_segmented_builder) — the compile cache
+        # keys on its identity + the bundle's shape class, so any
+        # mechanism padded onto this (S, R) rung reuses the executable.
+        # The closure rhs/jac above are discarded; observer/obs0 (an
+        # index-closing fold, mechanism-tensor-free) ride along.
+        mech_bundle = (gm_kernel, None, th_kernel)
+        rhs = _segmented_builder(mode, None, kc_compat, asv_quirk)
+        jac = None
 
     if mesh is not None:
         # pad the batch to the mesh device count with copies of the last
@@ -1171,6 +1308,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                                            admission=admission,
                                            refill=refill,
                                            live=registry,
+                                           rhs_bundle=mech_bundle,
                                            watch=watch if telemetry
                                            else None, **common)
         else:
@@ -1256,7 +1394,10 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         "x": {s: x_end[:, k] for k, s in enumerate(species)},
         "t": np.asarray(res.t),
         "status": np.asarray(res.status),
-        "report": sweep_report(res, cfgs),
+        # reserved operand keys (_nlive) are solver plumbing, not
+        # conditions — keep them out of the failure-triage report
+        "report": sweep_report(res, {k: v for k, v in cfgs.items()
+                                     if not k.startswith("_")}),
     }
     if prov is not None:
         from .resilience import quarantine as _quarantine
@@ -1274,6 +1415,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                   "method": method, "lanes": B, "bucket": bucket,
                   "segmented": bool(segment_steps > 0),
                   "admission": admission not in (None, False),
+                  "mech_shape": mech_shape,
+                  "mech_operands": bool(mech_operands),
                   "timeline": timeline, "live_port": bound_port})
     return out
 

@@ -1,0 +1,279 @@
+"""Mechanism-shape padding: many mechanisms, one compiled program shape.
+
+The compile ledger prices ONE program *shape* at ~150 s (BDF) to ~400 s
+(SDIRK) at GRI scale (PERF.md), and the frozen mechanism pytree bakes
+into every traced program — so today each new mechanism pays the full
+wall even when it is the same size class as one already compiled.  This
+module applies the lane-count bucketing discipline (:mod:`..aot.buckets`)
+to the OTHER two program-shape axes: species (S) and reactions (R).
+
+:func:`pad_gas_mechanism` pads a :class:`~.gas.GasMechanism` onto a
+``(S_pad, R_pad)`` bucket such that the dead tail is *provably inert*:
+
+* **dead species** carry zero stoichiometry columns (``nu_f``/``nu_r``),
+  zero third-body efficiency columns, zero initial mass (the caller pads
+  states with :func:`pad_states`), and zero NASA-7 coefficients
+  (:func:`pad_thermo`) — so their production rates, their Jacobian rows
+  AND columns, and their error-norm contributions are exactly ``0.0``,
+  and the Newton iteration matrix ``M = I - cJ`` is the identity on the
+  dead block (the LU of a block-diagonal ``[M_live, I]`` reproduces the
+  live factorization bit-for-bit);
+* **dead reactions** carry ``log_A = _LOG_ZERO`` (the ln-domain zero the
+  parser already uses for absent LOW slots), zero stoichiometry rows,
+  zero efficiency rows, and every feature mask off — their net rate is
+  multiplied into the state by all-zero ``dnu`` rows, contributing an
+  exact ``+0.0`` per matmul term.
+
+The one quantity padding CAN perturb is the solver's scaled RMS error
+norm, whose mean divides by the state length: the sweep entry points
+therefore thread the live component count through the reserved
+``cfg["_nlive"]`` operand (:data:`~..solver.sdirk.NLIVE_KEY`), restoring
+the live-count denominator so step counts and order selection are
+IDENTICAL padded vs unpadded (regression-asserted, tier-1).
+
+``canonical=True`` additionally replaces the species/equation name
+tuples — static pytree *meta* fields, part of every jit cache key — with
+shape-derived placeholders, so two different mechanisms padded to one
+bucket produce bundles with IDENTICAL treedefs: the mechanism-as-operand
+path (``batch_reactor_sweep(mech_operands=True)``, ``serving/``) then
+runs both through ONE compiled executable, with the live names kept
+host-side for request packing and result rendering.
+
+Shape-compatibility is decided by :func:`mech_shape_class`: the full
+static signature (padded S/R, the PLOG/Chebyshev table dims, and the
+``int_stoich``/``any_plog``/``any_cheb`` kernel-selection flags).  Two
+mechanisms share an executable iff their padded shape classes are equal.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gas import _LOG_ZERO, GasMechanism
+from .thermo import ThermoTable
+
+#: re-export of the solvers' reserved cfg key (solver/sdirk.py owns it)
+from ..solver.sdirk import NLIVE_KEY  # noqa: F401
+
+
+def mech_shape_class(gm, thermo=None):
+    """The static program-shape signature of a (possibly padded)
+    mechanism: every mechanism attribute that changes the traced
+    program's shape or kernel selection.  Equal signatures => the
+    operand-mode bundles are jit-cache-compatible."""
+    sig = {
+        "S": int(gm.n_species),
+        "R": int(gm.n_reactions),
+        "P": int(gm.plog_lnp.shape[1]),
+        "NT": int(gm.cheb_coef.shape[1]),
+        "NP": int(gm.cheb_coef.shape[2]),
+        "int_stoich": bool(gm.int_stoich),
+        "any_plog": bool(gm.any_plog),
+        "any_cheb": bool(gm.any_cheb),
+    }
+    if thermo is not None:
+        sig["S_thermo"] = int(thermo.n_species)
+    return sig
+
+
+def _canonical_names(prefix, n):
+    return tuple(f"_{prefix}{k}" for k in range(n))
+
+
+def _pad_species_names(species, s_pad, canonical):
+    if canonical:
+        return _canonical_names("S", s_pad)
+    return tuple(species) + tuple(
+        f"_PAD_S{k}" for k in range(s_pad - len(species)))
+
+
+def pad_gas_mechanism(gm, s_pad, r_pad, *, canonical=False):
+    """Pad ``gm`` to ``s_pad`` species x ``r_pad`` reactions (module doc
+    inertness contract).  ``s_pad``/``r_pad`` below the live counts are
+    loud errors; identity padding (``s_pad == S and r_pad == R``) changes
+    tensor VALUES nowhere (tier-C ``mech-pad-noop-fork`` pins the traced
+    program byte-identical).  ``canonical=True`` swaps the static name
+    meta for shape-derived placeholders (operand-mode treedef identity).
+    """
+    S, R = gm.n_species, gm.n_reactions
+    s_pad, r_pad = int(s_pad), int(r_pad)
+    if s_pad < S or r_pad < R:
+        raise ValueError(
+            f"mechanism padding cannot shrink: live (S={S}, R={R}) vs "
+            f"requested (S={s_pad}, R={r_pad})")
+    ds, dr = s_pad - S, r_pad - R
+
+    def row_col(a, col_fill, row_fill):
+        """(R, S) -> (r_pad, s_pad): pad columns with ``col_fill``,
+        then rows with ``row_fill``."""
+        a = np.asarray(a)
+        a = np.concatenate(
+            [a, np.full((R, ds), col_fill, dtype=a.dtype)], axis=1)
+        return np.concatenate(
+            [a, np.full((dr, s_pad), row_fill, dtype=a.dtype)], axis=0)
+
+    def rows(a, fill):
+        """(R, ...) -> (r_pad, ...) with constant ``fill`` rows."""
+        a = np.asarray(a)
+        pad = np.broadcast_to(
+            np.asarray(fill, dtype=a.dtype), (dr,) + a.shape[1:])
+        return np.concatenate([a, pad], axis=0)
+
+    # dead efficiency columns MUST be zero: a live +M row's d(cM)/dc_dead
+    # equals eff[row, dead], and a nonzero entry would put mass in the
+    # Jacobian's dead columns (value-inert — conc_dead == 0 — but it
+    # would break the identity-block LU argument above)
+    troe_inert = np.array([0.6, 100.0, 1000.0, np.inf])
+    sri_inert = np.array([1.0, 0.0, np.inf, 1.0, 0.0])
+    cheb_invT_inert = np.array([1 / 300.0, 1 / 2500.0])
+    cheb_logP_inert = np.array([0.0, 1.0])
+    return GasMechanism(
+        nu_f=jnp.asarray(row_col(gm.nu_f, 0.0, 0.0)),
+        nu_r=jnp.asarray(row_col(gm.nu_r, 0.0, 0.0)),
+        log_A=jnp.asarray(rows(gm.log_A, _LOG_ZERO)),
+        beta=jnp.asarray(rows(gm.beta, 0.0)),
+        Ea=jnp.asarray(rows(gm.Ea, 0.0)),
+        eff=jnp.asarray(row_col(gm.eff, 0.0, 0.0)),
+        has_tb=jnp.asarray(rows(gm.has_tb, 0.0)),
+        has_falloff=jnp.asarray(rows(gm.has_falloff, 0.0)),
+        log_A0=jnp.asarray(rows(gm.log_A0, _LOG_ZERO)),
+        beta0=jnp.asarray(rows(gm.beta0, 0.0)),
+        Ea0=jnp.asarray(rows(gm.Ea0, 0.0)),
+        has_troe=jnp.asarray(rows(gm.has_troe, 0.0)),
+        troe=jnp.asarray(rows(gm.troe, troe_inert)),
+        has_sri=jnp.asarray(rows(gm.has_sri, 0.0)),
+        sri=jnp.asarray(rows(gm.sri, sri_inert)),
+        rev_mask=jnp.asarray(rows(gm.rev_mask, 0.0)),
+        sign_A=jnp.asarray(rows(gm.sign_A, 1.0)),
+        has_rev=jnp.asarray(rows(gm.has_rev, 0.0)),
+        log_A_rev=jnp.asarray(rows(gm.log_A_rev, _LOG_ZERO)),
+        beta_rev=jnp.asarray(rows(gm.beta_rev, 0.0)),
+        Ea_rev=jnp.asarray(rows(gm.Ea_rev, 0.0)),
+        sign_A_rev=jnp.asarray(rows(gm.sign_A_rev, 1.0)),
+        has_plog=jnp.asarray(rows(gm.has_plog, 0.0)),
+        plog_lnp=jnp.asarray(rows(gm.plog_lnp, np.inf)),
+        plog_logA=jnp.asarray(rows(gm.plog_logA, _LOG_ZERO)),
+        plog_beta=jnp.asarray(rows(gm.plog_beta, 0.0)),
+        plog_Ea=jnp.asarray(rows(gm.plog_Ea, 0.0)),
+        has_cheb=jnp.asarray(rows(gm.has_cheb, 0.0)),
+        cheb_coef=jnp.asarray(rows(gm.cheb_coef, 0.0)),
+        cheb_invT=jnp.asarray(rows(gm.cheb_invT, cheb_invT_inert)),
+        cheb_logP=jnp.asarray(rows(gm.cheb_logP, cheb_logP_inert)),
+        cheb_si_ln=jnp.asarray(rows(gm.cheb_si_ln, 0.0)),
+        species=_pad_species_names(gm.species, s_pad, canonical),
+        equations=(_canonical_names("R", r_pad) if canonical
+                   else tuple(gm.equations) + tuple(
+                       f"_PAD_R{k}" for k in range(dr))),
+        int_stoich=gm.int_stoich,
+        any_plog=gm.any_plog,
+        any_cheb=gm.any_cheb,
+    )
+
+
+def pad_thermo(thermo, s_pad, *, canonical=False):
+    """Pad a :class:`~.thermo.ThermoTable` to ``s_pad`` species.  Dead
+    species get all-zero NASA-7 coefficients (g/RT == 0 exactly — and
+    every Gibbs sum weights them by zero stoichiometry anyway), molwt 1.0
+    (so ``conc = rho_k / molwt`` is ``0/1 == 0``, never ``0/0``), and the
+    default 300/1000/5000 K range bounds."""
+    S = thermo.n_species
+    s_pad = int(s_pad)
+    if s_pad < S:
+        raise ValueError(
+            f"thermo padding cannot shrink: live S={S} vs requested "
+            f"{s_pad}")
+    ds = s_pad - S
+
+    def cat(a, fill):
+        a = np.asarray(a)
+        pad = np.broadcast_to(
+            np.asarray(fill, dtype=a.dtype), (ds,) + a.shape[1:])
+        return np.concatenate([a, pad], axis=0)
+
+    return ThermoTable(
+        coeffs=jnp.asarray(cat(thermo.coeffs, 0.0)),
+        T_low=jnp.asarray(cat(thermo.T_low, 300.0)),
+        T_mid=jnp.asarray(cat(thermo.T_mid, 1000.0)),
+        T_high=jnp.asarray(cat(thermo.T_high, 5000.0)),
+        molwt=jnp.asarray(cat(thermo.molwt, 1.0)),
+        species=_pad_species_names(thermo.species, s_pad, canonical),
+        # composition is static pytree meta (a jit cache key component):
+        # canonical bundles blank it entirely so two mechanisms' padded
+        # thermo tables share one treedef — element-conservation checks
+        # run against the LIVE table the caller keeps
+        composition=(((),) * s_pad if canonical
+                     else tuple(thermo.composition) + ((),) * ds),
+    )
+
+
+def pad_states(y, s_pad):
+    """Pad state rows ``(..., S)`` to ``(..., s_pad)`` with zero mass —
+    the dead-species initial condition the inertness contract requires."""
+    y = jnp.asarray(y)
+    S = y.shape[-1]
+    if s_pad < S:
+        raise ValueError(f"state padding cannot shrink: {S} -> {s_pad}")
+    if s_pad == S:
+        return y
+    pad = [(0, 0)] * (y.ndim - 1) + [(0, int(s_pad) - S)]
+    return jnp.pad(y, pad)
+
+
+def nlive_cfg(cfgs, n_live, n_lanes):
+    """A copy of the per-lane ``cfgs`` dict with the reserved
+    :data:`NLIVE_KEY` operand set to the live component count — what
+    makes the padded solver norms match the dedicated-shape program
+    (solver/sdirk.py key contract)."""
+    out = dict(cfgs)
+    out[NLIVE_KEY] = jnp.full((int(n_lanes),), float(n_live),
+                              dtype=jnp.float64)
+    return out
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contract (analysis/contracts.py): identity
+# padding must be a true traced-program no-op — the padded-mechanism RHS
+# and Jacobian at (S, R) == the live shape trace byte-identical to the
+# raw mechanism's, so the mech_operands=False default path cannot drift
+# under padding-layer changes.
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Identical, Pure, program_contract  # noqa: E402
+
+
+@program_contract(
+    "mech-padding",
+    doc="mechanism padding: identity padding is a traced no-op; padded "
+        "RHS/Jacobian stay pure")
+def _contract_mech_padding(h):
+    from ..ops.rhs import make_gas_jac, make_gas_rhs
+
+    gm, th = h.gm, h.th
+    S, R = gm.n_species, gm.n_reactions
+    gmi, thi = pad_gas_mechanism(gm, S, R), pad_thermo(th, S)
+    yield Identical(
+        "mech-pad-noop-fork", "gas-rhs-identity-pad",
+        h.memo("gas-rhs-baseline",
+               lambda: str(h.jaxpr(make_gas_rhs(gm, th), 0.0, h.y0,
+                                   h.cfg))),
+        str(h.jaxpr(make_gas_rhs(gmi, thi), 0.0, h.y0, h.cfg)),
+        "identity mechanism padding changed the traced gas RHS: the "
+        "padding layer is no longer value-transparent at the live shape "
+        "(models/padding.py contract)")
+    yield Identical(
+        "mech-pad-noop-fork", "gas-jac-identity-pad",
+        h.memo("gas-jac-baseline",
+               lambda: str(h.jaxpr(make_gas_jac(gm, th), 0.0, h.y0,
+                                   h.cfg))),
+        str(h.jaxpr(make_gas_jac(gmi, thi), 0.0, h.y0, h.cfg)),
+        "identity mechanism padding changed the traced gas Jacobian "
+        "(models/padding.py contract)")
+    # a genuinely padded program stays pure (no callbacks / staging)
+    s_pad, r_pad = S + 3, R + 4
+    gmp = pad_gas_mechanism(gm, s_pad, r_pad)
+    thp = pad_thermo(th, s_pad)
+    y0p = pad_states(h.y0, s_pad)
+    yield Pure("gas-rhs-padded",
+               h.jaxpr(make_gas_rhs(gmp, thp), 0.0, y0p, h.cfg),
+               check_dtype=h.check_dtype)
+    yield Pure("gas-jac-padded",
+               h.jaxpr(make_gas_jac(gmp, thp), 0.0, y0p, h.cfg),
+               check_dtype=h.check_dtype)

@@ -116,6 +116,15 @@ SERVE_KEYS = ("serve_requests", "serve_lanes", "serve_answered",
               "serve_failed", "serve_rejects_overload",
               "serve_rejects_draining", "serve_stalls", "serve_epochs",
               "serve_latency_s", "serve_warmup_s", "fed_lanes")
+#: AOT program-store counters (aot/registry.py — docs/performance.md
+#: "Mechanism-shape economy"): Recorder counters incremented by the
+#: registry's LRU capacity policy (``enforce_capacity`` — entries
+#: evicted from the warm-cache manifest now that mechanism uploads make
+#: the program set user-extensible) and the serving session store's
+#: mechanism admission/eviction.  Absent from a run that never touched
+#: the registry — ``obs.diff`` maps a missing key to 0 (the FAULT_KEYS
+#: convention).
+AOT_KEYS = ("aot_evictions", "mech_admitted", "mech_evicted")
 
 
 #: THE counter-family registry (brlint tier-C counter-registry audit,
@@ -153,6 +162,8 @@ FAMILIES = {
              "semantics": "additive", "missing_zero": True},
     "serve": {"keys": SERVE_KEYS, "kind": "host",
               "semantics": "additive", "missing_zero": True},
+    "aot": {"keys": AOT_KEYS, "kind": "host",
+            "semantics": "additive", "missing_zero": True},
 }
 
 

@@ -15,6 +15,16 @@ Reference quirk (SURVEY.md): at :345 the reference multiplies the ENTIRE
 surface source vector (gas part and coverage part) by Asv, so coverage
 dynamics are scaled by Asv relative to the textbook equation.  We reproduce
 this behaviour behind ``asv_quirk`` (default True for parity).
+
+Mechanism-shape padding (models/padding.py): these RHS/Jacobian builders
+accept PADDED mechanism/thermo bundles unchanged — the kinetics kernels
+are inert on the dead tail by construction (zero ``nu`` rows/columns
+zero every dead contribution exactly; ``ln A = ln 0`` pad rows never
+reach the state through all-zero ``dnu``; zero ``eff`` pad columns keep
+the Jacobian's dead columns zero), so the padded gas RHS is the live RHS
+plus exact-zero tail entries, bit-for-bit.  The identity-padding
+byte-identity and the padded purity are pinned by the tier-C
+``mech-padding`` contract next to this module's own.
 """
 
 import os

@@ -32,20 +32,23 @@ and the scheduler tests never pay a device.
 """
 
 from .schema import (SCHEMA_VERSION, Request, error_response,  # noqa: F401
-                     ok_response, validate_request)
+                     ok_response, validate_request, validate_upload)
 from .scheduler import (Draining, Overloaded, RequestResult,  # noqa: F401
                         Scheduler, SchedulerReject)
 from .client import ServeError, SolveClient, poisson_trace  # noqa: F401
 
 __all__ = [
-    "SCHEMA_VERSION", "Request", "validate_request", "error_response",
+    "SCHEMA_VERSION", "Request", "validate_request", "validate_upload",
+    "error_response",
     "ok_response", "Scheduler", "SchedulerReject", "Overloaded",
     "Draining", "RequestResult", "SolverSession", "SessionSpec",
+    "SessionStore", "UnknownMechanism",
     "load_spec", "ServingServer", "serve_jsonl", "SolveClient",
     "ServeError", "poisson_trace",
 ]
 
 _LAZY = {"SolverSession": "session", "SessionSpec": "session",
+         "SessionStore": "session", "UnknownMechanism": "session",
          "load_spec": "session", "ServingServer": "server",
          "serve_jsonl": "server"}
 

@@ -81,6 +81,37 @@ class SolveClient:
                             err.get("message", "non-ok response"), resp)
         return resp
 
+    def upload_mechanism(self, mech_id, mech_text, therm_text,
+                         warm=True):
+        """POST one mechanism upload (``POST /mechanism`` —
+        schema.validate_upload grammar); returns the parsed ``ok``
+        response (fingerprint, species, warm state) or raises
+        :class:`ServeError`."""
+        body = json.dumps({"id": str(mech_id), "mech": mech_text,
+                           "therm": therm_text,
+                           "warm": bool(warm)}).encode()
+        req = urllib.request.Request(
+            self.url + "/mechanism", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                resp = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                resp = json.loads(e.read().decode())
+            except (ValueError, OSError):
+                raise ServeError("internal",
+                                 f"HTTP {e.code}: {e.reason}") from None
+            err = resp.get("error") or {}
+            raise ServeError(err.get("code", "internal"),
+                             err.get("message", f"HTTP {e.code}"),
+                             resp) from None
+        if resp.get("status") != "ok":
+            err = resp.get("error") or {}
+            raise ServeError(err.get("code", "internal"),
+                             err.get("message", "non-ok response"), resp)
+        return resp
+
 
 def poisson_trace(n_requests, rate_hz, seed, make_request):
     """The seeded open-loop trace: ``[(send_at_s, request), ...]`` with

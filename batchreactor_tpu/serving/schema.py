@@ -21,9 +21,12 @@ Request JSON (``POST /solve`` body, or one stdin-JSONL line)::
                                   # non-default pair compiles a new
                                   # program on first use (docs/serving.md)
      "Asv": 1.0,                  # optional surface-coupling parameter
-     "n_save": 0}                 # optional; only 0 is accepted — the
+     "n_save": 0,                 # optional; only 0 is accepted — the
                                   # admission gear streams final states,
                                   # not trajectories (loud error)
+     "mech": "user-mech-7"}       # optional mechanism routing key
+                                  # (multi-mechanism store; upload id or
+                                  # fingerprint prefix — docs/serving.md)
 
 Responses are ``{"v": 1, "id": ..., "status": "ok" | "error", ...}``:
 ``ok`` carries per-lane ``t`` / ``solver_status`` / ``provenance`` /
@@ -46,10 +49,17 @@ SCHEMA_VERSION = 1
 
 #: the only keys a request may carry (anything else is a loud error)
 _REQUEST_KEYS = ("v", "id", "T", "p", "X", "t1", "rtol", "atol", "Asv",
-                 "n_save")
+                 "n_save", "mech")
 
 #: error codes a response may carry
-ERROR_CODES = ("invalid", "overloaded", "draining", "internal")
+ERROR_CODES = ("invalid", "overloaded", "draining", "internal",
+               "unknown_mechanism")
+
+#: the only keys a mechanism upload may carry (POST /mechanism body —
+#: docs/serving.md "Mechanism upload"); ``mech``/``therm`` are the
+#: INLINE file texts (CHEMKIN-II / NASA-7), not paths: the daemon owns
+#: no shared filesystem with its clients
+_UPLOAD_KEYS = ("v", "id", "mech", "therm", "warm")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +76,11 @@ class Request:
     t1: float
     rtol: float
     atol: float
+    #: mechanism routing key (multi-mechanism store — docs/serving.md):
+    #: an upload id or fingerprint prefix; None = the session default.
+    #: Routing happens BEFORE scheduling (each mechanism owns its own
+    #: scheduler), so it is not part of pack_key.
+    mech: str | None = None
 
     @property
     def n_lanes(self):
@@ -194,6 +209,12 @@ def validate_request(obj, *, species=None, rtol_default=1e-6,
             f"streaming admission gear returns final states only "
             f"(n_save=0); run a trajectory solve through batch_reactor")
 
+    mech = obj.get("mech")
+    if mech is not None and (not isinstance(mech, str) or not mech):
+        raise ValueError(
+            f"request {rid!r}: mech must be a non-empty mechanism id "
+            f"string; got {mech!r}")
+
     bcast = (lambda a: np.broadcast_to(a, (k,)).copy()
              if a.shape[0] == 1 else a)
     X = {n: bcast(a) for n, a in X.items()}
@@ -209,7 +230,44 @@ def validate_request(obj, *, species=None, rtol_default=1e-6,
             f"{float(total[bad])!r}; mole fractions must sum > 0 on "
             f"every lane")
     return Request(id=rid, T=bcast(T), p=bcast(p), Asv=bcast(Asv),
-                   X=X, t1=t1, rtol=rtol, atol=atol)
+                   X=X, t1=t1, rtol=rtol, atol=atol, mech=mech)
+
+
+def validate_upload(obj, *, default_id=None):
+    """Validate one mechanism-upload JSON object (``POST /mechanism``;
+    grammar: docs/serving.md "Mechanism upload") into a plain dict
+    ``{"id", "mech", "therm", "warm"}`` — the ``api.py`` loudness
+    convention: unknown keys reject, every malformed field is a specific
+    ``ValueError``.  ``mech``/``therm`` are inline CHEMKIN-II / NASA-7
+    texts; parsing errors surface later, from the store's compile, as
+    ``invalid`` responses naming the parser's complaint."""
+    if not isinstance(obj, dict):
+        raise ValueError(f"mechanism upload must be a JSON object; got "
+                         f"{type(obj).__name__}")
+    unknown = sorted(set(obj) - set(_UPLOAD_KEYS))
+    if unknown:
+        raise ValueError(f"unknown upload key(s) {unknown}; known keys: "
+                         f"{list(_UPLOAD_KEYS)}")
+    v = obj.get("v", SCHEMA_VERSION)
+    if v != SCHEMA_VERSION:
+        raise ValueError(f"unsupported schema version {v!r} (this server "
+                         f"speaks v{SCHEMA_VERSION})")
+    uid = obj.get("id", default_id)
+    if uid is None or not isinstance(uid, str) or not uid:
+        raise ValueError("mechanism upload needs a non-empty string 'id' "
+                         "(the mech routing key of later solve requests)")
+    for key in ("mech", "therm"):
+        text = obj.get(key)
+        if not isinstance(text, str) or not text.strip():
+            raise ValueError(
+                f"upload {uid!r}: {key!r} must be the non-empty inline "
+                f"file text ({'CHEMKIN-II mechanism' if key == 'mech' else 'NASA-7 thermo database'})")
+    warm = obj.get("warm", True)
+    if not isinstance(warm, bool):
+        raise ValueError(f"upload {uid!r}: warm must be a boolean; got "
+                         f"{warm!r}")
+    return {"id": uid, "mech": obj["mech"], "therm": obj["therm"],
+            "warm": warm}
 
 
 def error_response(rid, code, message):

@@ -68,8 +68,10 @@ class _ServeHandler(http.server.BaseHTTPRequestHandler):
             self.send_error(500, f"{type(e).__name__}: {e}")
 
     def do_POST(self):  # noqa: N802 — stdlib handler contract
-        if self.path.split("?", 1)[0] != "/solve":
-            self.send_error(404, "POST /solve is the only write path")
+        path = self.path.split("?", 1)[0]
+        if path not in ("/solve", "/mechanism"):
+            self.send_error(404, "POST /solve and POST /mechanism are "
+                                 "the write paths")
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -79,7 +81,10 @@ class _ServeHandler(http.server.BaseHTTPRequestHandler):
             self._send(400, schema.error_response(
                 None, "invalid", f"request body is not JSON: {e}"))
             return
-        code, resp = self.front.solve(obj)
+        if path == "/mechanism":
+            code, resp = self.front.upload(obj)
+        else:
+            code, resp = self.front.solve(obj)
         self._send(code, resp)
 
     def log_message(self, *_args):
@@ -93,9 +98,13 @@ class ServingServer:
     daemon (``scripts/serve.py``)."""
 
     def __init__(self, session, scheduler, port=0, host="127.0.0.1",
-                 request_timeout=None):
+                 request_timeout=None, store=None):
         self.session = session
         self.scheduler = scheduler
+        #: multi-mechanism store (docs/serving.md): routes per-request
+        #: ``mech`` keys and accepts ``POST /mechanism`` uploads; None
+        #: keeps the single-mechanism daemon byte-compatible
+        self.store = store
         self.request_timeout = float(
             session.spec.request_timeout_s if request_timeout is None
             else request_timeout)
@@ -105,20 +114,42 @@ class ServingServer:
         self._ids = _IdSource()
 
     # ---- request plumbing (shared by HTTP and tests) ----------------------
+    def _route(self, obj):
+        """(session, scheduler) for a raw request object's ``mech`` key
+        — routed BEFORE validation, which needs the target session's
+        species list."""
+        mech = obj.get("mech") if isinstance(obj, dict) else None
+        if self.store is None:
+            if mech is not None:
+                from .session import UnknownMechanism
+
+                raise UnknownMechanism(
+                    f"mech={mech!r} routing needs the multi-mechanism "
+                    f"store; this daemon serves one mechanism")
+            return self.session, self.scheduler
+        return self.store.resolve(mech)
+
     def solve(self, obj):
         """One request object -> ``(http_status, response_object)``."""
+        from .session import UnknownMechanism
+
         rid = obj.get("id") if isinstance(obj, dict) else None
         try:
+            session, scheduler = self._route(obj)
+        except UnknownMechanism as e:
+            return 404, schema.error_response(
+                rid, "unknown_mechanism", e.args[0])
+        try:
             req = schema.validate_request(
-                obj, species=self.session.species,
-                rtol_default=self.session.spec.rtol,
-                atol_default=self.session.spec.atol,
+                obj, species=session.species,
+                rtol_default=session.spec.rtol,
+                atol_default=session.spec.atol,
                 default_id=self._ids.next(),
-                max_lanes=self.session.spec.max_lanes_per_request)
+                max_lanes=session.spec.max_lanes_per_request)
         except ValueError as e:
             return 400, schema.error_response(rid, "invalid", e)
         try:
-            future = self.scheduler.submit(req)
+            future = scheduler.submit(req)
         except SchedulerReject as e:
             return 503, schema.error_response(req.id, e.code, e)
         try:
@@ -130,7 +161,28 @@ class ServingServer:
             return 500, schema.error_response(
                 req.id, "internal", f"{type(e).__name__}: {e}")
         return 200, schema.ok_response(
-            req.id, self.session.render_result(result))
+            req.id, session.render_result(result))
+
+    def upload(self, obj):
+        """One mechanism-upload object -> ``(http_status, response)``
+        (``POST /mechanism``; grammar schema.validate_upload)."""
+        rid = obj.get("id") if isinstance(obj, dict) else None
+        if self.store is None:
+            return 404, schema.error_response(
+                rid, "invalid", "this daemon runs without a mechanism "
+                "store (scripts/serve.py --store)")
+        try:
+            upload = schema.validate_upload(obj)
+        except ValueError as e:
+            return 400, schema.error_response(rid, "invalid", e)
+        try:
+            _fp, info = self.store.add_upload(upload)
+        except ValueError as e:
+            return 400, schema.error_response(upload["id"], "invalid", e)
+        except Exception as e:  # noqa: BLE001 — answered, loudly
+            return 500, schema.error_response(
+                upload["id"], "internal", f"{type(e).__name__}: {e}")
+        return 200, schema.ok_response(upload["id"], info)
 
     def healthz(self):
         h = self.session.registry.healthz()
@@ -139,6 +191,8 @@ class ServingServer:
                         "queued_lanes": queued,
                         "inflight_lanes": inflight,
                         "draining": bool(self.scheduler._draining)}
+        if self.store is not None:
+            h["serving"]["store"] = self.store.healthz()
         return h
 
     # ---- lifecycle --------------------------------------------------------
@@ -174,6 +228,8 @@ class ServingServer:
     def close(self, drain_timeout=None):
         """Drain the scheduler (every accepted request answers), then
         stop the HTTP thread."""
+        if self.store is not None:
+            self.store.drain(drain_timeout)
         self.scheduler.drain(drain_timeout)
         if self._server is not None:
             self._server.shutdown()

@@ -29,6 +29,7 @@ impossible, not just discouraged).
 import dataclasses
 import json
 import os
+import threading
 import time
 
 import numpy as np
@@ -50,10 +51,11 @@ _MECH_KEYS = ("mech", "therm")
 _SOLVER_KEYS = ("method", "rtol", "atol", "jac_window", "linsolve",
                 "setup_economy", "stale_tol", "segment_steps",
                 "max_attempts", "stats", "ignition_marker",
-                "ignition_mode")
+                "ignition_mode", "mech_operands", "species_buckets",
+                "reaction_buckets")
 _SERVE_KEYS = ("resident", "refill", "buckets", "poll_every",
                "max_queue_lanes", "idle_timeout_s", "request_timeout_s",
-               "max_lanes_per_request", "coalesce_s")
+               "max_lanes_per_request", "coalesce_s", "max_mechanisms")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +79,16 @@ class SessionSpec:
     stats: bool = True
     ignition_marker: object = None
     ignition_mode: str = "half"
+    #: mechanism-shape generalization (docs/performance.md
+    #: "Mechanism-shape economy"): ``mech_operands=True`` pads the
+    #: mechanism onto the ``species_buckets`` x ``reaction_buckets``
+    #: (S, R) rung (pow2 ladders by default, the api.py rule) and lifts
+    #: the tensors to traced operands — every mechanism in one rung then
+    #: serves through ONE compiled executable, the multi-mechanism
+    #: store's (SessionStore) zero-compile upload path
+    mech_operands: bool = False
+    species_buckets: object = None
+    reaction_buckets: object = None
     # serve config (scheduler/capacity — NOT part of the program keys)
     resident: int = 8
     refill: object = 1
@@ -91,6 +103,10 @@ class SessionSpec:
     #: servers' max-batch-delay knob; 0 = dispatch immediately).  Lanes
     #: arriving after the seed still join through the live feed.
     coalesce_s: float = 0.0
+    #: multi-mechanism store capacity (SessionStore): resident sessions
+    #: beyond this LRU-evict (their manifest entries unpin; the
+    #: ``mech_evicted``/``aot_evictions`` counters record it)
+    max_mechanisms: int = 8
 
 
 def load_spec(source):
@@ -169,7 +185,8 @@ class SolverSession:
     def __init__(self, gm, thermo, spec, recorder=None):
         from ..aot import mechanism_fingerprint, normalize_buckets, \
             resolve_bucket
-        from ..api import _sweep_fns, resolve_jac_window
+        from ..api import _padded_mech, _segmented_builder, _sweep_fns, \
+            resolve_jac_window
         from ..obs import CompileWatch, LiveRegistry, Recorder
 
         self.gm = gm
@@ -185,22 +202,56 @@ class SolverSession:
                     f"session spec: ignition_marker "
                     f"{spec.ignition_marker!r} not in the mechanism")
             marker_idx = self._sp_idx[key]
+        # mechanism-shape resolution (api.py rule: operand mode defaults
+        # both ladders to pow2) — the padded twins drive the kernels,
+        # self.species/self.thermo stay LIVE for packing and rendering
+        sb = spec.species_buckets
+        rb = spec.reaction_buckets
+        if spec.mech_operands:
+            sb = "pow2" if sb is None else sb
+            rb = "pow2" if rb is None else rb
+        sb, rb = normalize_buckets(sb), normalize_buckets(rb)
+        self.mech_shape = None
+        self.mech_bundle = None
+        gm_kernel, th_kernel = gm, thermo
+        if sb is not None or rb is not None:
+            s_pad = (resolve_bucket(len(self.species), sb)
+                     if sb is not None else len(self.species))
+            r_pad = (resolve_bucket(gm.n_reactions, rb)
+                     if rb is not None else gm.n_reactions)
+            self.mech_shape = (s_pad, r_pad)
+            gm_kernel, th_kernel = _padded_mech(
+                gm, thermo, s_pad, r_pad,
+                canonical=bool(spec.mech_operands))
         # the EXACT callables batch_reactor_sweep builds: identical
         # construction => identical traced programs => identical AOT keys
         (self.rhs, self.jac, self.observer,
          self.observer_init) = _sweep_fns(
-            "gas", None, gm, None, thermo, False, True, marker_idx,
-            spec.ignition_mode)
+            "gas", None, gm_kernel, None, th_kernel, False, True,
+            marker_idx, spec.ignition_mode)
+        import jax
+
+        # the session fingerprint stays CONTENT-based (it keys the
+        # multi-mechanism store and request routing) even in operand
+        # mode, where the EXECUTION callable is the shared content-free
+        # builder — two mechanisms sharing one executable must still be
+        # two sessions
+        self.fingerprint = mechanism_fingerprint(
+            self.rhs, self.jac, self.observer,
+            extra=jax.tree_util.tree_map(repr, self.observer_init))
+        if spec.mech_operands:
+            # mechanism-as-operand (api.py mech_operands): the kernels
+            # swap for the shared cached builder + the padded bundle as
+            # a traced operand — any mechanism on this (S, R) rung runs
+            # the SAME executable (docs/performance.md)
+            self.mech_bundle = (gm_kernel, None, th_kernel)
+            self.rhs = _segmented_builder("gas", None, False, True)
+            self.jac = None
         self.jac_window = resolve_jac_window(spec.jac_window, spec.method)
         self.buckets = normalize_buckets(spec.buckets)
         #: the largest resident program shape the session will run —
         #: admission packs into at most this many slots
         self.bucket_cap = resolve_bucket(int(spec.resident), self.buckets)
-        import jax
-
-        self.fingerprint = mechanism_fingerprint(
-            self.rhs, self.jac, self.observer,
-            extra=jax.tree_util.tree_map(repr, self.observer_init))
         self.recorder = recorder if recorder is not None else Recorder()
         self.registry = LiveRegistry(
             recorder=self.recorder,
@@ -263,14 +314,21 @@ class SolverSession:
         from the served ones (every key here shapes the traced
         program)."""
         s = self.spec
-        return dict(method=s.method, rtol=float(rtol), atol=float(atol),
-                    jac=self.jac, observer=self.observer,
-                    observer_init=self.observer_init,
-                    jac_window=self.jac_window, linsolve=s.linsolve,
-                    setup_economy=bool(s.setup_economy),
-                    stale_tol=float(s.stale_tol), stats=bool(s.stats),
-                    segment_steps=int(s.segment_steps),
-                    max_attempts=int(s.max_attempts))
+        flags = dict(method=s.method, rtol=float(rtol), atol=float(atol),
+                     jac=self.jac, observer=self.observer,
+                     observer_init=self.observer_init,
+                     jac_window=self.jac_window, linsolve=s.linsolve,
+                     setup_economy=bool(s.setup_economy),
+                     stale_tol=float(s.stale_tol), stats=bool(s.stats),
+                     segment_steps=int(s.segment_steps),
+                     max_attempts=int(s.max_attempts))
+        if self.mech_bundle is not None:
+            # operand mode: the bundle rides the flag set verbatim into
+            # both the warmup specs and the live stream call — the aot
+            # registry keys it by SHAPE class (registry._resolve_spec),
+            # so shared-rung mechanisms resolve to one program key
+            flags["rhs_bundle"] = self.mech_bundle
+        return flags
 
     def warmup_specs(self, rtol=None, atol=None):
         """One ``aot.warmup`` spec per ladder rung <= the resident cap:
@@ -305,7 +363,28 @@ class SolverSession:
         X[0, 0] = 1.0
         y0 = np.asarray(self._solution_vectors(
             X, np.asarray([1500.0]), np.asarray([1e5])))[0]
-        return y0, {"T": 1500.0, "Asv": 1.0}
+        cfg = {"T": 1500.0, "Asv": 1.0}
+        if self.mech_shape is not None:
+            y0, cfg = self._pad_lanes(y0[None, :], cfg)
+            y0 = y0[0]
+            cfg = {k: (float(v) if np.ndim(v) == 0 else float(v[0]))
+                   for k, v in cfg.items()}
+        return y0, cfg
+
+    def _pad_lanes(self, y0, cfg):
+        """Dead-species padding of packed lane blocks: zero mass columns
+        + the live-count norm operand (models/padding.py contract)."""
+        from ..models.padding import NLIVE_KEY
+
+        k, s_live = y0.shape[0], y0.shape[1]
+        s_pad = self.mech_shape[0]
+        if s_live < s_pad:
+            y0 = np.concatenate(
+                [y0, np.zeros((k, s_pad - s_live), dtype=y0.dtype)],
+                axis=1)
+        cfg = dict(cfg)
+        cfg[NLIVE_KEY] = np.full((k,), float(len(self.species)))
+        return y0, cfg
 
     def warmup(self, cache_dir=None, log=None):
         """Pre-bake the session's program set (:mod:`~batchreactor_tpu.
@@ -346,8 +425,11 @@ class SolverSession:
         for name, vals in req.X.items():
             X[:, self._sp_idx[name.upper()]] = vals
         y0 = np.asarray(self._solution_vectors(X, req.T, req.p))
-        return y0, {"T": np.asarray(req.T, dtype=np.float64),
-                    "Asv": np.asarray(req.Asv, dtype=np.float64)}
+        cfg = {"T": np.asarray(req.T, dtype=np.float64),
+               "Asv": np.asarray(req.Asv, dtype=np.float64)}
+        if self.mech_shape is not None:
+            y0, cfg = self._pad_lanes(y0, cfg)
+        return y0, cfg
 
     # ---- the resident stream ----------------------------------------------
     def stream(self, y0s, cfgs, *, t1, rtol, atol, on_harvest=None,
@@ -416,9 +498,314 @@ class SolverSession:
         return {"fingerprint": self.fingerprint,
                 "species": len(self.species),
                 "bucket_cap": self.bucket_cap,
+                "mech_shape": self.mech_shape,
+                "mech_operands": self.mech_bundle is not None,
                 "warmed": (None if self.warmed is None
                            else sum(1 for r in self.warmed if r.warm)),
                 "compiles": w.get("compiles"),
                 "program_compiles": sum(self.program_compiles()
                                         .values()),
                 "uptime_s": round(time.time() - self._t0, 3)}
+
+
+class UnknownMechanism(KeyError):
+    """A solve request's ``mech`` routing key matched no resident
+    session (schema error code ``unknown_mechanism``)."""
+
+
+class SessionStore:
+    """The ``{fingerprint: SolverSession}`` multi-mechanism store
+    (ROADMAP 5; docs/serving.md "Multi-mechanism serving").
+
+    One daemon, many mechanisms: every resident mechanism owns a
+    :class:`SolverSession` + scheduler pair, keyed by the session's
+    content fingerprint and aliased by upload id, with the base spec's
+    solver/serve sections as the shared template — so every session
+    shares one solver flag set, one bucket ladder, and (under
+    ``mech_operands``) ONE compiled executable per (B, S, R) rung:
+    a new mechanism landing in a warmed rung warms at zero compiles.
+
+    Capacity: at most ``spec.max_mechanisms`` resident sessions;
+    beyond that the least-recently-REQUESTED unpinned session is
+    drained and dropped (``mech_evicted`` counter), and the AOT
+    manifest's LRU policy (:func:`aot.enforce_capacity`) trims the
+    registry with it (``aot_evictions``).  The DEFAULT session (the
+    daemon's serve.json mechanism) is pinned and never evicts.
+
+    Thread contract: ``resolve``/``add_*``/``healthz`` are called from
+    front-end handler threads — every mutation of the session map holds
+    ``_lock``; the per-session schedulers own their request streams.
+    """
+
+    #: brlint host-concurrency lint: these run on HTTP handler threads
+    _BRLINT_THREAD_ENTRIES = ("SessionStore.resolve",
+                              "SessionStore.add_upload",
+                              "SessionStore.healthz",
+                              "SessionStore.mechanisms")
+
+    def __init__(self, session, scheduler=None, *, cache_dir=None,
+                 upload_dir=None, scheduler_factory=None):
+        import tempfile
+
+        from .scheduler import Scheduler
+
+        self._lock = threading.RLock()
+        self._factory = scheduler_factory or (lambda s: Scheduler(s))
+        self.cache_dir = cache_dir
+        self.recorder = session.recorder
+        self.base_spec = session.spec
+        self.max_mechanisms = max(1, int(
+            getattr(session.spec, "max_mechanisms", 8)))
+        self._entries = {}      # fingerprint -> entry dict
+        self._aliases = {}      # upload/mech id -> fingerprint
+        self._owns_dir = upload_dir is None
+        self._dir = upload_dir or tempfile.mkdtemp(prefix="br-mechs-")
+        self._seq = 0
+        if scheduler is None:
+            scheduler = self._factory(session)
+        self.default_fingerprint = session.fingerprint
+        self._admit(session, scheduler, mech_id="default", pinned=True)
+
+    # ---- admission ---------------------------------------------------------
+    def _admit(self, session, scheduler, mech_id, pinned=False):
+        redundant = None
+        with self._lock:
+            fp = session.fingerprint
+            entry = self._entries.get(fp)
+            if entry is None:
+                self._seq += 1
+                entry = {"session": session, "scheduler": scheduler,
+                         "ids": set(), "pinned": pinned,
+                         "last_used": self._seq}
+                self._entries[fp] = entry
+                if self.recorder is not None:
+                    self.recorder.counter("mech_admitted")
+            elif entry["session"] is not session:
+                # two concurrent uploads of one mechanism: first admit
+                # wins, the loser's freshly-started pair shuts down
+                redundant = scheduler
+            entry["pinned"] = entry["pinned"] or pinned
+            if mech_id is not None:
+                entry["ids"].add(str(mech_id))
+                self._aliases[str(mech_id)] = fp
+            evicted = self._pop_over_capacity_locked(keep=fp)
+        # teardown OUTSIDE the lock: a victim drain joins a worker that
+        # may still be finishing device solves (up to the drain timeout)
+        # — under the lock it would stall resolve() for EVERY mechanism
+        for victim in evicted:
+            self._teardown_evicted(victim)
+        if redundant is not None:
+            try:
+                redundant.drain(timeout=5.0)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            session.__exit__(None, None, None)
+        return fp
+
+    def _pop_over_capacity_locked(self, keep=None):
+        """Pop LRU unpinned entries beyond capacity (map surgery only —
+        no draining, no I/O); returns the popped entries for the caller
+        to tear down outside the lock."""
+        popped = []
+        while len(self._entries) > self.max_mechanisms:
+            victims = sorted(
+                (fp for fp, e in self._entries.items()
+                 if not e["pinned"] and fp != keep),
+                key=lambda fp: self._entries[fp]["last_used"])
+            if not victims:
+                # everything (else) pinned or just-admitted: capacity
+                # degrades to advisory rather than evicting the session
+                # the caller is about to hand out
+                break
+            fp = victims[0]
+            entry = self._entries.pop(fp)
+            for mid in entry["ids"]:
+                self._aliases.pop(mid, None)
+            if self.recorder is not None:
+                self.recorder.counter("mech_evicted")
+            popped.append(entry)
+        return popped
+
+    def _teardown_evicted(self, entry):
+        from ..aot import enforce_capacity
+
+        try:
+            entry["scheduler"].drain(timeout=30.0)
+        except Exception:  # noqa: BLE001 — eviction must not wedge
+            pass
+        entry["session"].__exit__(None, None, None)
+        if self.cache_dir is not None:
+            # registry-side LRU: trim the manifest with the store
+            enforce_capacity(
+                self.cache_dir,
+                self.max_mechanisms * max(
+                    1, len(self._programs_per_session())),
+                recorder=self.recorder)
+
+    def _programs_per_session(self):
+        with self._lock:
+            e = self._entries.get(self.default_fingerprint)
+        if e is None:
+            return ()
+        return e["session"].warmup_specs()
+
+    def add_session(self, session, mech_id=None, warm=True):
+        """Admit a pre-built session (tests, programmatic callers);
+        warms it (shared-rung programs load at zero compiles), starts
+        its scheduler, returns the fingerprint."""
+        with self._lock:
+            existing = self._entries.get(session.fingerprint)
+            if existing is not None:
+                if mech_id is not None:
+                    existing["ids"].add(str(mech_id))
+                    self._aliases[str(mech_id)] = session.fingerprint
+                return session.fingerprint
+        session.__enter__()
+        if warm:
+            session.warmup(cache_dir=self.cache_dir)
+        scheduler = self._factory(session).start()
+        return self._admit(session, scheduler, mech_id)
+
+    def _session_keys(self, session):
+        """The session's warm-cache program keys (the manifest rows its
+        requests keep alive through :func:`aot.touch_keys`)."""
+        if session.warmed:
+            return [r.key for r in session.warmed]
+        return []
+
+    def add_mechanism(self, mech_path, therm_path, mech_id=None,
+                      warm=True):
+        """Build + admit a session for a mechanism file pair under the
+        base spec's solver/serve template."""
+        import batchreactor_tpu as br
+
+        spec = dataclasses.replace(
+            self.base_spec, mech=os.path.abspath(str(mech_path)),
+            therm=os.path.abspath(str(therm_path)))
+        gm = br.compile_gaschemistry(spec.mech)
+        th = br.create_thermo(list(gm.species), spec.therm)
+        session = SolverSession(gm, th, spec, recorder=self.recorder)
+        return self.add_session(session, mech_id=mech_id, warm=warm)
+
+    def add_upload(self, upload):
+        """One validated upload (schema.validate_upload) -> (fingerprint,
+        healthz-style info dict).  The inline texts land under the store
+        dir; a parse failure raises ``ValueError`` (the front-end's
+        ``invalid`` response)."""
+        uid = upload["id"]
+        mech_path = os.path.join(self._dir, f"{_safe_name(uid)}.dat")
+        therm_path = os.path.join(self._dir, f"{_safe_name(uid)}.therm")
+        for path, text in ((mech_path, upload["mech"]),
+                           (therm_path, upload["therm"])):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        try:
+            fp = self.add_mechanism(mech_path, therm_path, mech_id=uid,
+                                    warm=upload.get("warm", True))
+        except (KeyError, ValueError, NotImplementedError) as e:
+            raise ValueError(f"mechanism upload {uid!r} rejected: "
+                             f"{e}") from e
+        with self._lock:
+            session = self._entries[fp]["session"]
+        return fp, {"fingerprint": fp, "id": uid,
+                    "species": list(session.species),
+                    "mech_shape": session.mech_shape,
+                    "warmed": (None if session.warmed is None else
+                               sum(1 for r in session.warmed if r.warm)),
+                    "program_compiles": session.program_compiles()}
+
+    # ---- routing -----------------------------------------------------------
+    #: minimum seconds between manifest ``last_used`` touches per
+    #: session — the LRU clock is request-driven but must not pay a
+    #: manifest load+save on every solve
+    TOUCH_EVERY_S = 60.0
+
+    def resolve(self, mech=None):
+        """Route a request's ``mech`` key (upload id, full fingerprint,
+        or unambiguous fingerprint prefix; None = default) to its
+        ``(session, scheduler)`` pair, advancing the LRU clock — both
+        the store's in-memory one and (throttled, when a cache dir is
+        managed) the warm-cache manifest's ``last_used``, so
+        :func:`aot.enforce_capacity` evicts by true recency of USE, not
+        warm time."""
+        touch = None
+        with self._lock:
+            if mech is None:
+                fp = self.default_fingerprint
+            else:
+                fp = self._aliases.get(str(mech))
+                if fp is None:
+                    hits = [f for f in self._entries
+                            if f.startswith(str(mech))]
+                    if len(hits) != 1:
+                        raise UnknownMechanism(
+                            f"unknown mechanism {mech!r} "
+                            f"({len(self._entries)} resident; upload it "
+                            f"via POST /mechanism or use a resident id)")
+                    fp = hits[0]
+            entry = self._entries.get(fp)
+            if entry is None:
+                raise UnknownMechanism(f"mechanism {mech!r} is no longer "
+                                       f"resident (evicted)")
+            self._seq += 1
+            entry["last_used"] = self._seq
+            if self.cache_dir is not None:
+                now = time.monotonic()
+                if now - entry.get("touched_at", 0.0) > self.TOUCH_EVERY_S:
+                    entry["touched_at"] = now
+                    touch = self._session_keys(entry["session"])
+            session, scheduler = entry["session"], entry["scheduler"]
+        if touch:
+            # manifest I/O outside the lock (routing must never wait on
+            # a disk write); touch_keys itself is load+atomic-replace
+            from ..aot import touch_keys
+
+            touch_keys(self.cache_dir, touch)
+        return session, scheduler
+
+    def mechanisms(self):
+        """Healthz-facing census: one row per resident session."""
+        with self._lock:
+            return [{"fingerprint": fp,
+                     "ids": sorted(e["ids"]),
+                     "pinned": e["pinned"],
+                     "species": len(e["session"].species),
+                     "mech_shape": e["session"].mech_shape,
+                     "program_compiles": sum(
+                         e["session"].program_compiles().values())}
+                    for fp, e in self._entries.items()]
+
+    def healthz(self):
+        return {"mechanisms": self.mechanisms(),
+                "max_mechanisms": self.max_mechanisms}
+
+    # ---- lifecycle ---------------------------------------------------------
+    def drain(self, timeout=None):
+        """Drain every resident scheduler and close the sessions the
+        STORE admitted (the daemon's SIGTERM path); the default
+        session's context stays caller-owned (scripts/serve.py's
+        ``with session:``), and the store's upload temp dir is removed
+        when the store created it."""
+        import shutil
+
+        with self._lock:
+            entries = list(self._entries.values())
+        ok = True
+        for e in entries:
+            try:
+                ok = e["scheduler"].drain(timeout) and ok
+            except Exception:  # noqa: BLE001 — drain-all must finish
+                ok = False
+            if e["session"].fingerprint != self.default_fingerprint:
+                # symmetric with add_session's __enter__ (eviction and
+                # the redundant-admit path already close theirs)
+                e["session"].__exit__(None, None, None)
+        if self._owns_dir:
+            shutil.rmtree(self._dir, ignore_errors=True)
+        return ok
+
+
+def _safe_name(name):
+    return "".join(c if c.isalnum() or c in "-_." else "-" for c in name)

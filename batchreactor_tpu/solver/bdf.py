@@ -42,8 +42,8 @@ from jax import lax
 
 from .linalg import (apply_factor, factor_m, factor_zeros, make_solve_m,
                      resolve_linsolve)
-from .sdirk import (DT_UNDERFLOW, MAX_STEPS_REACHED, RUNNING, SUCCESS,
-                    SolveResult, _scaled_norm)
+from .sdirk import (DT_UNDERFLOW, MAX_STEPS_REACHED, NLIVE_KEY, RUNNING,
+                    SUCCESS, SolveResult, _scaled_norm)
 
 MAXORD = 5
 _ROWS = MAXORD + 3          # D rows 0..MAXORD+2
@@ -313,6 +313,24 @@ def solve(
         raise ValueError("timeline_state resumes a timeline ring; pass "
                          "timeline=N too or drop the state")
 
+    # mechanism-shape padding (models/padding.py; key contract
+    # sdirk.NLIVE_KEY): the live component count enters as a traced
+    # per-lane operand through cfg; absent — every unpadded run — the
+    # static None leaves every norm below tracing the pre-padding program
+    nlive = cfg.get(NLIVE_KEY) if isinstance(cfg, dict) else None
+    if nlive is not None:
+        nlive = jnp.asarray(nlive, dtype=y0.dtype)
+
+    def _norm(e, y):
+        return _scaled_norm(e, y, rtol, atol, nlive)
+
+    if nlive is None:
+        def _rms(x):
+            return jnp.sqrt(jnp.mean(jnp.square(x)))
+    else:
+        def _rms(x):
+            return jnp.sqrt(jnp.sum(jnp.square(x)) / nlive)
+
     f = functools.partial(rhs, cfg=cfg)
     if jac is None:
         jac = jax.jacfwd(lambda t, y: rhs(t, y, cfg), argnums=1)
@@ -326,8 +344,8 @@ def solve(
     # ---- initial h (Hairer heuristic, same as sdirk) ----------------------
     f0 = f(t0, y0)
     if dt0 is None or not isinstance(dt0, (int, float)):
-        d0 = _scaled_norm(y0, y0, rtol, atol)
-        d1 = _scaled_norm(f0, y0, rtol, atol)
+        d0 = _norm(y0, y0)
+        d1 = _norm(f0, y0)
         h_heur = jnp.clip(0.01 * d0 / jnp.maximum(d1, 1e-30),
                           span * 1e-24, span)
         if dt0 is None:
@@ -426,7 +444,7 @@ def solve(
             d, ynew, it, dw_old, _, _ = s
             res = c * f(t_new, ynew) - psi - d
             dd = solve_m(res)
-            dw = jnp.sqrt(jnp.mean(jnp.square(dd / scale)))
+            dw = _rms(dd / scale)
             rate = jnp.where(dw_old > 0, dw / dw_old, 0.0)
             slow = (dw_old > 0) & (
                 (rate >= 1.0)
@@ -534,7 +552,7 @@ def solve(
                 FS = fdot(t_new, y_cand, S_pred + dS)
                 dS = dS + jax.vmap(solve_m)(c * FS - psi_S - dS)
 
-        err = _scaled_norm(errc_tab[order] * d, y_pred, rtol, atol)
+        err = _norm(errc_tab[order] * d, y_pred)
         if tangent is not None and sens_errcon:
             # CVODES errconS=True analog: the tangent local error joins
             # the step controller, so h shrinks where the sensitivity
@@ -597,13 +615,13 @@ def solve(
         e_mid = err
         e_m = jnp.where(
             order > 1,
-            _scaled_norm(errc_tab[order - 1] * jnp.take(D_acc, order, axis=0),
-                         y_new, rtol, atol), jnp.inf)
+            _norm(errc_tab[order - 1] * jnp.take(D_acc, order, axis=0),
+                  y_new), jnp.inf)
         e_p = jnp.where(
             order < MAXORD,
-            _scaled_norm(errc_tab[order + 1] *
-                         jnp.take(D_acc, order + 2, axis=0),
-                         y_new, rtol, atol), jnp.inf)
+            _norm(errc_tab[order + 1] *
+                  jnp.take(D_acc, order + 2, axis=0),
+                  y_new), jnp.inf)
         of = order.astype(y0.dtype)
         f_m = jnp.where(order > 1,
                         jnp.maximum(e_m, 1e-16) ** (-1.0 / of), 0.0)

@@ -89,9 +89,28 @@ class SolveResult:
     #                            jaxprs are unchanged
 
 
-def _scaled_norm(e, y, rtol, atol):
+#: reserved per-lane cfg key carrying the LIVE state-component count of a
+#: mechanism-padded solve (models/padding.py).  Both solvers read it with
+#: ``cfg.get`` at trace time: absent (every unpadded run) the traced
+#: program is byte-identical to the key not existing; present, every
+#: scaled RMS norm divides by the live count instead of the padded state
+#: length, so dead pad components — which contribute exactly 0.0 to the
+#: squared sum — cannot dilute the error/Newton norms and perturb step
+#: control.  The key rides cfg (a traced per-lane operand), NOT a static
+#: argument, so two mechanisms with different live counts padded to one
+#: (S, R) bucket share a single compiled executable.
+NLIVE_KEY = "_nlive"
+
+
+def _scaled_norm(e, y, rtol, atol, nlive=None):
     scale = atol + rtol * jnp.abs(y)
-    return jnp.sqrt(jnp.mean(jnp.square(e / scale)))
+    if nlive is None:
+        return jnp.sqrt(jnp.mean(jnp.square(e / scale)))
+    # padded-state norm: trailing dead components are exactly 0.0 (zero
+    # state, zero RHS, identity Newton rows), so the squared sum equals
+    # the live sum bit-for-bit; only the denominator must be the live
+    # count for the norm to match the dedicated-shape program's
+    return jnp.sqrt(jnp.sum(jnp.square(e / scale)) / nlive)
 
 
 def solve(
@@ -199,6 +218,16 @@ def solve(
     # explicit modes, lu32p included, pass through validated
     linsolve = resolve_linsolve(linsolve, method="sdirk")
 
+    # mechanism-shape padding (models/padding.py): the reserved cfg key
+    # carries the live component count as a traced operand; absent (the
+    # default) every norm below traces exactly the pre-padding program
+    nlive = cfg.get(NLIVE_KEY) if isinstance(cfg, dict) else None
+    if nlive is not None:
+        nlive = jnp.asarray(nlive, dtype=y0.dtype)
+
+    def _norm(e, y):
+        return _scaled_norm(e, y, rtol, atol, nlive)
+
     f = functools.partial(rhs, cfg=cfg)
     if jac is None:
         jac = jax.jacfwd(lambda t, y: rhs(t, y, cfg), argnums=1)
@@ -209,8 +238,8 @@ def solve(
         # standard first-step heuristic (Hairer & Wanner II.4): h ~ 1% of the
         # scale-relative state/derivative ratio, clipped into the span
         f0 = f(t0, y0)
-        d0 = _scaled_norm(y0, y0, rtol, atol)
-        d1 = _scaled_norm(f0, y0, rtol, atol)
+        d0 = _norm(y0, y0)
+        d1 = _norm(f0, y0)
         # lower clip must admit chemistry's ~1e-16 s initial transients
         # (golden first step 4.3e-16 s, /root/reference/test/
         # batch_gas_and_surf/gas_profile.csv row 2)
@@ -239,7 +268,7 @@ def solve(
             g = z - base - h * _GAMMA * f(t_stage, z)
             dz = solve_m(-g)
             z_new = z + dz
-            dnorm = _scaled_norm(dz, y_scale, rtol, atol)
+            dnorm = _norm(dz, y_scale)
             converged = dnorm < newton_tol
             # divergence guard: growing updates or non-finite iterates
             growing = (it > 0) & (dnorm > 2.0 * prev_norm)
@@ -281,7 +310,7 @@ def solve(
 
         y_new = y + h * sum(b_i * k for b_i, k in zip(_B, ks))
         err_vec = h * sum(be * k for be, k in zip(_B_ERR, ks))
-        err = _scaled_norm(err_vec, y, rtol, atol)
+        err = _norm(err_vec, y)
         ok = ok & jnp.all(jnp.isfinite(y_new)) & jnp.isfinite(err)
         return y_new, err, ok, n_newton
 

@@ -122,9 +122,37 @@ docs/performance.md "Newton setup economy") reuse the carried
 factorization across `jac_window` boundaries until `|c/c0 - 1| >
 stale_tol` (default 0.3) or a Newton convergence failure forces a
 refresh."""),
+    ("Mechanism-shape padding", "batchreactor_tpu.models.padding",
+     ["pad_gas_mechanism", "pad_thermo", "pad_states", "nlive_cfg",
+      "mech_shape_class"],
+     """\
+The species/reaction twin of lane-count bucketing (docs/performance.md
+"Mechanism-shape economy"): pad a mechanism onto a canonical (S, R)
+rung with a provably inert dead tail — zero rates, identity Newton
+rows/cols, live-count error norms — so mechanisms of one size class
+share compiled executables, exactly as sweep sizes share bucket
+programs.  Consumed through `batch_reactor_sweep(species_buckets=,
+reaction_buckets=, mech_operands=)` and the serving session spec."""),
+    ("AOT program store", "batchreactor_tpu.aot",
+     ["warmup", "spec_keys", "configure_cache", "program_key",
+      "mechanism_fingerprint", "bundle_shape_signature",
+      "normalize_buckets", "resolve_bucket", "bucket_ladder",
+      "load_manifest", "merge_manifests", "touch_keys", "pin_keys",
+      "enforce_capacity", "cache_stats"],
+     """\
+Shape-bucketed ahead-of-time compilation (docs/performance.md
+"Compile economy" / "Mechanism-shape economy"): canonical (B, S, R)
+program ladders, zero-span warmup through the real sweep drivers into
+the persistent compilation cache, a manifest with per-program
+compile/hit accounting, and — now that mechanism uploads make the
+program set user-extensible — use-tracking with an LRU eviction + pin
+policy (`aot_evictions` counter).  CLI: `scripts/warm_cache.py`
+(`--spec`, `--fanout`, `--list`, `--evict/--pin/--unpin`)."""),
     ("Serving", "batchreactor_tpu.serving",
-     ["validate_request", "Request", "error_response", "ok_response",
-      "load_spec", "SessionSpec", "SolverSession", "Scheduler",
+     ["validate_request", "validate_upload", "Request",
+      "error_response", "ok_response",
+      "load_spec", "SessionSpec", "SolverSession", "SessionStore",
+      "UnknownMechanism", "Scheduler",
       "RequestResult", "Overloaded", "Draining", "ServingServer",
       "serve_jsonl", "SolveClient", "ServeError", "poisson_trace"],
      """\
@@ -134,9 +162,13 @@ continuously-batched device program — warm AOT executables
 (`scripts/warm_cache.py --spec serve.json`), the streaming admission
 driver's live feed (`parallel/sweep.py` `_feed`/`_on_harvest`),
 explicit `overloaded`/`draining` backpressure, SIGTERM graceful drain,
-and the live `/metrics` plane.  Entry points: `scripts/serve.py`
-(HTTP + stdin-JSONL) and `scripts/serve_bench.py` (seeded Poisson
-load, the round-10 latency/throughput evidence)."""),
+and the live `/metrics` plane.  The multi-mechanism store
+(`SessionStore`, `POST /mechanism`, per-request `mech` routing) serves
+MANY mechanisms from one daemon, sharing executables per (B, S, R)
+rung under `mech_operands`.  Entry points: `scripts/serve.py`
+(HTTP + stdin-JSONL, `--store`/`--add-mech`) and
+`scripts/serve_bench.py` (seeded Poisson load, `--mechs` — the
+round-10/11 latency/throughput evidence)."""),
     ("Static analysis (brlint)", "batchreactor_tpu.analysis",
      ["lint_paths", "lint_file", "Baseline", "Finding", "all_rules",
       "program_contract", "run_contracts", "all_contracts",

@@ -59,6 +59,15 @@ def main(argv=None):
                     help="persistent compilation cache directory")
     ap.add_argument("--flight-dir", default=".",
                     help="directory for flight_*.jsonl postmortem dumps")
+    ap.add_argument("--store", action="store_true",
+                    help="enable the multi-mechanism session store: "
+                         "POST /mechanism uploads + per-request 'mech' "
+                         "routing (docs/serving.md); the --spec "
+                         "mechanism is the pinned default")
+    ap.add_argument("--add-mech", action="append", default=[],
+                    metavar="ID=MECH:THERM",
+                    help="pre-admit extra mechanisms into the store "
+                         "(implies --store); repeatable")
     args = ap.parse_args(argv)
 
     # the cache dir must be pinned BEFORE jax compiles anything
@@ -77,6 +86,22 @@ def main(argv=None):
         session.warmup(cache_dir=args.cache_dir,
                        log=lambda m: print(m, file=sys.stderr))
     scheduler = Scheduler(session)
+    store = None
+    if args.store or args.add_mech:
+        from batchreactor_tpu.serving.session import SessionStore
+
+        store = SessionStore(session, scheduler,
+                             cache_dir=args.cache_dir)
+        for spec_str in args.add_mech:
+            mid, _, rest = spec_str.partition("=")
+            mech, _, therm = rest.partition(":")
+            if not (mid and mech and therm):
+                ap.error(f"--add-mech wants ID=MECH:THERM, got "
+                         f"{spec_str!r}")
+            fp = store.add_mechanism(mech, therm, mech_id=mid,
+                                     warm=not args.no_warmup)
+            print(f"[serve] mechanism {mid!r} resident "
+                  f"({fp[:12]}...)", file=sys.stderr)
 
     # HTTP mode drains on SIGTERM/SIGINT: OUR handler goes in first,
     # then arm_flight wraps it — the SIGTERM path therefore dumps the
@@ -109,11 +134,13 @@ def main(argv=None):
                   file=sys.stderr)
             return 0
         with ServingServer(session, scheduler, port=args.port,
-                           host=args.host) as srv:
+                           host=args.host, store=store) as srv:
             print(json.dumps({"serving": {
                 "url": srv.url, "port": srv.port, "pid": os.getpid(),
                 "fingerprint": session.fingerprint,
                 "bucket_cap": session.bucket_cap,
+                "store": (None if store is None else
+                          [m["ids"] for m in store.mechanisms()]),
                 "warmed": (None if session.warmed is None else
                            [r.key for r in session.warmed])}}),
                   flush=True)

@@ -49,6 +49,16 @@ def main(argv=None):
     ap.add_argument("--T-hi", type=float, default=1500.0)
     ap.add_argument("--comp", default="H2=0.3,O2=0.15,N2=0.55",
                     help="inlet mole fractions, SP=x comma-separated")
+    ap.add_argument("--mechs", action="append", default=[],
+                    metavar="ID=MECH:THERM",
+                    help="multi-mechanism preset: upload these extra "
+                         "mechanisms over POST /mechanism before the "
+                         "trace and route requests across the whole set "
+                         "from the seed's rng; the summary gains "
+                         "per-mechanism cond/s + the compile/wall "
+                         "split (PERF.md round-11).  Repeatable; "
+                         "in-process daemons get the session store "
+                         "automatically")
     ap.add_argument("--t1", type=float, default=5e-5,
                     help="integration horizon per request [s]")
     ap.add_argument("--no-warmup", action="store_true")
@@ -74,18 +84,36 @@ def main(argv=None):
         name, _, val = part.partition("=")
         comp[name.strip()] = float(val)
     lane_choices = [int(v) for v in args.lanes.split(",")]
+    mech_specs = []
+    for spec_str in args.mechs:
+        mid, _, rest = spec_str.partition("=")
+        mech, _, therm = rest.partition(":")
+        if not (mid and mech and therm):
+            ap.error(f"--mechs wants ID=MECH:THERM, got {spec_str!r}")
+        mech_specs.append((mid, mech, therm))
+    #: the routing choices the seeded rng draws from — None is the
+    #: daemon's default mechanism; uploads join before the trace fires
+    mech_choices = [None] + [m[0] for m in mech_specs]
 
     def make_request(i, rng):
         k = rng.choice(lane_choices)
-        return {"id": f"bench-{args.seed}-{i}",
-                "T": [round(rng.uniform(args.T_lo, args.T_hi), 3)
-                      for _ in range(k)],
-                "X": comp, "t1": args.t1}
+        req = {"id": f"bench-{args.seed}-{i}",
+               "T": [round(rng.uniform(args.T_lo, args.T_hi), 3)
+                     for _ in range(k)],
+               "X": comp, "t1": args.t1}
+        if len(mech_choices) > 1:
+            # draw only in multi-mechanism mode: an unconditional draw
+            # would consume rng state and silently change every seeded
+            # single-mechanism trace vs the round-10 baselines
+            mech = rng.choice(mech_choices)
+            if mech is not None:
+                req["mech"] = mech
+        return req
 
     trace = poisson_trace(args.requests, args.rate, args.seed,
                           make_request)
 
-    session = server = None
+    session = server = store = None
     if args.url:
         url = args.url
     else:
@@ -95,17 +123,40 @@ def main(argv=None):
             aot.configure_cache(args.cache_dir)
         from batchreactor_tpu.serving.scheduler import Scheduler
         from batchreactor_tpu.serving.server import ServingServer
-        from batchreactor_tpu.serving.session import SolverSession
+        from batchreactor_tpu.serving.session import (SessionStore,
+                                                      SolverSession)
 
         session = SolverSession.from_spec(args.spec)
         if not args.no_warmup:
             session.warmup(cache_dir=args.cache_dir,
                            log=lambda m: print(m, file=sys.stderr))
         session.__enter__()
-        server = ServingServer(session, Scheduler(session)).start()
+        scheduler = Scheduler(session)
+        if mech_specs:
+            store = SessionStore(session, scheduler,
+                                 cache_dir=args.cache_dir)
+        server = ServingServer(session, scheduler, store=store).start()
         url = server.url
 
     client = SolveClient(url)
+    upload_s = 0.0
+    if mech_specs:
+        # the upload path IS the measured surface: route the extra
+        # mechanisms through POST /mechanism like any client would
+        # (works against --url daemons too), timing the warm-in wall
+        t_up = time.perf_counter()
+        for mid, mech, therm in mech_specs:
+            with open(mech) as f:
+                mech_text = f.read()
+            with open(therm) as f:
+                therm_text = f.read()
+            resp = client.upload_mechanism(mid, mech_text, therm_text,
+                                           warm=not args.no_warmup)
+            print(f"[serve-bench] mechanism {mid!r} resident "
+                  f"(shape {resp.get('mech_shape')}, armed compiles "
+                  f"{sum((resp.get('program_compiles') or {}).values())})",
+                  file=sys.stderr)
+        upload_s = time.perf_counter() - t_up
     scrapes = []
     answered = [0]
 
@@ -134,6 +185,24 @@ def main(argv=None):
     summary["seed"] = args.seed
     summary["rate_hz"] = args.rate
     summary["t1"] = args.t1
+    if mech_specs:
+        # per-mechanism split: lanes answered / shared trace wall (the
+        # mechanisms ride ONE daemon, so per-mechanism cond/s sum to
+        # the total) + the upload/warm-in wall
+        per = {}
+        for (_at, req), rec in zip(trace, records):
+            key = req.get("mech") or "default"
+            d = per.setdefault(key, {"requests": 0, "answered": 0,
+                                     "lanes": 0})
+            d["requests"] += 1
+            if rec and rec["ok"]:
+                d["answered"] += 1
+                d["lanes"] += len((rec["response"] or {}).get("t", []))
+        for d in per.values():
+            d["cond_per_s"] = (round(d["lanes"] / wall, 3)
+                               if wall > 0 else None)
+        summary["per_mechanism"] = per
+        summary["mech_upload_s"] = round(upload_s, 3)
     all_success = all(
         r and r["ok"]
         and all(p == "success"
@@ -142,6 +211,14 @@ def main(argv=None):
     summary["all_success"] = bool(all_success)
 
     if server is not None:
+        if store is not None:
+            # the compile/wall split per resident mechanism — the
+            # round-11 evidence that shared-rung mechanisms serve a
+            # whole trace at zero armed compiles
+            summary["per_mechanism_compiles"] = {
+                "+".join(m["ids"]) or m["fingerprint"][:12]:
+                    m["program_compiles"]
+                for m in store.mechanisms()}
         server.close()
         w = session.compile_summary()
         # program_compiles is the warm-serving contract (0 after
@@ -149,6 +226,7 @@ def main(argv=None):
         # eager-op programs on the unarmed serve-host label
         summary["program_compiles"] = session.program_compiles()
         summary["compiles"] = w["compiles"]
+        summary["compile_s"] = round(w.get("compile_s", 0.0), 3)
         summary["retraces"] = w["retraces"]
         summary["cache_hits"] = w["cache_hits"]
         session.__exit__(None, None, None)

@@ -58,15 +58,22 @@ def _parse_comp(text):
 
 
 def list_manifest(cache_dir):
-    """Render the manifest without touching jax or a device."""
-    from batchreactor_tpu.aot import load_manifest, manifest_path
+    """Render the manifest without touching jax or a device: every
+    entry with its (B, S, R) shape, staleness, NEVER-HIT status (zero
+    persistent-cache hits — warmed but no session ever loaded it, the
+    registry's eviction candidates), pin state, and the cache dir's
+    total bytes on disk."""
+    from batchreactor_tpu.aot import (cache_stats, load_manifest,
+                                      manifest_path)
 
     man = load_manifest(cache_dir)
     entries = man.get("entries", {})
+    stats = cache_stats(cache_dir)
     print(f"manifest {manifest_path(cache_dir)} "
           f"(jax {man.get('jax', '?')}, package {man.get('package', '?')}):"
           f" {len(entries)} programs")
     cur_jax = man.get("jax")
+    never_hit = set(stats["never_hit"])
     stale = 0
     for key in sorted(entries):
         e = entries[key]
@@ -74,14 +81,78 @@ def list_manifest(cache_dir):
         if cur_jax is not None and e.get("jax") != cur_jax:
             tag = f"  [STALE: warmed under jax {e.get('jax')}]"
             stale += 1
-        print(f"  {key}: bucket={e['bucket']} warmups={e['warmups']} "
+        if key in never_hit:
+            tag += "  [NEVER-HIT]"
+        if e.get("pinned"):
+            tag += "  [PINNED]"
+        shape = (f" s={e['s_bucket']} r={e['r_bucket']}"
+                 if "s_bucket" in e else "")
+        print(f"  {key}: bucket={e['bucket']}{shape} "
+              f"warmups={e['warmups']} "
               f"compiles={e['compiles']} ({e['compile_s']:.1f}s) "
               f"hits={e['cache_hits']} misses={e['cache_misses']} "
-              f"last={e.get('last_warmed', '?')}{tag}")
+              f"last={e.get('last_used', e.get('last_warmed', '?'))}"
+              f"{tag}")
     if stale:
         print(f"  {stale} stale entr{'y' if stale == 1 else 'ies'} — "
               f"re-run warmup under the current jax")
+    if never_hit:
+        print(f"  {len(never_hit)} never-hit entr"
+              f"{'y' if len(never_hit) == 1 else 'ies'} — warmed but "
+              f"never loaded by any session (eviction candidates)")
+    print(f"  cache dir: {stats['cache_files']} files, "
+          f"{stats['total_cache_bytes'] / 1e6:.1f} MB")
     return 0
+
+
+def fanout_warm(args):
+    """``--fanout N --spec serve.json``: per-host AOT warmup fanout
+    (ROADMAP 2) — N worker PROCESSES warm disjoint round-robin shards
+    of the session's warmup specs concurrently against ONE shared
+    persistent cache (jax's cache writes are per-file atomic, so
+    concurrent writers compose), each recording its counters in a
+    private part manifest; the parent then folds the parts into the
+    main manifest crash-atomically (aot.merge_manifests: tmp +
+    os.replace, the PR-7 chunk convention — a SIGKILL at any point
+    loses no counters and never tears the manifest)."""
+    import subprocess
+
+    n = int(args.fanout)
+    cmd_base = [sys.executable, os.path.abspath(__file__),
+                "--spec", args.spec, "--cache-dir", args.cache_dir]
+    procs = []
+    tags = []
+    for i in range(n):
+        tag = f"fanout-{os.getpid()}-{i}"
+        tags.append(tag)
+        procs.append(subprocess.Popen(
+            cmd_base + ["--fanout-worker", f"{i}:{n}",
+                        "--manifest-tag", tag],
+            stdout=subprocess.PIPE, stderr=sys.stderr))
+    outs, rcs = [], []
+    for p in procs:
+        out, _ = p.communicate()
+        rcs.append(p.returncode)
+        try:
+            outs.append(json.loads(out.decode() or "{}"))
+        except ValueError:
+            outs.append({})
+    from batchreactor_tpu.aot import load_manifest, merge_manifests
+
+    merge_manifests(args.cache_dir, tags)
+    man = load_manifest(args.cache_dir)
+    summary = {
+        "workers": n,
+        "worker_rcs": rcs,
+        "programs": sum(o.get("programs", 0) for o in outs),
+        "already_warm": sum(o.get("already_warm", 0) for o in outs),
+        "compiled": sum(o.get("compiled", 0) for o in outs),
+        "compile_s": round(sum(o.get("compile_s", 0.0) for o in outs), 3),
+        "manifest_entries": len(man.get("entries", {})),
+        "cache_dir": os.path.abspath(args.cache_dir),
+    }
+    print(json.dumps(summary))
+    return 0 if all(rc == 0 for rc in rcs) else 1
 
 
 def warm_from_spec(args):
@@ -99,6 +170,28 @@ def warm_from_spec(args):
 
     session = SolverSession.from_spec(args.spec)
     specs = session.warmup_specs()
+    if args.fanout_worker:
+        # one fanout shard (fanout_warm spawns these): round-robin by
+        # spec index, so shard unions cover the spec list exactly
+        idx, total = (int(v) for v in args.fanout_worker.split(":"))
+        specs = [s for k, s in enumerate(specs) if k % total == idx]
+        if not specs:
+            print(json.dumps({"programs": 0, "already_warm": 0,
+                              "compiled": 0, "compile_s": 0.0,
+                              "keys": []}))
+            return 0
+        results = aot.warmup(specs, cache_dir=args.cache_dir,
+                             log=lambda m: print(m, file=sys.stderr),
+                             manifest_tag=args.manifest_tag)
+        warm = sum(r.warm for r in results)
+        print(json.dumps({
+            "programs": len(results),
+            "already_warm": warm,
+            "compiled": len(results) - warm,
+            "compile_s": round(sum(r.compile_s for r in results), 3),
+            "keys": [r.key for r in results],
+        }))
+        return 0
     if args.list:
         man = aot.load_manifest(args.cache_dir)
         entries = man.get("entries", {})
@@ -195,8 +288,38 @@ def main(argv=None):
                          "serve.json (serving.session.load_spec grammar) "
                          "— the daemon and the warmer then share one "
                          "fingerprint by construction")
+    ap.add_argument("--fanout", type=int, default=0,
+                    help="with --spec: warm the spec's program set with "
+                         "this many concurrent worker processes against "
+                         "the shared persistent cache (per-host pod-tier "
+                         "warmup); part manifests merge crash-atomically")
+    ap.add_argument("--fanout-worker", help=argparse.SUPPRESS)
+    ap.add_argument("--manifest-tag", help=argparse.SUPPRESS)
+    ap.add_argument("--evict", type=int, metavar="MAX_PROGRAMS",
+                    help="LRU-evict unpinned manifest entries beyond "
+                         "MAX_PROGRAMS (pinned entries never evict); "
+                         "no compiles, no device")
+    ap.add_argument("--pin", action="append", default=[], metavar="KEY",
+                    help="pin manifest entries (exempt from --evict and "
+                         "the serving store's LRU policy); repeatable")
+    ap.add_argument("--unpin", action="append", default=[],
+                    metavar="KEY", help="unpin manifest entries")
     args = ap.parse_args(argv)
 
+    if args.evict is not None or args.pin or args.unpin:
+        from batchreactor_tpu.aot import enforce_capacity, pin_keys
+
+        out = {}
+        if args.pin:
+            out["pinned"] = pin_keys(args.cache_dir, args.pin, True)
+        if args.unpin:
+            out["unpinned"] = pin_keys(args.cache_dir, args.unpin, False)
+        if args.evict is not None:
+            out["evicted"] = enforce_capacity(args.cache_dir, args.evict)
+        print(json.dumps(out))
+        return 0
+    if args.fanout and args.spec and not args.fanout_worker:
+        return fanout_warm(args)
     if args.spec:
         return warm_from_spec(args)
     if args.list:

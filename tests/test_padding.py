@@ -1,0 +1,361 @@
+"""Mechanism-shape padding + mechanism-as-operand programs
+(models/padding.py, api.py ``species_buckets``/``reaction_buckets``/
+``mech_operands`` — docs/performance.md "Mechanism-shape economy").
+
+The inertness contract under test:
+
+* dead species/reactions contribute EXACT zeros to production rates and
+  to the Jacobian's dead rows AND columns;
+* solver step counts, rejection counts, and order histograms are
+  IDENTICAL padded vs unpadded (the ``_nlive`` norm operand restores the
+  live-count denominator — padding must not perturb error control);
+* live final states match the dedicated-shape run to quasi-Newton
+  roundoff (XLA reassociates reductions across tensor shapes, so
+  Newton-converged states carry a documented few-ulp caveat — the PR-8
+  down-shift precedent; production rates themselves are bit-exact);
+* in operand mode, two mechanisms padded onto one (S, R) rung run ONE
+  compiled executable: the second mechanism's armed ``sweep-segment``
+  label records ZERO compiles (the PERF.md round-11 evidence).
+"""
+
+import numpy as np
+import pytest
+
+import batchreactor_tpu as br
+from batchreactor_tpu.models.padding import (NLIVE_KEY, mech_shape_class,
+                                             nlive_cfg, pad_gas_mechanism,
+                                             pad_states, pad_thermo)
+from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+from batchreactor_tpu.parallel.grid import sweep_solution_vectors
+from batchreactor_tpu.parallel.sweep import (ensemble_solve,
+                                             ensemble_solve_segmented)
+
+import jax.numpy as jnp
+
+FIX = __file__.rsplit("/", 1)[0] + "/fixtures"
+S_PAD, R_PAD = 16, 32
+
+
+@pytest.fixture(scope="module")
+def mech():
+    gm = br.compile_gaschemistry(f"{FIX}/h2o2.dat")
+    th = br.create_thermo(list(gm.species), f"{FIX}/therm.dat")
+    return gm, th
+
+
+@pytest.fixture(scope="module")
+def mech_n():
+    gm = br.compile_gaschemistry(f"{FIX}/h2o2_n.dat")
+    th = br.create_thermo(list(gm.species), f"{FIX}/therm.dat")
+    return gm, th
+
+
+def _lanes(gm, th, B=3):
+    S = gm.n_species
+    X = np.zeros((B, S))
+    idx = {s: k for k, s in enumerate(gm.species)}
+    X[:, idx["H2"]], X[:, idx["O2"]], X[:, idx["N2"]] = 0.3, 0.15, 0.55
+    T = jnp.asarray(np.linspace(1150.0, 1500.0, B))
+    y0 = sweep_solution_vectors(jnp.asarray(X), th.molwt, T, 1e5)
+    return y0, {"T": T, "Asv": jnp.ones(B)}
+
+
+# --------------------------------------------------------------------------
+# the padding layer itself
+# --------------------------------------------------------------------------
+def test_padding_validation(mech):
+    gm, th = mech
+    with pytest.raises(ValueError, match="cannot shrink"):
+        pad_gas_mechanism(gm, gm.n_species - 1, R_PAD)
+    with pytest.raises(ValueError, match="cannot shrink"):
+        pad_thermo(th, th.n_species - 1)
+    with pytest.raises(ValueError, match="cannot shrink"):
+        pad_states(jnp.zeros((2, 5)), 3)
+
+
+def test_rhs_and_jac_inertness(mech):
+    """Dead species: zero rates, zero Jacobian rows AND columns; live
+    block bit-exact (eager — no reduction-shape reassociation here for
+    the rates; the Jacobian contraction carries the documented ulp
+    caveat, so the dead-block zeros are the hard assertion)."""
+    gm, th = mech
+    S = gm.n_species
+    gmp = pad_gas_mechanism(gm, S_PAD, R_PAD)
+    thp = pad_thermo(th, S_PAD)
+    y0, _ = _lanes(gm, th, 1)
+    cfg = {"T": 1300.0, "Asv": 1.0}
+    dy = make_gas_rhs(gm, th)(0.0, y0[0], cfg)
+    dyp = make_gas_rhs(gmp, thp)(0.0, pad_states(y0, S_PAD)[0], cfg)
+    assert np.array_equal(np.asarray(dy), np.asarray(dyp)[:S])
+    assert np.all(np.asarray(dyp)[S:] == 0.0)
+    Jp = make_gas_jac(gmp, thp)(0.0, pad_states(y0, S_PAD)[0], cfg)
+    Jp = np.asarray(Jp)
+    assert np.all(Jp[S:, :] == 0.0), "dead Jacobian rows must be zero"
+    assert np.all(Jp[:, S:] == 0.0), "dead Jacobian columns must be zero"
+
+
+def test_identity_padding_is_value_transparent(mech):
+    gm, th = mech
+    gmi = pad_gas_mechanism(gm, gm.n_species, gm.n_reactions)
+    thi = pad_thermo(th, th.n_species)
+    for name in ("nu_f", "log_A", "eff", "troe", "plog_lnp"):
+        assert np.array_equal(np.asarray(getattr(gm, name)),
+                              np.asarray(getattr(gmi, name)))
+    assert gmi.species == gm.species and gmi.equations == gm.equations
+    assert np.array_equal(np.asarray(th.molwt), np.asarray(thi.molwt))
+
+
+def test_shape_class_and_canonical_meta(mech, mech_n):
+    gm, th = mech
+    gm2, th2 = mech_n
+    a = pad_gas_mechanism(gm, S_PAD, R_PAD, canonical=True)
+    b = pad_gas_mechanism(gm2, S_PAD, R_PAD, canonical=True)
+    assert mech_shape_class(a) == mech_shape_class(b)
+    assert a.species == b.species and a.equations == b.equations
+    ta = pad_thermo(th, S_PAD, canonical=True)
+    tb = pad_thermo(th2, S_PAD, canonical=True)
+    assert ta.species == tb.species and ta.composition == tb.composition
+    # non-canonical padding keeps the live names (closure-mode reports)
+    nc = pad_gas_mechanism(gm, S_PAD, R_PAD)
+    assert nc.species[: gm.n_species] == gm.species
+
+
+# --------------------------------------------------------------------------
+# dead species provably inert: step control blind to the padding
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("method", ["bdf", "sdirk"])
+def test_step_counts_and_order_hist_identical(mech, method):
+    gm, th = mech
+    S = gm.n_species
+    gmp, thp = pad_gas_mechanism(gm, S_PAD, R_PAD), pad_thermo(th, S_PAD)
+    y0, cfg = _lanes(gm, th)
+    kw = dict(method=method, stats=True, max_steps=20_000)
+    a = ensemble_solve(make_gas_rhs(gm, th), y0, 0.0, 5e-5, cfg,
+                       jac=make_gas_jac(gm, th), **kw)
+    b = ensemble_solve(make_gas_rhs(gmp, thp), pad_states(y0, S_PAD),
+                       0.0, 5e-5, nlive_cfg(cfg, S, y0.shape[0]),
+                       jac=make_gas_jac(gmp, thp), **kw)
+    assert np.array_equal(np.asarray(a.status), np.asarray(b.status))
+    assert np.array_equal(np.asarray(a.n_accepted),
+                          np.asarray(b.n_accepted))
+    assert np.array_equal(np.asarray(a.n_rejected),
+                          np.asarray(b.n_rejected))
+    assert np.array_equal(np.asarray(a.t), np.asarray(b.t))
+    if method == "bdf":
+        assert np.array_equal(np.asarray(a.stats["order_hist"]),
+                              np.asarray(b.stats["order_hist"]))
+    # dead species hold exactly zero through the whole solve
+    assert np.all(np.asarray(b.y)[:, S:] == 0.0)
+    # live states: quasi-Newton roundoff caveat (module doc)
+    ref = np.asarray(a.y)
+    assert np.allclose(ref, np.asarray(b.y)[:, :S], rtol=1e-10,
+                       atol=1e-22)
+
+
+def test_segmented_and_admission_padded(mech):
+    """The segmented matrix leg: step counts identical and states at
+    roundoff through the pipelined driver and continuous batching."""
+    gm, th = mech
+    S = gm.n_species
+    gmp, thp = pad_gas_mechanism(gm, S_PAD, R_PAD), pad_thermo(th, S_PAD)
+    y0, cfg = _lanes(gm, th, 5)
+    kw = dict(segment_steps=32, max_segments=10_000, stats=True)
+    a = ensemble_solve_segmented(make_gas_rhs(gm, th), y0, 0.0, 5e-5,
+                                 cfg, jac=make_gas_jac(gm, th), **kw)
+    for extra in ({}, {"admission": 2, "refill": 1}):
+        b = ensemble_solve_segmented(
+            make_gas_rhs(gmp, thp), pad_states(y0, S_PAD), 0.0, 5e-5,
+            nlive_cfg(cfg, S, 5), jac=make_gas_jac(gmp, thp), **kw,
+            **extra)
+        assert np.array_equal(np.asarray(a.status), np.asarray(b.status))
+        assert np.array_equal(np.asarray(a.n_accepted),
+                              np.asarray(b.n_accepted)), extra
+        assert np.allclose(np.asarray(a.y), np.asarray(b.y)[:, :S],
+                           rtol=1e-10, atol=1e-22), extra
+        assert np.all(np.asarray(b.y)[:, S:] == 0.0)
+
+
+# --------------------------------------------------------------------------
+# the api entry point
+# --------------------------------------------------------------------------
+def test_sweep_api_padded_strips_live_species(mech):
+    gm, th = mech
+    chem = br.Chemistry(gaschem=True)
+    comp = {"H2": 0.3, "O2": 0.15, "N2": 0.55}
+    T = [1200.0, 1400.0]
+    base = br.batch_reactor_sweep(comp, T, 1e5, 5e-5, chem=chem,
+                                  thermo_obj=th, md=gm)
+    pad = br.batch_reactor_sweep(comp, T, 1e5, 5e-5, chem=chem,
+                                 thermo_obj=th, md=gm,
+                                 species_buckets=(S_PAD,),
+                                 reaction_buckets=(R_PAD,),
+                                 telemetry=True)
+    assert set(pad["x"]) == set(gm.species)  # no _PAD_* names leak
+    for s in gm.species:
+        assert np.allclose(base["x"][s], pad["x"][s], rtol=1e-10,
+                           atol=1e-18)
+    assert tuple(pad["telemetry"]["meta"]["mech_shape"]) == (S_PAD, R_PAD)
+    # the failure-triage report never carries the reserved operand
+    assert all(not k.startswith("_")
+               for k in pad["report"].get("failed_conditions", {}))
+
+
+def test_sweep_api_padding_validation(mech):
+    gm, th = mech
+    chem = br.Chemistry(gaschem=True)
+    comp = {"H2": 1.0}
+    with pytest.raises(ValueError, match="segment_steps"):
+        br.batch_reactor_sweep(comp, 1200.0, 1e5, 1e-6, chem=chem,
+                               thermo_obj=th, md=gm, mech_operands=True)
+    with pytest.raises(ValueError, match="analytic Jacobian"):
+        br.batch_reactor_sweep(comp, 1200.0, 1e5, 1e-6, chem=chem,
+                               thermo_obj=th, md=gm, mech_operands=True,
+                               segment_steps=16, analytic_jac=False)
+    with pytest.raises(ValueError, match="gas chemistry only"):
+        br.batch_reactor_sweep(comp, 1200.0, 1e5, 1e-6,
+                               chem=br.Chemistry(userchem=True,
+                                                 udf=lambda t, s: 0.0),
+                               thermo_obj=th, species_buckets="pow2")
+
+
+def test_mech_operands_one_executable_two_mechanisms(mech, mech_n,
+                                                     cold_compile_cache):
+    """THE tentpole contract: a second mechanism padded into a warmed
+    (B, S, R) bucket compiles NOTHING — armed ``sweep-segment`` label
+    evidence, compact program included — and its results match its own
+    dedicated-shape run."""
+    gm, th = mech
+    gm2, th2 = mech_n
+    chem = br.Chemistry(gaschem=True)
+    T = [1200.0, 1350.0, 1500.0]
+    kw = dict(chem=chem, segment_steps=64, mech_operands=True,
+              species_buckets=(S_PAD,), reaction_buckets=(R_PAD,),
+              telemetry=True, admission=2, refill=1)
+
+    def armed(rep):
+        lbl = rep["telemetry"]["compile"].get("by_label") or {}
+        return {k: v["compiles"] for k, v in lbl.items()
+                if v.get("single_program")}
+
+    rA = br.batch_reactor_sweep({"H2": 0.3, "O2": 0.15, "N2": 0.55}, T,
+                                1e5, 5e-5, thermo_obj=th, md=gm, **kw)
+    first = armed(rA)
+    assert first.get("sweep-segment", 0) >= 1  # cold bucket compiled
+    rB = br.batch_reactor_sweep(
+        {"H2": 0.3, "O2": 0.15, "N2": 0.5, "AR": 0.05}, T, 1e5, 5e-5,
+        thermo_obj=th2, md=gm2, **kw)
+    second = armed(rB)
+    assert sum(second.values()) == 0, (
+        f"second mechanism in a warmed bucket must compile nothing; "
+        f"got {second} (first run: {first})")
+    assert set(rB["x"]) == set(gm2.species)
+    base = br.batch_reactor_sweep(
+        {"H2": 0.3, "O2": 0.15, "N2": 0.5, "AR": 0.05}, T, 1e5, 5e-5,
+        chem=chem, thermo_obj=th2, md=gm2)
+    for s in gm2.species:
+        assert np.allclose(base["x"][s], rB["x"][s], rtol=1e-10,
+                           atol=1e-18), s
+    # same program + same operands => bit-exact across re-parsed copies
+    gm2b = br.compile_gaschemistry(f"{FIX}/h2o2_n.dat")
+    th2b = br.create_thermo(list(gm2b.species), f"{FIX}/therm.dat")
+    rB2 = br.batch_reactor_sweep(
+        {"H2": 0.3, "O2": 0.15, "N2": 0.5, "AR": 0.05}, T, 1e5, 5e-5,
+        thermo_obj=th2b, md=gm2b, **kw)
+    for s in gm2.species:
+        assert np.array_equal(rB["x"][s], rB2["x"][s]), s
+
+
+# --------------------------------------------------------------------------
+# the (B, S, R) aot registry keys
+# --------------------------------------------------------------------------
+def test_program_key_mech_shape_and_legacy_format():
+    from batchreactor_tpu.aot import program_key
+
+    legacy = program_key("fp", "bdf", 8, {"rtol": "1e-06"})
+    assert legacy.startswith("bdf-b8-") and len(legacy.split("-")) == 3
+    shaped = program_key("fp", "bdf", 8, {"rtol": "1e-06"},
+                         mech_shape=(16, 32))
+    assert shaped.startswith("bdf-b8-s16r32-")
+    assert shaped.split("-")[-1] != legacy.split("-")[-1]
+
+
+def test_spec_keys_share_rung_across_mechanisms(mech, mech_n):
+    """Two mechanisms on one (S, R) rung resolve to the SAME program
+    keys (the warm-cache manifest's sharing evidence) while their
+    closure-mode specs resolve to different ones."""
+    from batchreactor_tpu.aot import spec_keys
+    from batchreactor_tpu.api import _padded_mech, _segmented_builder
+
+    gm, th = mech
+    gm2, th2 = mech_n
+    builder = _segmented_builder("gas", None, False, True)
+
+    def spec_for(g, t):
+        gp, tp = _padded_mech(g, t, S_PAD, R_PAD, True)
+        y0 = np.zeros(S_PAD)
+        y0[0] = 1.0
+        return dict(rhs=builder, y0=y0, cfg={"T": 1300.0, "Asv": 1.0,
+                                             NLIVE_KEY: 9.0},
+                    lanes=[4], buckets=(4,), segment_steps=16,
+                    rhs_bundle=(gp, None, tp))
+
+    ka = spec_keys(spec_for(gm, th))
+    kb = spec_keys(spec_for(gm2, th2))
+    assert ka == kb
+    assert all("-s16r32-" in key for key, _b in ka)
+
+
+def test_registry_lru_pin_and_stats(tmp_path):
+    from batchreactor_tpu import aot
+    from batchreactor_tpu.obs import Recorder
+
+    cache = str(tmp_path)
+    man = aot.load_manifest(cache)
+    for i, key in enumerate(["bdf-b2-aaa", "bdf-b4-bbb", "bdf-b8-ccc"]):
+        man["entries"][key] = {
+            "bucket": 2 ** (i + 1), "warmups": 1, "compiles": 1,
+            "compile_s": 1.0, "cache_hits": i,  # first entry never hit
+            "cache_misses": 0, "last_used": f"2026-08-0{i + 1}T00:00:00"}
+    from batchreactor_tpu.aot.registry import _save_manifest
+
+    _save_manifest(cache, man)
+    stats = aot.cache_stats(cache)
+    assert stats["entries"] == 3
+    assert stats["never_hit"] == ["bdf-b2-aaa"]
+    assert stats["total_cache_bytes"] > 0
+    # pin the LRU entry: eviction must skip it and take the next-oldest
+    assert aot.pin_keys(cache, ["bdf-b2-aaa"]) == ["bdf-b2-aaa"]
+    rec = Recorder()
+    evicted = aot.enforce_capacity(cache, 2, recorder=rec)
+    assert evicted == ["bdf-b4-bbb"]
+    assert rec.counters.get("aot_evictions") == 1
+    left = set(aot.load_manifest(cache)["entries"])
+    assert left == {"bdf-b2-aaa", "bdf-b8-ccc"}
+    # touch moves the clock: the touched entry now survives a cap of 1
+    aot.touch_keys(cache, ["bdf-b2-aaa"])
+    aot.pin_keys(cache, ["bdf-b2-aaa"], pinned=False)
+    assert aot.enforce_capacity(cache, 1) == ["bdf-b8-ccc"]
+
+
+def test_merge_manifests_crash_atomic_fold(tmp_path):
+    from batchreactor_tpu import aot
+    from batchreactor_tpu.aot.registry import _save_manifest
+
+    cache = str(tmp_path)
+    for tag, hits in (("w0", 2), ("w1", 3)):
+        part = aot.load_manifest(cache, tag)
+        part["entries"]["bdf-b4-xyz"] = {
+            "bucket": 4, "warmups": 1, "compiles": 1, "compile_s": 2.0,
+            "cache_hits": hits, "cache_misses": 0}
+        part["jax"] = "test-jax"
+        _save_manifest(cache, part, tag)
+    man = aot.merge_manifests(cache, ["w0", "w1"])
+    e = man["entries"]["bdf-b4-xyz"]
+    assert e["warmups"] == 2 and e["cache_hits"] == 5
+    assert e["compile_s"] == 4.0
+    # parts pruned; merged manifest persisted
+    import os
+
+    assert not os.path.exists(aot.manifest_path(cache, "w0"))
+    assert aot.load_manifest(cache)["entries"]["bdf-b4-xyz"][
+        "warmups"] == 2

@@ -735,3 +735,147 @@ class TestServeDaemonSubprocess:
         assert r.returncode == 1, r.stdout + r.stderr
         assert "MISSING" in r.stdout
         assert "fingerprint" in r.stdout
+
+
+# --------------------------------------------------------------------------
+# mixed-mechanism serving: one daemon, many mechanisms, one executable
+# (SessionStore — docs/serving.md "Multi-mechanism serving")
+# --------------------------------------------------------------------------
+_FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+
+def _mechshape_spec(**solver_over):
+    solver = {"segment_steps": 16, "stats": True, "mech_operands": True}
+    solver.update(solver_over)
+    return {"mechanism": {"mech": f"{_FIXTURES}/h2o2.dat",
+                          "therm": f"{_FIXTURES}/therm.dat"},
+            "solver": solver,
+            "serve": {"resident": 8, "refill": 1, "buckets": [8],
+                      "poll_every": 1, "max_queue_lanes": 64,
+                      "idle_timeout_s": 0.3, "coalesce_s": 2.0,
+                      "max_mechanisms": 4}}
+
+
+class TestMixedMechanismServing:
+    def test_upload_schema_validation(self):
+        from batchreactor_tpu.serving.schema import validate_upload
+
+        ok = validate_upload({"id": "m1", "mech": "SPECIES\nH2\nEND",
+                              "therm": "THERMO\nEND"})
+        assert ok["warm"] is True
+        with pytest.raises(ValueError, match="unknown upload key"):
+            validate_upload({"id": "m1", "mech": "x", "therm": "y",
+                             "path": "/etc/passwd"})
+        with pytest.raises(ValueError, match="non-empty string 'id'"):
+            validate_upload({"mech": "x", "therm": "y"})
+        with pytest.raises(ValueError, match="inline file text"):
+            validate_upload({"id": "m1", "mech": "  ", "therm": "y"})
+        with pytest.raises(ValueError, match="warm must be a boolean"):
+            validate_upload({"id": "m1", "mech": "x", "therm": "y",
+                             "warm": "yes"})
+
+    def test_mixed_mechanisms_one_daemon(self):
+        """THE acceptance test: h2o2 + the vendored 12-species variant
+        padded into one (S, R) bucket, served concurrently by one
+        daemon — per-mechanism results BIT-EXACT vs the same
+        mechanism's dedicated (padded-program) direct sweep, the
+        scrambled multi-lane harvest un-shuffled exactly per mechanism,
+        and ZERO armed-label compiles on the uploaded mechanism after
+        warmup (the `sweep-segment compiles: 1 -> 0` evidence)."""
+        import batchreactor_tpu as br
+        from batchreactor_tpu.serving.client import SolveClient
+        from batchreactor_tpu.serving.scheduler import Scheduler
+        from batchreactor_tpu.serving.server import ServingServer
+        from batchreactor_tpu.serving.session import (SessionStore,
+                                                      SolverSession)
+
+        session = SolverSession.from_spec(_mechshape_spec())
+        session.warmup()
+        comp_b = {"H2": 0.3, "O2": 0.15, "N2": 0.5, "AR": 0.05}
+        # scrambled per-lane temperatures: the un-shuffle target
+        Ts_a = [1480.0, 1170.0, 1390.0, 1255.0]
+        Ts_b = [1420.0, 1205.0, 1333.0]
+        with session:
+            sched = Scheduler(session)
+            store = SessionStore(session, sched)
+            with ServingServer(session, sched, store=store) as srv:
+                client = SolveClient(srv.url)
+                up = client.upload_mechanism(
+                    "h2o2n", open(f"{_FIXTURES}/h2o2_n.dat").read(),
+                    open(f"{_FIXTURES}/therm.dat").read())
+                assert tuple(up["mech_shape"]) == (16, 32)
+                # warmed through the SHARED rung: zero armed compiles
+                assert sum((up["program_compiles"] or {}).values()) == 0
+                # both mechanisms' requests in flight concurrently
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(4) as pool:
+                    fa = pool.submit(client.solve, {
+                        "id": "mix-a", "T": Ts_a, "X": _COMP,
+                        "t1": 5e-5})
+                    fb = pool.submit(client.solve, {
+                        "id": "mix-b", "T": Ts_b, "X": comp_b,
+                        "t1": 5e-5, "mech": "h2o2n"})
+                    ra, rb = fa.result(120), fb.result(120)
+            census = {m["ids"][0]: m for m in store.mechanisms()}
+        assert ra["provenance"] == ["success"] * len(Ts_a)
+        assert rb["provenance"] == ["success"] * len(Ts_b)
+        assert "NO" in rb["x"] and "NO" not in ra["x"]
+        # dedicated direct sweeps under the SAME padded program config:
+        # bit-exact, lane order preserved through the scrambled harvest
+        kw = dict(chem=br.Chemistry(gaschem=True), segment_steps=16,
+                  admission=8, refill=1, buckets=(8,), poll_every=1,
+                  mech_operands=True)
+        da = br.batch_reactor_sweep(
+            _COMP, np.asarray(Ts_a), 1e5, 5e-5,
+            thermo_obj=session.thermo, md=session.gm, **kw)
+        for sp in session.species:
+            np.testing.assert_array_equal(
+                ra["x"][sp], np.asarray(da["x"][sp]), err_msg=sp)
+        np.testing.assert_array_equal(ra["t"], np.asarray(da["t"]))
+        gm2 = br.compile_gaschemistry(f"{_FIXTURES}/h2o2_n.dat")
+        th2 = br.create_thermo(list(gm2.species),
+                               f"{_FIXTURES}/therm.dat")
+        db = br.batch_reactor_sweep(
+            comp_b, np.asarray(Ts_b), 1e5, 5e-5, thermo_obj=th2,
+            md=gm2, **kw)
+        for sp in gm2.species:
+            np.testing.assert_array_equal(
+                rb["x"][sp], np.asarray(db["x"][sp]), err_msg=sp)
+        # per-mechanism armed compiles after serving: all zero
+        assert census["default"]["program_compiles"] == 0, census
+        assert census["h2o2n"]["program_compiles"] == 0, census
+
+    def test_store_routing_and_lru_eviction(self):
+        from batchreactor_tpu.serving.scheduler import Scheduler
+        from batchreactor_tpu.serving.session import (SessionStore,
+                                                      SolverSession,
+                                                      UnknownMechanism)
+
+        spec = _mechshape_spec()
+        spec["serve"]["max_mechanisms"] = 2
+        session = SolverSession.from_spec(spec)
+        session.warmup()
+        with session:
+            store = SessionStore(session, Scheduler(session))
+            fp1 = store.add_mechanism(f"{_FIXTURES}/h2o2_n.dat",
+                                      f"{_FIXTURES}/therm.dat",
+                                      mech_id="m1")
+            # routing: by id, by fingerprint prefix, default, unknown
+            assert store.resolve("m1")[0].fingerprint == fp1
+            assert store.resolve(fp1[:10])[0].fingerprint == fp1
+            assert (store.resolve(None)[0].fingerprint
+                    == session.fingerprint)
+            with pytest.raises(UnknownMechanism):
+                store.resolve("nope")
+            # capacity 2: a third mechanism LRU-evicts m1 (default is
+            # pinned), and requests for m1 then answer unknown
+            store.add_mechanism(f"{_FIXTURES}/grimech.dat",
+                                f"{_FIXTURES}/therm.dat", mech_id="m2",
+                                warm=False)
+            ids = {m["ids"][0] for m in store.mechanisms()}
+            assert ids == {"default", "m2"}
+            with pytest.raises(UnknownMechanism, match="no longer|unknown"):
+                store.resolve("m1")
+            assert session.recorder.counters.get("mech_evicted") == 1
+            store.drain(5.0)
