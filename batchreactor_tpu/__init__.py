@@ -54,6 +54,7 @@ from .api import (  # noqa: E402
 from .io.config import InputData, input_data  # noqa: E402
 from . import sensitivity  # noqa: E402
 from . import obs  # noqa: E402
+from . import energy  # noqa: E402
 
 __all__ = [
     "ThermoTable",
@@ -75,6 +76,7 @@ __all__ = [
     "pad_thermo",
     "sensitivity",
     "obs",
+    "energy",
 ]
 
 __version__ = "0.1.0"

@@ -202,6 +202,7 @@ _REGISTRY = {}
 OWNER_MODULES = (
     "ops.rhs",
     "models.padding",
+    "energy.eqns",
     "solver.bdf",
     "solver.sdirk",
     "solver.linalg_pallas",
@@ -541,7 +542,8 @@ def run_contracts(fixtures_dir=None, select=None, registry_audits=True):
 # --------------------------------------------------------------------------
 #: on-values used to toggle each schema knob when behaviorally checking
 #: that it moves the resume fingerprint
-_SCHEMA_KNOB_VALUES = {"stats": True, "timeline": 8}
+_SCHEMA_KNOB_VALUES = {"stats": True, "timeline": 8,
+                       "energy": "adiabatic_v"}
 
 
 def fingerprint_registry_findings():

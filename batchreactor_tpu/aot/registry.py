@@ -392,12 +392,17 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None,
             # the bucket
             n_lanes = max(bucket, int(round(bucket * backlog)))
             y0s = jnp.broadcast_to(y0, (n_lanes,) + y0.shape)
-            cfgs = {
-                k: jnp.broadcast_to(
-                    jnp.asarray(v, dtype=jnp.float64
-                                if jnp.asarray(v).dtype.kind == "f"
-                                else None), (n_lanes,))
-                for k, v in cfg.items()}
+
+            def _lane_bcast(v):
+                # scalar rows broadcast to (n_lanes,); vector-valued
+                # exemplar rows (the energy T-row atol weight is (n,))
+                # keep their trailing shape per lane
+                av = jnp.asarray(v, dtype=jnp.float64
+                                 if jnp.asarray(v).dtype.kind == "f"
+                                 else None)
+                return jnp.broadcast_to(av, (n_lanes,) + av.shape)
+
+            cfgs = {k: _lane_bcast(v) for k, v in cfg.items()}
             watch = CompileWatch(default_label=key)
             t0 = time.perf_counter()
             # zero-span execution (t1 == t0): one step attempt per lane,

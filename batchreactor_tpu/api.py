@@ -208,15 +208,23 @@ def _make_jac(mode, gm, sm, thermo, kc_compat, asv_quirk):
 
 
 @functools.lru_cache(maxsize=64)
-def _segmented_builder(mode, udf, kc_compat, asv_quirk):
+def _segmented_builder(mode, udf, kc_compat, asv_quirk, energy=None):
     """Builder for the segmented sweep's bundle mode: mechanism tensors
     enter the compiled program as traced operands (exactly like the
     monolithic :func:`_solve`), so repeated file-driven runs with freshly
     parsed same-shaped mechanisms reuse one executable.  The lru key is the
-    static chemistry config, not object ids — bounded and leak-free."""
+    static chemistry config, not object ids — bounded and leak-free.
+    ``energy`` (gas mode only; ``energy/eqns.py`` modes) builds the
+    non-isothermal RHS/Jacobian over the ``[rho_k, T]`` state instead —
+    a distinct static config, hence a distinct cache row."""
 
     def build(bundle):
         gm, sm, thermo = bundle
+        if energy is not None:
+            from .energy.eqns import make_energy_jac, make_energy_rhs
+
+            return (make_energy_rhs(gm, thermo, energy, kc_compat),
+                    make_energy_jac(gm, thermo, energy, kc_compat))
         rhs = _make_rhs(mode, udf, gm, sm, thermo, kc_compat, asv_quirk)
         jacf = _make_jac(mode, gm, sm, thermo, kc_compat, asv_quirk)
         return rhs, jacf
@@ -740,8 +748,15 @@ def _programmatic_run(inlet_comp, T, p, time, *, Asv, chem, thermo_obj, md,
 # ensemble compilation caches key on callable *identity* (parallel/sweep.py),
 # so rebuilding closures per call would recompile the sweep every time.
 # Keyed on object ids with strong refs held in the values (ids stay valid
-# while cached); bounded FIFO eviction.
+# while cached); bounded FIFO eviction.  Reached from concurrent HTTP
+# upload threads like _PADDED_MECHS below (serving SessionStore.
+# add_upload -> SolverSession.__init__ builds its callables here), so
+# mutation holds a lock — an unlocked check-then-pop would let two
+# uploads pop one FIFO key and KeyError, and a lost insert race would
+# hand two sessions different closure identities for one mechanism
+# (a silent recompile).
 _SWEEP_FNS = {}
+_SWEEP_FNS_LOCK = threading.Lock()
 
 # padded (mechanism, thermo) pairs per (source ids, bucket shape): the
 # padded bundles must be IDENTITY-stable across calls for the same reason
@@ -778,34 +793,74 @@ def _padded_mech(gm, thermo_obj, s_pad, r_pad, canonical):
 
 
 def _sweep_fns(mode, udf, gm, sm, thermo_obj, kc_compat, asv_quirk,
-               marker_idx, ignition_mode, jac_mode="analytic"):
+               marker_idx, ignition_mode, jac_mode="analytic",
+               energy=None):
     from .parallel import ignition_observer
 
     key = (mode, id(udf), id(gm), id(sm), id(thermo_obj), kc_compat,
-           asv_quirk, marker_idx, ignition_mode, jac_mode)
-    hit = _SWEEP_FNS.get(key)
-    if (hit is not None and hit[0] is gm and hit[1] is sm
-            and hit[2] is thermo_obj and hit[3] is udf):
-        return hit[4:]
-    rhs = _make_rhs(mode, udf, gm, sm, thermo_obj, kc_compat, asv_quirk)
+           asv_quirk, marker_idx, ignition_mode, jac_mode, energy)
+    with _SWEEP_FNS_LOCK:
+        hit = _SWEEP_FNS.get(key)
+        if (hit is not None and hit[0] is gm and hit[1] is sm
+                and hit[2] is thermo_obj and hit[3] is udf):
+            return hit[4:]
+    if energy is not None:
+        # non-isothermal gas chemistry (energy/eqns.py): the state grows
+        # the trailing T row, the ignition-delay detector folds in-loop
+        # (energy/ignition.py — out["ignition_delay"], no sens= needed),
+        # and an ignition_marker's species detector merges alongside
+        from .energy.eqns import make_energy_jac, make_energy_rhs
+        from .energy.ignition import (energy_ignition_observer,
+                                      merge_observers)
+
+        rhs = make_energy_rhs(gm, thermo_obj, energy, kc_compat)
+
+        def mk_jac():
+            return make_energy_jac(gm, thermo_obj, energy, kc_compat)
+
+        observer, obs0 = energy_ignition_observer(
+            len(thermo_obj.species))
+        if marker_idx is not None:
+            sp_obs, sp_init = ignition_observer(marker_idx,
+                                                mode=ignition_mode)
+            observer, obs0 = merge_observers(observer, obs0, sp_obs,
+                                             sp_init)
+    else:
+        rhs = _make_rhs(mode, udf, gm, sm, thermo_obj, kc_compat,
+                        asv_quirk)
+
+        def mk_jac():
+            return _make_jac(mode, gm, sm, thermo_obj, kc_compat,
+                             asv_quirk)
+
+        observer = obs0 = None
+        if marker_idx is not None:
+            observer, obs0 = ignition_observer(marker_idx,
+                                               mode=ignition_mode)
+    # ONE jac-mode dispatch for both physics families (a divergent copy
+    # per branch would let a future mode silently treat them differently)
     if jac_mode == "fwd":
         jac = None  # solver falls back to jax.jacfwd
     else:
-        jac = _make_jac(mode, gm, sm, thermo_obj, kc_compat, asv_quirk)
+        jac = mk_jac()
         if jac_mode == "remat" and jac is not None:
-            # rematerialized closed-form Jacobian: numerically identical,
-            # but the checkpoint barrier restructures what XLA sees — the
-            # third arrow (after analytic/fwd) against the coupled-mode
-            # TPU compile wall (PERF.md).  Wrapped HERE so the callable is
-            # cached: a per-call jax.checkpoint closure would defeat the
-            # compilation cache (identity-keyed, parallel/sweep.py)
+            # rematerialized closed-form Jacobian: numerically
+            # identical, but the checkpoint barrier restructures what
+            # XLA sees — the third arrow (after analytic/fwd) against
+            # the coupled-mode TPU compile wall (PERF.md).  Wrapped
+            # HERE so the callable is cached: a per-call
+            # jax.checkpoint closure would defeat the compilation
+            # cache (identity-keyed, parallel/sweep.py)
             jac = jax.checkpoint(jac)
-    observer = obs0 = None
-    if marker_idx is not None:
-        observer, obs0 = ignition_observer(marker_idx, mode=ignition_mode)
-    if len(_SWEEP_FNS) >= 64:
-        _SWEEP_FNS.pop(next(iter(_SWEEP_FNS)))
-    _SWEEP_FNS[key] = (gm, sm, thermo_obj, udf, rhs, jac, observer, obs0)
+    with _SWEEP_FNS_LOCK:
+        hit = _SWEEP_FNS.get(key)
+        if (hit is not None and hit[0] is gm and hit[1] is sm
+                and hit[2] is thermo_obj and hit[3] is udf):
+            return hit[4:]  # concurrent builder won: keep ONE identity
+        if len(_SWEEP_FNS) >= 64:
+            _SWEEP_FNS.pop(next(iter(_SWEEP_FNS)))
+        _SWEEP_FNS[key] = (gm, sm, thermo_obj, udf, rhs, jac, observer,
+                           obs0)
     return rhs, jac, observer, obs0
 
 
@@ -814,7 +869,8 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                         rtol=1e-6, atol=1e-10,
                         max_steps=200_000, segment_steps=0, kc_compat=False,
                         asv_quirk=True, ignition_marker=None,
-                        ignition_mode="half", method="bdf", jac_window=None,
+                        ignition_mode="half", energy=None, atol_T=None,
+                        method="bdf", jac_window=None,
                         linsolve="auto", setup_economy=False, stale_tol=0.3,
                         analytic_jac=True, telemetry=False, pipeline=None,
                         poll_every=None, buckets=None, fetch_deadline=None,
@@ -860,6 +916,25 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     closed form but wraps it in ``jax.checkpoint`` (numerically identical,
     different XLA program structure).  Both are measurement/escape knobs
     for the coupled analytic-J TPU-backend compile-time wall (PERF.md).
+
+    ``energy`` (gas chemistry only; docs/energy.md) selects the
+    non-isothermal reactor family: ``None`` (default) is the isothermal
+    reference physics — every traced program byte-identical to the knob
+    not existing (tier-C ``energy-noop-fork``) — while
+    ``"adiabatic_v"`` (constant volume) / ``"adiabatic_p"`` (constant
+    pressure) grow the state a trailing temperature row ``[rho_k, T]``
+    and close dT/dt from the species rates via on-device NASA-7 thermo
+    (``energy/eqns.py``; the analytic Jacobian gains the dense dwdot/dT
+    column and the dT/dt row).  Energy runs return two extra per-lane
+    arrays: ``out["T"]`` (final temperatures) and
+    ``out["ignition_delay"]`` (the max-dT/dt detector of
+    ``energy/ignition.py``, folded in-loop — NaN where the lane never
+    ignited; no ``sens=`` required), and the T row carries its own
+    error-norm absolute tolerance ``atol_T`` (default
+    ``energy.DEFAULT_ATOL_T`` = 1e-4 K) through the reserved
+    ``_atol_scale`` operand.  ``ignition_marker`` still works and adds
+    the species-proxy ``out["tau"]`` alongside.  Incompatible with
+    quarantine ``oracle=True`` (the native BDF runtime is isothermal).
 
     ``linsolve`` picks the Newton linear-solver mode (table:
     docs/api.md "Newton linear algebra"; semantics: solver/linalg.py
@@ -1057,6 +1132,21 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     from .resilience.policy import normalize_quarantine
 
     qpol = normalize_quarantine(quarantine)
+    # energy-mode grammar up front (energy/eqns.py is the one validation
+    # point), before any mechanism parsing happens
+    from .energy.eqns import resolve_energy
+
+    energy = resolve_energy(energy)
+    if energy is None and atol_T is not None:
+        raise ValueError(
+            "atol_T weights the temperature row of a non-isothermal "
+            "solve; pass energy= ('adiabatic_v'/'adiabatic_p') or drop "
+            "the argument")
+    if energy is not None and qpol is not None and qpol.oracle:
+        raise ValueError(
+            "quarantine oracle=True cross-checks against the native CPU "
+            "BDF runtime, which is isothermal-only; drop the oracle rung "
+            "or the energy knob")
     # canonicalize the bucket ladder up front (loud ValueError on a bad
     # spec — aot/buckets.py is the one validation point), before any
     # mechanism parsing happens
@@ -1149,6 +1239,11 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     else:
         raise ValueError("batch_reactor_sweep needs surfchem, gaschem, "
                          "and/or userchem")
+    if energy is not None and mode != "gas":
+        raise ValueError(
+            f"energy={energy!r} supports gas chemistry only (the "
+            f"surface/coupled/udf state layouts have no temperature-row "
+            f"contract yet); drop the knob for mode {mode!r}")
     species = thermo_obj.species
 
     # mechanism-shape padding (models/padding.py): the kernel-side
@@ -1199,6 +1294,18 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         y0s = pad_states(y0s, mech_shape[0])
         cfgs[NLIVE_KEY] = jnp.full((B,), float(len(species)),
                                    dtype=jnp.float64)
+    if energy is not None:
+        # non-isothermal state extension (energy/eqns.py): the trailing
+        # T row goes on AFTER species padding (so it sits at S_pad), the
+        # T-row atol weight rides the reserved _atol_scale operand, and
+        # a padded run's live count bumps by one (the T row is live).
+        # energy=None skips this block entirely — the isothermal path
+        # never even copies cfgs (tier-C energy-noop-fork).
+        from .energy.eqns import energy_cfg, extend_states
+
+        y0s = extend_states(y0s, T)
+        cfgs = energy_cfg(cfgs, energy, B, int(y0s.shape[1]), atol,
+                          atol_T)
     marker_idx = None
     if ignition_marker is not None:
         key = ignition_marker.upper()
@@ -1223,7 +1330,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
     rhs, jac, observer, obs0 = _sweep_fns(mode, chem.udf, gm_kernel, sm,
                                           th_kernel, kc_compat, asv_quirk,
                                           marker_idx, ignition_mode,
-                                          jac_mode)
+                                          jac_mode, energy)
     mech_bundle = None
     if mech_operands:
         # mechanism-as-operand: the SAME cached builder the file-driven
@@ -1233,7 +1340,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         # The closure rhs/jac above are discarded; observer/obs0 (an
         # index-closing fold, mechanism-tensor-free) ride along.
         mech_bundle = (gm_kernel, None, th_kernel)
-        rhs = _segmented_builder(mode, None, kc_compat, asv_quirk)
+        rhs = _segmented_builder(mode, None, kc_compat, asv_quirk, energy)
         jac = None
 
     if mesh is not None:
@@ -1406,6 +1513,14 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
         out["report"]["quarantine"] = _quarantine.provenance_counts(prov)
     if chem.surfchem:
         out["covg"] = np.asarray(res.y)[:, ng:]
+    if energy is not None:
+        # the physical ignition surface (energy/ignition.py): final
+        # per-lane temperatures + the max-dT/dt delay, NaN where the
+        # lane never ignited — no sens= required
+        from .energy.ignition import extract_delay
+
+        out["T"] = np.asarray(res.y)[:, -1]
+        out["ignition_delay"] = extract_delay(res.observed)
     if ignition_marker is not None:
         out["tau"] = np.asarray(res.observed["tau"])
     if telemetry:
@@ -1417,6 +1532,7 @@ def batch_reactor_sweep(inlet_comp, T, p, time, *, chem=None, thermo_obj=None,
                   "admission": admission not in (None, False),
                   "mech_shape": mech_shape,
                   "mech_operands": bool(mech_operands),
+                  "energy": energy,
                   "timeline": timeline, "live_port": bound_port})
     return out
 

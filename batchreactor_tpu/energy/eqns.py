@@ -1,0 +1,349 @@
+"""Non-isothermal reactor equations: the energy ODE over ``[rho_k, T]``.
+
+The reference (and the isothermal reproduction, ``ops/rhs.py``) freezes
+T as a per-lane parameter, so every sweep is chemistry at a pinned
+temperature and "ignition delay" is a species-marker proxy.  This module
+closes the loop: the state vector grows a trailing temperature row
+``y = [rho*Y_1 .. rho*Y_S, T]`` and the energy RHS closes dT/dt from the
+species production rates via on-device NASA-7 thermodynamics
+(``ops/thermo.cp_h_s_over_R`` — already parsed into the frozen bundles),
+turning the ensemble sweep into *physical* ignition: thermal runaway,
+real ignition-delay tables, flammability-limit maps (docs/energy.md).
+
+Modes (``resolve_energy`` is THE validation rule, shared by ``api.py``,
+``parallel/checkpoint.py`` and ``serving/schema.py``):
+
+* ``None`` — isothermal (the default; every traced program is
+  byte-identical to the knob not existing — tier-C ``energy-noop-fork``);
+* ``"adiabatic_v"`` — adiabatic constant-volume:
+
+    d(rho_k)/dt = wdot_k M_k
+    dT/dt       = -sum_k u_k wdot_k / sum_k c_k Cv_k
+
+  with molar internal energies ``u_k = h_k - R T`` and ``Cv_k = Cp_k -
+  R`` (the classic constant-U,V reactor; Cantera's IdealGasReactor);
+* ``"adiabatic_p"`` — adiabatic constant-pressure: the partial
+  densities pick up the thermal-expansion dilution of the constant-p
+  ideal-gas closure ``rho = p Wbar / (R T)``,
+
+    d(rho_k)/dt = wdot_k M_k - rho_k (sum_j wdot_j / Ctot + (dT/dt)/T)
+    dT/dt       = -sum_k h_k wdot_k / sum_k c_k Cp_k
+
+  (``Ctot = sum_j c_j``; the dilution keeps ``sum_k c_k = p/(RT)``
+  invariant along the trajectory, the same algebraic-closure discipline
+  as the isothermal pressure round-trip).
+
+The analytic Jacobian (``make_energy_jac``) keeps the solvers' closed-
+form economics: the species block reuses ``ops/gas_kinetics.
+production_rates_and_jac`` unchanged, the dense ``dwdot/dT`` column is
+ONE scalar jvp through the forward rate kernel (exact to roundoff — the
+kernel's clamps were built for tangents; re-deriving d ln k/dT by hand
+would just duplicate it), and the dT/dt row closes by the chain rule
+over the mixture-heat-capacity sums, with the NASA-7 T-derivatives
+(dCp/dT, dh/dT) also one scalar jvp.  Matches ``jax.jacfwd`` of the RHS
+to roundoff (tests/test_energy.py), at ~2 extra RHS-cost over the
+isothermal Jacobian.
+
+Error-norm convention: the T row lives on a ~1000 K scale while the
+species rows sit at ~1e-1 kg/m^3, so one scalar ``atol`` cannot serve
+both.  The reserved per-lane cfg operand ``_atol_scale``
+(:data:`~..solver.sdirk.ATOL_SCALE_KEY`) carries a per-component
+multiplier on ``atol`` — :func:`energy_atol_scale` builds ones over the
+species rows and ``atol_T / atol`` (default :data:`DEFAULT_ATOL_T` =
+1e-4 K) on the T row.  Like ``_nlive`` it is a traced operand read with
+``cfg.get`` at trace time: absent, the solvers trace the pre-energy
+program byte-for-byte.
+
+Mechanism-shape padding (models/padding.py): dead species are provably
+inert in the energy sums — padded thermo rows carry ``cp_k = R`` (so
+``Cv_k = 0``) and ``h_k = R T`` (so ``u_k = 0``), dead concentrations
+and production rates are exactly ``0.0``, so every mixture sum equals
+the live sum bit-for-bit and (``adiabatic_v``) the Jacobian's dead rows
+AND columns stay exactly zero — the identity-Newton-block argument that
+keeps padded step counts identical to the dedicated-shape program's.
+(``adiabatic_p`` dead columns carry the harmless ``dCtot/dc`` coupling:
+value-inert, factorization-ulp class.)
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..solver.sdirk import ATOL_SCALE_KEY, NLIVE_KEY  # noqa: F401
+from ..utils.constants import R
+
+#: accepted non-None mode literals, in documentation order
+ENERGY_MODES = ("adiabatic_v", "adiabatic_p")
+
+#: default absolute tolerance on the temperature row [K] — CVODE-style
+#: chemistry setups run T at atol 1e-2..1e-6 K; 1e-4 keeps the T row's
+#: error weight commensurate with rtol*T (~1e-3 K at 1000 K, rtol 1e-6)
+#: without letting a near-zero-slope induction phase stall the controller
+DEFAULT_ATOL_T = 1e-4
+
+
+def resolve_energy(energy):
+    """THE validation rule for the ``energy=`` knob (module doc), shared
+    by every entry point so the accepted grammar cannot drift:
+    ``None``/``False`` -> ``None`` (isothermal), the mode literals pass
+    through, anything else is a loud error naming the accepted values."""
+    if energy is None or energy is False:
+        return None
+    if energy in ENERGY_MODES:
+        return energy
+    raise ValueError(
+        f"unknown energy mode {energy!r}; accepted: None (isothermal), "
+        f"'adiabatic_v' (adiabatic constant-volume), 'adiabatic_p' "
+        f"(adiabatic constant-pressure)")
+
+
+def _mix_thermo(T, thermo):
+    """(Cp (S,) [J/mol/K], h (S,) [J/mol]) at scalar T — the NASA-7
+    evaluation both the RHS and (through one scalar jvp) the Jacobian's
+    dCp/dT / dh/dT terms share, so forward and derivative cannot drift."""
+    from ..ops.thermo import cp_h_s_over_R
+
+    cp_R, h_RT, _ = cp_h_s_over_R(T, thermo)
+    return cp_R * R, h_RT * (R * T)
+
+
+def make_energy_rhs(gm, thermo, mode, kc_compat=False):
+    """Pure RHS for non-isothermal gas chemistry over ``y = [rho_k, T]``
+    (module doc equations).  ``mode=None`` returns the isothermal gas
+    RHS unchanged — the dispatch is a traced no-op (tier-C
+    ``energy-noop-fork``)."""
+    mode = resolve_energy(mode)
+    if mode is None:
+        from ..ops.rhs import make_gas_rhs
+
+        return make_gas_rhs(gm, thermo, kc_compat=kc_compat)
+    from ..ops.gas_kinetics import production_rates
+
+    molwt = thermo.molwt
+
+    def rhs(t, y, cfg):
+        rho_y, T = y[:-1], y[-1]
+        conc = rho_y / molwt
+        wdot = production_rates(T, conc, gm, thermo, kc_compat)
+        cp, h = _mix_thermo(T, thermo)
+        if mode == "adiabatic_v":
+            u = h - R * T
+            cv = cp - R
+            Tdot = -(u @ wdot) / (conc @ cv)
+            dy = wdot * molwt
+        else:  # adiabatic_p
+            Tdot = -(h @ wdot) / (conc @ cp)
+            # constant-p dilution: keeps Ctot = p/(RT) invariant (module
+            # doc); Ctot > 0 always (a lane starts with positive density
+            # and the dilution preserves it)
+            Ctot = jnp.sum(conc)
+            dil = jnp.sum(wdot) / Ctot + Tdot / T
+            dy = wdot * molwt - rho_y * dil
+        return jnp.concatenate([dy, jnp.reshape(Tdot, (1,))])
+
+    return rhs
+
+
+def make_energy_jac(gm, thermo, mode, kc_compat=False):
+    """Analytic Jacobian companion to :func:`make_energy_rhs`:
+    ``jac(t, y, cfg) -> (S+1, S+1)`` over ``y = [rho_k, T]``.  The
+    species block is the isothermal closed form; the dense T column is
+    one scalar jvp of the rate kernel; the dT/dt row is the chain rule
+    over the mixture sums (module doc).  ``mode=None`` returns the
+    isothermal gas Jacobian unchanged."""
+    mode = resolve_energy(mode)
+    if mode is None:
+        from ..ops.rhs import make_gas_jac
+
+        return make_gas_jac(gm, thermo, kc_compat=kc_compat)
+    from ..ops.gas_kinetics import production_rates, production_rates_and_jac
+
+    molwt = thermo.molwt
+
+    def jac(t, y, cfg):
+        rho_y, T = y[:-1], y[-1]
+        conc = rho_y / molwt
+        wdot, dwdot = production_rates_and_jac(T, conc, gm, thermo,
+                                               kc_compat)
+        # the dense dwdot/dT column: one scalar jvp through the forward
+        # kernel — exact (the clamps were designed for tangents), about
+        # one RHS-evaluation of work
+        one = jnp.ones_like(T)
+        _, dwdot_dT = jax.jvp(
+            lambda Tv: production_rates(Tv, conc, gm, thermo, kc_compat),
+            (T,), (one,))
+        (cp, h), (dcp, dh) = jax.jvp(
+            lambda Tv: _mix_thermo(Tv, thermo), (T,), (one,))
+        inv_w = 1.0 / molwt
+        if mode == "adiabatic_v":
+            u = h - R * T
+            du = dh - R          # == Cv_k, evaluated through the SAME jvp
+            cv = cp - R
+            ccv = conc @ cv
+            Tdot = -(u @ wdot) / ccv
+            J_ss = dwdot * (molwt[:, None] * inv_w[None, :])
+            J_sT = dwdot_dT * molwt
+            # dTdot/dc_b = -(u . dwdot[:, b])/ccv - Tdot Cv_b/ccv
+            dTdot_dc = -(u @ dwdot) / ccv - Tdot * cv / ccv
+            J_Ts = dTdot_dc * inv_w
+            J_TT = ((-(du @ wdot) - (u @ dwdot_dT)) / ccv
+                    - Tdot * (conc @ dcp) / ccv)
+        else:  # adiabatic_p
+            ccp = conc @ cp
+            Tdot = -(h @ wdot) / ccp
+            dTdot_dc = -(h @ dwdot) / ccp - Tdot * cp / ccp
+            dTdot_dT = ((-(dh @ wdot) - (h @ dwdot_dT)) / ccp
+                        - Tdot * (conc @ dcp) / ccp)
+            Ctot = jnp.sum(conc)
+            W = jnp.sum(wdot)
+            dil = W / Ctot + Tdot / T
+            colsum = jnp.sum(dwdot, axis=0)          # dW/dc_b
+            ddil_dc = (colsum / Ctot - W / (Ctot * Ctot)
+                       + dTdot_dc / T)
+            ddil_dT = (jnp.sum(dwdot_dT) / Ctot + dTdot_dT / T
+                       - Tdot / (T * T))
+            S = molwt.shape[0]
+            J_ss = (dwdot * (molwt[:, None] * inv_w[None, :])
+                    - dil * jnp.eye(S, dtype=y.dtype)
+                    - rho_y[:, None] * (ddil_dc * inv_w)[None, :])
+            J_sT = dwdot_dT * molwt - rho_y * ddil_dT
+            J_Ts = dTdot_dc * inv_w
+            J_TT = dTdot_dT
+        top = jnp.concatenate([J_ss, J_sT[:, None]], axis=1)
+        bot = jnp.concatenate(
+            [J_Ts, jnp.reshape(J_TT, (1,))])[None, :]
+        return jnp.concatenate([top, bot], axis=0)
+
+    return jac
+
+
+# --------------------------------------------------------------------------
+# state / cfg extension helpers (the api.py wiring surface)
+# --------------------------------------------------------------------------
+def extend_states(y0s, T):
+    """``(B, S) -> (B, S+1)``: append the per-lane initial temperature
+    as the trailing state row (module doc layout).  For energy-mode
+    sweeps this runs AFTER species padding (``models/padding.
+    pad_states``), so the T row always sits at index ``S_pad``."""
+    y0s = jnp.asarray(y0s)
+    T = jnp.broadcast_to(jnp.asarray(T, dtype=y0s.dtype),
+                         (y0s.shape[0],))
+    return jnp.concatenate([y0s, T[:, None]], axis=1)
+
+
+def energy_atol_scale(n_lanes, n, atol, atol_T=None):
+    """The per-lane ``(B, n)`` :data:`~..solver.sdirk.ATOL_SCALE_KEY`
+    operand for an energy-extended state: ones over the species rows,
+    ``atol_T / atol`` on the trailing T row, so the solvers' scaled
+    norms weight the temperature error at ``atol_T`` Kelvin (module doc
+    norm convention).  ``atol_T=None`` -> :data:`DEFAULT_ATOL_T`."""
+    atol_T = DEFAULT_ATOL_T if atol_T is None else float(atol_T)
+    if atol_T <= 0:
+        raise ValueError(f"atol_T must be positive Kelvin, got {atol_T}")
+    row = jnp.ones((int(n),), dtype=jnp.float64)
+    row = row.at[-1].set(atol_T / float(atol))
+    return jnp.broadcast_to(row, (int(n_lanes), int(n)))
+
+
+def energy_cfg(cfgs, energy, n_lanes, n, atol, atol_T=None):
+    """A copy of the per-lane ``cfgs`` dict extended for an energy-mode
+    sweep: the T-row atol-scale operand, and the live-count operand
+    bumped by one when mechanism padding set it (the T row is live).
+    ``energy=None`` returns ``cfgs`` UNCHANGED (same object): the
+    isothermal path must not even copy the dict — the traced program
+    stays byte-identical to the knob not existing (tier-C
+    ``energy-noop-fork``)."""
+    if resolve_energy(energy) is None:
+        return cfgs
+    out = dict(cfgs)
+    if NLIVE_KEY in out:
+        out[NLIVE_KEY] = jnp.asarray(out[NLIVE_KEY]) + 1.0
+    out[ATOL_SCALE_KEY] = energy_atol_scale(n_lanes, n, atol, atol_T)
+    return out
+
+
+# --------------------------------------------------------------------------
+# brlint tier-C program contracts (analysis/contracts.py).  The energy
+# RHS/Jacobian are traced into every non-isothermal solver program;
+# energy-noop-fork pins the mode=None dispatch byte-identical to the
+# isothermal builders (sharing the mech-padding contract's baseline
+# memo, so every no-op comparison uses the same "before").
+# --------------------------------------------------------------------------
+from ..analysis.contracts import Identical, Pure, program_contract  # noqa: E402
+
+
+@program_contract(
+    "energy-eqns",
+    doc="non-isothermal RHS/Jacobian (both adiabatic modes) + the "
+        "T-row-weighted solver program: pure")
+def _contract_energy_eqns(h):
+    jnp_ = h.jnp
+    y0e = jnp_.concatenate([h.y0, jnp_.asarray([1100.0])])
+    cfg_e = {**h.cfg,
+             ATOL_SCALE_KEY: jnp_.ones_like(y0e).at[-1].set(1e6)}
+    for mode in ENERGY_MODES:
+        rhs = make_energy_rhs(h.gm, h.th, mode)
+        jac = make_energy_jac(h.gm, h.th, mode)
+        yield Pure(f"energy-rhs-{mode}", h.jaxpr(rhs, 0.0, y0e, cfg_e),
+                   check_dtype=h.check_dtype)
+        yield Pure(f"energy-jac-{mode}", h.jaxpr(jac, 0.0, y0e, cfg_e),
+                   check_dtype=h.check_dtype)
+    # the T-row-weighted BDF step program (the _atol_scale operand rides
+    # cfg, exactly like _nlive): pure, no callbacks / in-loop staging
+    from ..solver import bdf
+
+    rhs_v = make_energy_rhs(h.gm, h.th, "adiabatic_v")
+    jac_v = make_energy_jac(h.gm, h.th, "adiabatic_v")
+
+    def run(y0_):
+        return bdf.solve(rhs_v, y0_, 0.0, 1e-7, cfg_e, rtol=1e-6,
+                         atol=1e-10, max_steps=3, n_save=0,
+                         jac=jac_v).y
+
+    yield Pure("energy-bdf-step", h.jaxpr(run, y0e))
+
+
+@program_contract(
+    "energy-noop-fork",
+    doc="energy=None is a traced no-op: the mode dispatch returns the "
+        "isothermal builders' programs byte-identical, and the cfg "
+        "extension leaves the per-lane dict untouched")
+def _contract_energy_noop(h):
+    from ..ops.rhs import make_gas_jac, make_gas_rhs
+
+    yield Identical(
+        "energy-noop-fork", "gas-rhs-energy-none",
+        h.memo("gas-rhs-baseline",
+               lambda: str(h.jaxpr(make_gas_rhs(h.gm, h.th), 0.0, h.y0,
+                                   h.cfg))),
+        str(h.jaxpr(make_energy_rhs(h.gm, h.th, None), 0.0, h.y0,
+                    h.cfg)),
+        "make_energy_rhs(mode=None) traced a DIFFERENT program than the "
+        "isothermal gas RHS: the energy dispatch leaked into the "
+        "isothermal path (energy/eqns.py contract)")
+    yield Identical(
+        "energy-noop-fork", "gas-jac-energy-none",
+        h.memo("gas-jac-baseline",
+               lambda: str(h.jaxpr(make_gas_jac(h.gm, h.th), 0.0, h.y0,
+                                   h.cfg))),
+        str(h.jaxpr(make_energy_jac(h.gm, h.th, None), 0.0, h.y0,
+                    h.cfg)),
+        "make_energy_jac(mode=None) traced a DIFFERENT program than the "
+        "isothermal gas Jacobian (energy/eqns.py contract)")
+    # the cfg extension at energy=None must leave the per-lane dict
+    # UNTOUCHED (same object, not a copy): the solvers read the
+    # _atol_scale operand with cfg.get at trace time, so "key absent"
+    # IS the pre-energy solver/segment program byte-for-byte — this
+    # pins the isothermal path never even growing the key
+    cfg_none = energy_cfg(h.cfg, None, 1, h.y0.shape[0], 1e-10)
+    yield Identical(
+        "energy-noop-fork", "energy-cfg-none",
+        repr(sorted(h.cfg)), repr(sorted(cfg_none)),
+        "energy_cfg(energy=None) changed the per-lane cfg keys: the "
+        "isothermal path would trace a different solver program "
+        "(energy/eqns.py contract)")
+    if cfg_none is not h.cfg:
+        yield Identical(
+            "energy-noop-fork", "energy-cfg-none-identity", "same",
+            "copied",
+            "energy_cfg(energy=None) copied the cfg dict instead of "
+            "returning it unchanged (energy/eqns.py contract)")

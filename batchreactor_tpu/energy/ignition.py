@@ -1,0 +1,247 @@
+"""Ignition delay as a first-class quantity: detectors, QoIs, gradients.
+
+Before the energy equation existed, ignition delay was a species-marker
+proxy scattered across the stack: a fuel-consumption observer fold in
+``parallel/sweep.py`` and a threshold-crossing QoI inside
+``sensitivity/adjoint.py``.  This module is the shared home for the
+crossing machinery, plus the detectors the *physical* (non-isothermal)
+workload makes possible:
+
+* :func:`interp_crossing` / :func:`grid_crossing` — the ONE linear-
+  interpolation crossing rule.  ``sensitivity/adjoint.py``'s species
+  QoI now delegates here, so the observer, the grid QoI, and the
+  forward IFT gradient all define "the crossing" identically.
+* :func:`energy_ignition_observer` — the streaming O(1)-memory detector
+  for energy-mode sweeps: the running max of dT/dt over accepted-step
+  intervals (the classic max-temperature-rise-rate marker) with a
+  temperature-rise gate, plus the first interpolated crossing of
+  ``T0 + dT_thr`` (the threshold marker the gradient passes
+  differentiate).  Folds per accepted step; composes with the species
+  fallback detector through :func:`merge_observers` (all keys
+  ``ign_``-prefixed, so the two folds never collide).
+* :func:`extract_delay` — host-side read-out: the max-dT/dt time where
+  the lane actually ignited (temperature rose by >= ``dT_min``), NaN
+  elsewhere (the ``parallel.ignition_observer`` NaN contract).
+* :func:`temperature_ignition_qoi` — the adjoint-compatible grid QoI
+  (``sensitivity.adjoint.solve_adjoint``): interpolated first rising
+  crossing of ``T0 + dT_thr`` on the pinned-grid knot states, with the
+  crossing *index* stop-gradiented so gradients flow through the
+  bracketing values — dtau_ign/d(theta) at parameter-count-independent
+  cost.
+* :func:`delay_sensitivity_forward` — the CVODES-shaped forward
+  gradient: solve to the crossing, then apply the implicit-function
+  theorem at it.  tau is defined by ``T(tau) = T0 + dT_thr``, so
+  ``dtau/dtheta = -S_T(tau) / Tdot(tau)`` with ``S_T`` the T row of the
+  staggered forward tangents (``solver/bdf.py tangent=``) — one
+  tangent-carrying solve per gradient, exact at the crossing the
+  threshold defines.  FD-validated in tests/test_energy.py alongside
+  the adjoint twin.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import numpy as np
+
+#: default temperature-rise threshold [K] defining ignition for the
+#: threshold detector and both gradient passes (the common 400 K
+#: convention of shock-tube ignition-delay correlations)
+DEFAULT_DT_THRESHOLD = 400.0
+
+#: default minimum temperature rise [K] for a lane to count as ignited
+#: in :func:`extract_delay` (below it the max-dT/dt time is induction
+#: noise, not a runaway)
+DEFAULT_DT_MIN = 50.0
+
+
+def interp_crossing(t_prev, t_cur, v_prev, v_cur, thr):
+    """Linearly interpolated crossing time of ``thr`` inside the
+    bracketing interval ``(t_prev, v_prev) -> (t_cur, v_cur)`` — THE
+    crossing rule every detector and QoI shares.  Degenerate brackets
+    (``v_prev == v_cur``) clamp onto ``t_cur``."""
+    denom = v_cur - v_prev
+    w = jnp.where(denom != 0, (thr - v_prev) / denom, 1.0)
+    w = jnp.clip(w, 0.0, 1.0)
+    return t_prev + w * (t_cur - t_prev)
+
+
+def grid_crossing(tk, m, thr, rising=False):
+    """Interpolated FIRST crossing of ``thr`` by the grid series ``m``
+    over knot times ``tk`` — the adjoint-QoI form: the crossing *index*
+    is piecewise-constant in the parameters and stop-gradiented, so
+    gradients flow through the bracketing VALUES (tk is grid-pinned and
+    carries no gradient by the adjoint's design).  Returns NaN where
+    the series never crosses (the ``parallel.ignition_observer``
+    contract — a silent last-knot tau would carry a silently-zero
+    gradient)."""
+    hit = (m > thr) if rising else (m < thr)
+    j = lax.stop_gradient(jnp.maximum(jnp.argmax(hit), 1))
+    t_x = interp_crossing(tk[j - 1], tk[j], m[j - 1], m[j], thr)
+    return jnp.where(jnp.any(hit), t_x, jnp.nan)
+
+
+# --------------------------------------------------------------------------
+# streaming detectors (observer folds — the O(1)-memory sweep surface)
+# --------------------------------------------------------------------------
+def energy_ignition_observer(t_index, dT_thr=DEFAULT_DT_THRESHOLD):
+    """(observer, init) extracting ignition delay DURING an energy-mode
+    solve (module doc).  ``t_index`` is the temperature row's state
+    index (``S_pad`` — the trailing row).  Folded keys (all
+    ``ign_``-prefixed so the species fallback detector merges cleanly):
+
+    * ``ign_tau_dT`` — midpoint time of the steepest accepted-step
+      dT/dt interval seen so far (the max-temperature-rise-rate
+      marker); gate it with :func:`extract_delay`;
+    * ``ign_tau_thr`` — interpolated first crossing of ``T0 + dT_thr``
+      (NaN until crossed) — the threshold tau the gradient passes
+      differentiate;
+    * ``ign_T0`` / ``ign_T_max`` — first-seen and running-max
+      temperature (the first accepted step sits ~1e-16 s after t0, so
+      first-seen == initial to rounding — the species detector's m0
+      convention).
+    """
+
+    init = {"ign_t_prev": jnp.nan, "ign_T_prev": jnp.nan,
+            "ign_T0": jnp.nan, "ign_T_max": -jnp.inf,
+            "ign_slope_max": -jnp.inf, "ign_tau_dT": jnp.nan,
+            "ign_tau_thr": jnp.nan}
+
+    def observer(t, y, acc):
+        T = y[t_index]
+        T0 = jnp.where(jnp.isnan(acc["ign_T0"]), T, acc["ign_T0"])
+        dt = t - acc["ign_t_prev"]
+        valid = jnp.isfinite(acc["ign_t_prev"]) & (dt > 0)
+        slope = jnp.where(valid, (T - acc["ign_T_prev"])
+                          / jnp.where(dt > 0, dt, 1.0), -jnp.inf)
+        steeper = slope > acc["ign_slope_max"]
+        tau_dT = jnp.where(steeper, acc["ign_t_prev"] + 0.5 * dt,
+                           acc["ign_tau_dT"])
+        thr = T0 + dT_thr
+        crossed = (jnp.isnan(acc["ign_tau_thr"]) & valid
+                   & (T >= thr) & (acc["ign_T_prev"] < thr))
+        t_x = interp_crossing(acc["ign_t_prev"], t,
+                              acc["ign_T_prev"], T, thr)
+        return {"ign_t_prev": t, "ign_T_prev": T, "ign_T0": T0,
+                "ign_T_max": jnp.maximum(T, acc["ign_T_max"]),
+                "ign_slope_max": jnp.maximum(slope,
+                                             acc["ign_slope_max"]),
+                "ign_tau_dT": tau_dT,
+                "ign_tau_thr": jnp.where(crossed, t_x,
+                                         acc["ign_tau_thr"])}
+
+    return observer, init
+
+
+def merge_observers(a, a0, b, b0):
+    """Compose two observer folds over disjoint key sets into one
+    (dict-union accumulator); loud on a key collision — a silently
+    shadowed fold would report one detector's tau as the other's."""
+    overlap = sorted(set(a0) & set(b0))
+    if overlap:
+        raise ValueError(f"observer folds collide on key(s) {overlap}")
+
+    init = {**a0, **b0}
+
+    def observer(t, y, acc):
+        out_a = a(t, y, {k: acc[k] for k in a0})
+        out_b = b(t, y, {k: acc[k] for k in b0})
+        return {**out_a, **out_b}
+
+    return observer, init
+
+
+def extract_delay(observed, dT_min=DEFAULT_DT_MIN):
+    """Host-side per-lane ignition delay from an
+    :func:`energy_ignition_observer` fold: the max-dT/dt time where the
+    lane actually ignited (T rose by >= ``dT_min`` Kelvin over the
+    run), NaN elsewhere — ``out["ignition_delay"]`` on
+    ``batch_reactor_sweep`` energy runs."""
+    tau = np.asarray(observed["ign_tau_dT"], dtype=np.float64)
+    rise = (np.asarray(observed["ign_T_max"])
+            - np.asarray(observed["ign_T0"]))
+    return np.where(rise >= float(dT_min), tau, np.nan)
+
+
+# --------------------------------------------------------------------------
+# gradient-pass QoIs (adjoint) and the forward IFT pass
+# --------------------------------------------------------------------------
+def temperature_ignition_qoi(t_index, dT_thr=DEFAULT_DT_THRESHOLD):
+    """Adjoint QoI builder (``sensitivity.adjoint.solve_adjoint``
+    contract ``qoi(tk, ys, y_final) -> scalar``): ignition delay as the
+    interpolated first rising crossing of ``T0 + dT_thr`` on the
+    pinned-grid temperature row — dtau_ign/d(theta) at
+    parameter-count-independent cost (module doc)."""
+
+    def qoi(tk, ys, y_final):
+        Tser = ys[:, t_index]
+        return grid_crossing(tk, Tser, Tser[0] + dT_thr, rising=True)
+
+    return qoi
+
+
+def delay_sensitivity_forward(rhs_theta, y0, theta, cfg, t_index, *,
+                              t_max, jac=None, dT_thr=DEFAULT_DT_THRESHOLD,
+                              rtol=1e-8, atol=1e-12, max_steps=100_000,
+                              jac_window=1, sens_iters=2):
+    """Forward (tangent-based) ignition-delay gradient: ``(tau, grad,
+    aux)`` with ``grad`` a theta-shaped pytree of dtau/dtheta.
+
+    tau is the threshold tau — ``T(tau) = T0 + dT_thr`` — and the
+    gradient is the implicit-function theorem at the crossing::
+
+        0 = d/dtheta [T(tau(theta); theta) - T0]
+          => dtau/dtheta = -S_T(tau) / Tdot(tau)
+
+    evaluated in two passes: (1) a plain adaptive solve to ``t_max``
+    locates the interpolated crossing (the
+    :func:`energy_ignition_observer` threshold detector); (2) a
+    staggered-tangent solve (``sensitivity.forward.solve_forward``) to
+    ``t1 = tau`` lands state + tangents exactly at the crossing, where
+    one RHS evaluation closes ``Tdot``.  Cost: one plain + one
+    tangent-carrying solve — the CVODES shape.  Run at ``rtol <= 1e-8``
+    (the docs/sensitivity.md tangent-accuracy tier).  NaN gradient when
+    the lane never crosses inside ``t_max`` (``aux["ignited"]`` False).
+    """
+    from ..sensitivity import params as P
+    from ..sensitivity.forward import solve_forward
+    from ..solver import bdf
+
+    theta0 = jax.tree.map(lax.stop_gradient, theta)
+
+    def rhs0(t, y, cfg):
+        return rhs_theta(t, y, theta0, cfg)
+
+    jac0 = None
+    if jac is not None:
+        def jac0(t, y, cfg):
+            return jac(t, y, theta0, cfg)
+
+    observer, obs0 = energy_ignition_observer(t_index, dT_thr=dT_thr)
+    pin = bdf.solve(rhs0, jnp.asarray(y0), 0.0, float(t_max), cfg,
+                    rtol=rtol, atol=atol, max_steps=max_steps,
+                    jac=jac0, jac_window=jac_window,
+                    observer=observer, observer_init=obs0)
+    tau = pin.observed["ign_tau_thr"]
+    ignited = bool(np.isfinite(np.asarray(tau)))
+    theta_flat, unflat = P.flatten(theta)
+    if not ignited:
+        grad = unflat(jnp.full((theta_flat.shape[0],), jnp.nan))
+        return float(np.asarray(tau)), grad, {
+            "ignited": False, "status": pin.status, "Tdot": np.nan}
+    jac_fixed = None
+    if jac is not None:
+        def jac_fixed(t, y, cfg):
+            return jac(t, y, theta, cfg)
+
+    res = solve_forward(rhs_theta, y0, 0.0, tau, theta, cfg, rtol=rtol,
+                        atol=atol, max_steps=max_steps, jac=jac_fixed,
+                        jac_window=jac_window, sens_iters=sens_iters,
+                        sens_errcon=True)
+    Tdot = rhs_theta(res.t, res.y, theta, cfg)[t_index]
+    grad_flat = -res.tangents[:, t_index] / Tdot
+    return float(np.asarray(tau)), unflat(grad_flat), {
+        "ignited": True, "status": res.status,
+        "Tdot": float(np.asarray(Tdot)),
+        "T_at_tau": float(np.asarray(res.y[t_index])),
+        "n_accepted": int(res.n_accepted)}

@@ -12,8 +12,10 @@ to the OTHER two program-shape axes: species (S) and reactions (R).
 
 * **dead species** carry zero stoichiometry columns (``nu_f``/``nu_r``),
   zero third-body efficiency columns, zero initial mass (the caller pads
-  states with :func:`pad_states`), and zero NASA-7 coefficients
-  (:func:`pad_thermo`) — so their production rates, their Jacobian rows
+  states with :func:`pad_states`), and the inert NASA-7 row
+  (:func:`pad_thermo`: ``cp = R``, ``h = RT`` — so the energy
+  equations' ``Cv``/``u`` vanish on the dead tail too) — so their
+  production rates, their Jacobian rows
   AND columns, and their error-norm contributions are exactly ``0.0``,
   and the Newton iteration matrix ``M = I - cJ`` is the identity on the
   dead block (the LU of a block-diagonal ``[M_live, I]`` reproduces the
@@ -171,10 +173,23 @@ def pad_gas_mechanism(gm, s_pad, r_pad, *, canonical=False):
 
 def pad_thermo(thermo, s_pad, *, canonical=False):
     """Pad a :class:`~.thermo.ThermoTable` to ``s_pad`` species.  Dead
-    species get all-zero NASA-7 coefficients (g/RT == 0 exactly — and
-    every Gibbs sum weights them by zero stoichiometry anyway), molwt 1.0
-    (so ``conc = rho_k / molwt`` is ``0/1 == 0``, never ``0/0``), and the
-    default 300/1000/5000 K range bounds."""
+    species get the INERT NASA-7 row ``a1 = 1, a2..a7 = 0`` in both
+    ranges — ``cp_k = R``, ``h_k = R T``, ``s_k = R ln T`` — molwt 1.0
+    (so ``conc = rho_k / molwt`` is ``0/1 == 0``, never ``0/0``), and
+    the default 300/1000/5000 K range bounds.
+
+    Why ``a1 = 1`` rather than all-zero coefficients: every *Gibbs* sum
+    weights dead species by zero stoichiometry, so any finite fill is
+    value-inert for isothermal kinetics (``dnu_ik * g_k = 0 * finite ==
+    0.0`` exactly); but the ENERGY equations (energy/eqns.py) sum
+    ``c_k Cv_k`` and ``u_k wdot_k`` with ``Cv_k = Cp_k - R`` and ``u_k
+    = h_k - R T`` — an all-zero row would give dead species ``Cv = -R``
+    and ``u = -R T``, putting nonzero entries in the adiabatic
+    Jacobian's dead COLUMNS (through ``d(sum c Cv)/dc_dead``) and
+    breaking the identity-Newton-block argument.  The inert row makes
+    ``Cv_dead = 0`` and ``u_dead = 0`` exactly, so the dead tail is
+    provably inert in the energy sums too (zero contribution, zero
+    Jacobian rows AND columns, step-count identity preserved)."""
     S = thermo.n_species
     s_pad = int(s_pad)
     if s_pad < S:
@@ -189,8 +204,10 @@ def pad_thermo(thermo, s_pad, *, canonical=False):
             np.asarray(fill, dtype=a.dtype), (ds,) + a.shape[1:])
         return np.concatenate([a, pad], axis=0)
 
+    coeffs_inert = np.zeros((2, 7))
+    coeffs_inert[:, 0] = 1.0          # cp/R = 1, h/RT = 1, s/R = ln T
     return ThermoTable(
-        coeffs=jnp.asarray(cat(thermo.coeffs, 0.0)),
+        coeffs=jnp.asarray(cat(thermo.coeffs, coeffs_inert)),
         T_low=jnp.asarray(cat(thermo.T_low, 300.0)),
         T_mid=jnp.asarray(cat(thermo.T_mid, 1000.0)),
         T_high=jnp.asarray(cat(thermo.T_high, 5000.0)),

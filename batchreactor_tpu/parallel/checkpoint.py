@@ -168,12 +168,15 @@ def _hash_callable(h, fn, depth=0):
 #: resume fingerprint — a resume under a different value would silently
 #: concatenate chunks with different schemas.  ``stats`` has always
 #: hashed for this reason; a non-None ``timeline`` joined in PR 9 (the
-#: stat_timeline_* keys).  The brlint tier-C fingerprint-completeness
+#: stat_timeline_* keys); a non-None ``energy`` joined with the energy
+#: subsystem (energy/eqns.py: the chunk state rows grow the trailing T
+#: column, so a resume under a different mode would concatenate (B, S)
+#: and (B, S+1) chunks).  The brlint tier-C fingerprint-completeness
 #: audit (analysis/contracts.py) checks this registry stays disjoint
 #: from the exemption list below AND that toggling each knob really
 #: moves the hash — adding a schema-changing knob means registering it
 #: here, never exempting it.
-SCHEMA_KNOBS = ("stats", "timeline")
+SCHEMA_KNOBS = ("stats", "timeline", "energy")
 
 #: segmented execution-GEAR / watchdog / observer knobs, contractually
 #: results-neutral (parallel/sweep.py): they change how segments are
@@ -794,6 +797,16 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
     (it changes the persisted chunk stats schema — resuming under a
     different ring fails loudly; explicit ``timeline=None``
     fingerprints identically to the knob absent).
+
+    ``energy=`` (``energy/eqns.py`` mode literals) declares a
+    non-isothermal sweep: callers running an energy-mode ``rhs`` (state
+    ``[rho_k, T]``) pass the mode so it PINS the resume fingerprint —
+    the chunk state schema grows the T column, and a resume under a
+    different mode must fail loudly instead of concatenating
+    mixed-width chunks (``SCHEMA_KNOBS``).  The knob is a declaration
+    only (the rhs already fixes the physics) and is never forwarded to
+    the per-chunk driver; explicit ``energy=None`` fingerprints
+    identically to the knob absent, so pre-energy dirs stay resumable.
     """
     from ..resilience import inject
     from ..resilience.policy import (RETRYABLE, fallback_kwargs,
@@ -805,6 +818,17 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
 
     retry = normalize_retry(retry)
     qpol = normalize_quarantine(quarantine)
+    # energy= is a schema DECLARATION here (SCHEMA_KNOBS): the caller's
+    # rhs already fixes the physics, but a non-None mode grows every
+    # chunk's state rows by the trailing T column, so it must pin the
+    # resume fingerprint — validated by THE one rule (energy/eqns.py),
+    # folded into the hash below, never forwarded to the per-chunk
+    # driver (which has no energy kwarg).  Explicit energy=None
+    # fingerprints identically to the knob absent (the buckets=None /
+    # timeline=None convention), so pre-energy checkpoint dirs resume.
+    from ..energy.eqns import resolve_energy
+
+    energy = resolve_energy(solve_kw.pop("energy", None))
     resident_req, refill_spec = resolve_admission(
         admission, refill, n_lanes=int(jnp.asarray(y0s).shape[0]))
     if resident_req is not None:
@@ -898,9 +922,11 @@ def checkpointed_sweep(rhs, y0s, t0, t1, cfgs, ckpt_dir, *, chunk_size=512,
         cost_sorted = lane_cost[perm]
     B = y0s.shape[0]
     os.makedirs(ckpt_dir, exist_ok=True)
+    fp_kw = (solve_kw if energy is None
+             else {**solve_kw, "energy": energy})
     pinned = {"B": int(B), "chunk_size": chunk_size,
               "t0": float(t0), "t1": float(t1),
-              "fingerprint": _sweep_fingerprint(rhs, y0s, cfgs, solve_kw)}
+              "fingerprint": _sweep_fingerprint(rhs, y0s, cfgs, fp_kw)}
     ledger = _Ledger(ckpt_dir, pinned, ensure_manifest(ckpt_dir, pinned))
     # live telemetry plane (obs/live.py, rides solve_kw into the
     # segmented driver too): chunk progress + retry-ledger state publish

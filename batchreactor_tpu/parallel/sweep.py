@@ -2137,8 +2137,11 @@ def ignition_observer(marker, mode="half", frac=0.5):
 def ignition_delay(ts, ys, marker, mode="peak"):
     """Per-lane ignition delay from saved trajectories.
 
-    The classic max-dT/dt marker is unavailable (isothermal reactor —
-    SURVEY.md §7.8), so use species markers: ``mode="peak"`` returns the
+    The classic max-dT/dt marker needs the energy equation — isothermal
+    runs (the default physics) use species markers; non-isothermal
+    sweeps (``energy=`` on ``batch_reactor_sweep``) get the physical
+    detector in-loop instead (``energy/ignition.py``,
+    ``out["ignition_delay"]``).  ``mode="peak"`` returns the
     time of the marker species' maximum (e.g. OH mass density), ``"half"``
     the first time it drops below half its initial value (fuel-consumption
     marker).  ``ts``: (B, n_save) +inf-padded; ``ys``: (B, n_save, S);

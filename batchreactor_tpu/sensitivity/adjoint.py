@@ -167,25 +167,18 @@ def final_species_qoi(index):
 def ignition_delay_qoi(marker, frac=0.5):
     """QoI builder: ignition delay as the interpolated first crossing of
     the marker species below ``frac`` x its first-grid-point value (the
-    fuel-consumption marker of ``parallel.ignition_observer``; the
-    crossing *index* is piecewise-constant in theta and stop-gradiented —
-    gradients flow through the bracketing values)."""
+    fuel-consumption marker of ``parallel.ignition_observer``).  The
+    crossing machinery lives in ``energy/ignition.py`` (the ONE
+    grid-crossing rule, shared with the temperature-threshold QoI
+    ``energy.temperature_ignition_qoi``): the crossing *index* is
+    piecewise-constant in theta and stop-gradiented — gradients flow
+    through the bracketing values — and a never-crossed series returns
+    NaN (a silent last-knot tau would carry a silently-zero gradient)."""
+    from ..energy.ignition import grid_crossing
 
     def qoi(tk, ys, y_final):
         m = ys[:, marker]
-        thr = frac * m[0]
-        below = m < thr
-        j = lax.stop_gradient(jnp.maximum(jnp.argmax(below), 1))
-        m_hi, m_lo = m[j - 1], m[j]
-        t_hi, t_lo = tk[j - 1], tk[j]
-        denom = m_hi - m_lo
-        w = jnp.clip(jnp.where(denom != 0, (m_hi - thr) / denom, 1.0),
-                     0.0, 1.0)
-        # NaN where the marker never crossed (same contract as
-        # parallel.ignition_observer) — a silent tau == last-knot value
-        # would also carry a silently-zero gradient (clipped w)
-        return jnp.where(jnp.any(below), t_hi + w * (t_lo - t_hi),
-                         jnp.nan)
+        return grid_crossing(tk, m, frac * m[0])
 
     return qoi
 

@@ -1,9 +1,10 @@
 """The coalescer: a thread-safe request queue feeding resident streams.
 
 Requests land here (:meth:`Scheduler.submit`) from any number of
-front-end threads, are grouped by *pack key* ``(t1, rtol, atol)`` —
-``t1`` and the conditions are traced operands of one shared program,
-``rtol``/``atol`` are static and therefore a distinct compiled program —
+front-end threads, are grouped by *pack key* ``(t1, rtol, atol,
+energy)`` — ``t1`` and the conditions are traced operands of one shared
+program; ``rtol``/``atol``/``energy`` are static and therefore a
+distinct compiled program (an energy lane's state is one row wider) —
 and are packed into the PR-8 admission backlog of a resident streaming
 sweep: the scheduler's worker thread runs one *epoch* per active pack
 key through ``session.stream``, whose
@@ -280,7 +281,11 @@ class Scheduler:
         rec = getattr(self.session, "recorder", None)
         if rec is not None:
             rec.counter("serve_epochs")
-        t1, rtol, atol = key
+        # pack key: (t1, rtol, atol) pre-energy, (t1, rtol, atol,
+        # energy) since — the star-unpack keeps fake-session tests and
+        # any 3-tuple producer working
+        t1, rtol, atol, *rest = key
+        energy = rest[0] if rest else None
         gid_map = []      # gid -> (_Work, lane offset); driver gids are
         #                   append-order over (initial backlog + feeds)
         epoch_works = []
@@ -341,8 +346,13 @@ class Scheduler:
                     if self._draining or other:
                         return None     # rotate / drain: close the feed
                     if not idle:
+                        # zero-lane rows keep each cfg leaf's trailing
+                        # shape (the energy _atol_scale leaf is (k, n),
+                        # not (k,)) so the driver's concatenate stays
+                        # shape-consistent
                         return (np.zeros((0,) + y0s.shape[1:]),
-                                {k: np.zeros((0,))
+                                {k: np.zeros(
+                                    (0,) + np.asarray(cfgs[k]).shape[1:])
                                  for k in cfgs})
                     left = deadline - time.monotonic()
                     if left <= 0:
@@ -386,8 +396,11 @@ class Scheduler:
                 self._resolve(w)
 
         try:
+            # energy rides only when set, so fake sessions (and any
+            # pre-energy stream signature) keep working
+            ekw = {} if energy is None else {"energy": energy}
             self.session.stream(y0s, cfgs, t1=t1, rtol=rtol, atol=atol,
-                                on_harvest=on_harvest, feed=feed)
+                                on_harvest=on_harvest, feed=feed, **ekw)
         except BaseException as e:  # noqa: BLE001 — an epoch must not
             #                         kill the scheduler thread; every
             #                         admitted request is answered

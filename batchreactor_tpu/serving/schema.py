@@ -24,9 +24,15 @@ Request JSON (``POST /solve`` body, or one stdin-JSONL line)::
      "n_save": 0,                 # optional; only 0 is accepted — the
                                   # admission gear streams final states,
                                   # not trajectories (loud error)
-     "mech": "user-mech-7"}       # optional mechanism routing key
+     "mech": "user-mech-7",       # optional mechanism routing key
                                   # (multi-mechanism store; upload id or
                                   # fingerprint prefix — docs/serving.md)
+     "energy": "adiabatic_v"}     # optional non-isothermal mode
+                                  # (docs/energy.md: adiabatic_v /
+                                  # adiabatic_p; the session spec must
+                                  # list it in solver.energy_modes —
+                                  # energy lanes answer with per-lane
+                                  # "T" and "ignition_delay")
 
 Responses are ``{"v": 1, "id": ..., "status": "ok" | "error", ...}``:
 ``ok`` carries per-lane ``t`` / ``solver_status`` / ``provenance`` /
@@ -49,7 +55,12 @@ SCHEMA_VERSION = 1
 
 #: the only keys a request may carry (anything else is a loud error)
 _REQUEST_KEYS = ("v", "id", "T", "p", "X", "t1", "rtol", "atol", "Asv",
-                 "n_save", "mech")
+                 "n_save", "mech", "energy")
+
+#: the non-None energy-mode literals (energy/eqns.py ENERGY_MODES,
+#: duplicated here because the schema imports no jax-reaching module —
+#: tests pin the two tuples equal)
+ENERGY_MODES = ("adiabatic_v", "adiabatic_p")
 
 #: error codes a response may carry
 ERROR_CODES = ("invalid", "overloaded", "draining", "internal",
@@ -81,6 +92,11 @@ class Request:
     #: Routing happens BEFORE scheduling (each mechanism owns its own
     #: scheduler), so it is not part of pack_key.
     mech: str | None = None
+    #: non-isothermal reactor mode (docs/energy.md): None = isothermal,
+    #: else an :data:`ENERGY_MODES` literal.  Part of pack_key — an
+    #: energy lane carries the trailing T state row, so it can never
+    #: share a resident program with isothermal lanes.
+    energy: str | None = None
 
     @property
     def n_lanes(self):
@@ -88,9 +104,10 @@ class Request:
 
     def pack_key(self):
         """Requests sharing this key can ride one resident stream: t1
-        is a traced operand of the shared program, rtol/atol are static
-        (a distinct pair is a distinct compiled program)."""
-        return (self.t1, self.rtol, self.atol)
+        is a traced operand of the shared program, rtol/atol/energy are
+        static (a distinct combination is a distinct compiled
+        program — the energy state is one row wider)."""
+        return (self.t1, self.rtol, self.atol, self.energy)
 
 
 def _as_lane_array(name, value, rid):
@@ -128,14 +145,17 @@ def _positive_scalar(name, value, rid):
 
 def validate_request(obj, *, species=None, rtol_default=1e-6,
                      atol_default=1e-10, default_id=None,
-                     max_lanes=None):
+                     max_lanes=None, energy_modes=()):
     """Validate one request JSON object into a :class:`Request` (module
     doc grammar); every rejection is a ``ValueError`` naming the field.
 
     ``species`` (the session's gas species tuple) makes unknown ``X``
     keys a validation error here instead of a failure deep in lane
     packing; ``max_lanes`` bounds one request's lane count (a request
-    larger than the whole admission queue could never be accepted).
+    larger than the whole admission queue could never be accepted);
+    ``energy_modes`` is the tuple of non-isothermal modes THIS session
+    warmed (``SessionSpec.energy_modes``) — a request asking for an
+    un-warmed mode rejects here, before anything queues.
     """
     if not isinstance(obj, dict):
         raise ValueError(f"request must be a JSON object; got "
@@ -215,6 +235,36 @@ def validate_request(obj, *, species=None, rtol_default=1e-6,
             f"request {rid!r}: mech must be a non-empty mechanism id "
             f"string; got {mech!r}")
 
+    energy = obj.get("energy")
+    if energy is not None:
+        if energy not in ENERGY_MODES:
+            # name the accepted literals (the api.py loudness
+            # convention — a typo'd mode must say what IS accepted)
+            raise ValueError(
+                f"request {rid!r}: unknown energy mode {energy!r}; "
+                f"accepted: {list(ENERGY_MODES)} (omit the key for an "
+                f"isothermal solve)")
+        if tuple(energy_modes or ()) and energy not in energy_modes:
+            raise ValueError(
+                f"request {rid!r}: energy mode {energy!r} is not "
+                f"enabled on this session (warmed modes: "
+                f"{list(energy_modes)}); add it to the session spec's "
+                f"solver.energy_modes")
+        if not energy_modes:
+            raise ValueError(
+                f"request {rid!r}: energy mode {energy!r} is not "
+                f"enabled on this session (no solver.energy_modes in "
+                f"the session spec)")
+        if "Asv" in obj and np.any(Asv != 1.0):
+            # incompatible-knob rejection (the n_save convention below):
+            # Asv couples surface chemistry, energy mode is gas-only
+            # adiabatic — a silently ignored Asv would report physics
+            # that never ran
+            raise ValueError(
+                f"request {rid!r}: Asv is a surface-coupling parameter; "
+                f"energy={energy!r} runs gas-only adiabatic chemistry — "
+                f"drop Asv or the energy key")
+
     bcast = (lambda a: np.broadcast_to(a, (k,)).copy()
              if a.shape[0] == 1 else a)
     X = {n: bcast(a) for n, a in X.items()}
@@ -230,7 +280,8 @@ def validate_request(obj, *, species=None, rtol_default=1e-6,
             f"{float(total[bad])!r}; mole fractions must sum > 0 on "
             f"every lane")
     return Request(id=rid, T=bcast(T), p=bcast(p), Asv=bcast(Asv),
-                   X=X, t1=t1, rtol=rtol, atol=atol, mech=mech)
+                   X=X, t1=t1, rtol=rtol, atol=atol, mech=mech,
+                   energy=energy)
 
 
 def validate_upload(obj, *, default_id=None):

@@ -145,7 +145,8 @@ class ServingServer:
                 rtol_default=session.spec.rtol,
                 atol_default=session.spec.atol,
                 default_id=self._ids.next(),
-                max_lanes=session.spec.max_lanes_per_request)
+                max_lanes=session.spec.max_lanes_per_request,
+                energy_modes=getattr(session.spec, "energy_modes", ()))
         except ValueError as e:
             return 400, schema.error_response(rid, "invalid", e)
         try:
@@ -281,7 +282,8 @@ def serve_jsonl(session, scheduler, infile, outfile):
                 rtol_default=session.spec.rtol,
                 atol_default=session.spec.atol,
                 default_id=ids.next(),
-                max_lanes=session.spec.max_lanes_per_request)
+                max_lanes=session.spec.max_lanes_per_request,
+                energy_modes=getattr(session.spec, "energy_modes", ()))
         except ValueError as e:
             rejected += 1
             _emit(schema.error_response(

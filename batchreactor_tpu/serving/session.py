@@ -52,7 +52,7 @@ _SOLVER_KEYS = ("method", "rtol", "atol", "jac_window", "linsolve",
                 "setup_economy", "stale_tol", "segment_steps",
                 "max_attempts", "stats", "ignition_marker",
                 "ignition_mode", "mech_operands", "species_buckets",
-                "reaction_buckets")
+                "reaction_buckets", "energy_modes")
 _SERVE_KEYS = ("resident", "refill", "buckets", "poll_every",
                "max_queue_lanes", "idle_timeout_s", "request_timeout_s",
                "max_lanes_per_request", "coalesce_s", "max_mechanisms")
@@ -89,6 +89,14 @@ class SessionSpec:
     mech_operands: bool = False
     species_buckets: object = None
     reaction_buckets: object = None
+    #: non-isothermal serving (docs/energy.md): the tuple of energy-mode
+    #: literals this session warms and serves — each mode is its own
+    #: program family (the state grows the trailing T row), warmed per
+    #: ladder rung alongside the isothermal set; a request's ``energy``
+    #: key must name one of these (schema.validate_request) and joins
+    #: its pack key, so energy and isothermal lanes never share a
+    #: resident program.  ``()`` (default) serves isothermal only.
+    energy_modes: tuple = ()
     # serve config (scheduler/capacity — NOT part of the program keys)
     resident: int = 8
     refill: object = 1
@@ -155,6 +163,16 @@ def load_spec(source):
     kw.update(_section(obj.get("serve") or {}, _SERVE_KEYS, "serve"))
     if isinstance(kw.get("buckets"), list):
         kw["buckets"] = tuple(int(b) for b in kw["buckets"])
+    if kw.get("energy_modes") is not None:
+        from .schema import ENERGY_MODES
+
+        modes = tuple(kw["energy_modes"])
+        bad = [m for m in modes if m not in ENERGY_MODES]
+        if bad:
+            raise ValueError(
+                f"session spec: unknown energy mode(s) {bad}; "
+                f"accepted: {list(ENERGY_MODES)}")
+        kw["energy_modes"] = modes
     resolve = (lambda p: p if os.path.isabs(p)
                else os.path.normpath(os.path.join(base, p)))
     spec = SessionSpec(mech=resolve(mech_sec["mech"]),
@@ -247,6 +265,21 @@ class SolverSession:
             self.mech_bundle = (gm_kernel, None, th_kernel)
             self.rhs = _segmented_builder("gas", None, False, True)
             self.jac = None
+        # per-energy-mode callables (docs/energy.md serving): None is
+        # the isothermal set above; each listed mode builds its own
+        # rhs/jac/observer through the SAME api construction, so served
+        # energy lanes and direct batch_reactor_sweep(energy=) lanes run
+        # identical programs (and share AOT keys)
+        self._mode_fns = {None: (self.rhs, self.jac, self.observer,
+                                 self.observer_init)}
+        for m in tuple(spec.energy_modes or ()):
+            rhs_m, jac_m, obs_m, obs0_m = _sweep_fns(
+                "gas", None, gm_kernel, None, th_kernel, False, True,
+                marker_idx, spec.ignition_mode, "analytic", m)
+            if spec.mech_operands:
+                rhs_m = _segmented_builder("gas", None, False, True, m)
+                jac_m = None
+            self._mode_fns[m] = (rhs_m, jac_m, obs_m, obs0_m)
         self.jac_window = resolve_jac_window(spec.jac_window, spec.method)
         self.buckets = normalize_buckets(spec.buckets)
         #: the largest resident program shape the session will run —
@@ -308,15 +341,30 @@ class SolverSession:
                 if e.get("single_program")}
 
     # ---- warmup (the aot/ registry face) ----------------------------------
-    def _stream_flags(self, rtol, atol):
+    def _energy_fns(self, energy):
+        """The per-mode ``(rhs, jac, observer, observer_init)`` set;
+        loud on a mode the session never built (schema validation gates
+        requests, this guards programmatic callers)."""
+        try:
+            return self._mode_fns[energy]
+        except KeyError:
+            raise ValueError(
+                f"energy mode {energy!r} is not enabled on this "
+                f"session (warmed modes: "
+                f"{list(self.spec.energy_modes)}); add it to the "
+                f"session spec's solver.energy_modes") from None
+
+    def _stream_flags(self, rtol, atol, energy=None):
         """THE sweep flag set — shared verbatim by :meth:`stream` and
         :meth:`warmup_specs` so the warmed program keys cannot drift
         from the served ones (every key here shapes the traced
-        program)."""
+        program).  ``energy`` selects the per-mode callable set (the
+        pack key's static half)."""
         s = self.spec
+        _rhs, jac_m, obs_m, obs0_m = self._energy_fns(energy)
         flags = dict(method=s.method, rtol=float(rtol), atol=float(atol),
-                     jac=self.jac, observer=self.observer,
-                     observer_init=self.observer_init,
+                     jac=jac_m, observer=obs_m,
+                     observer_init=obs0_m,
                      jac_window=self.jac_window, linsolve=s.linsolve,
                      setup_economy=bool(s.setup_economy),
                      stale_tol=float(s.stale_tol), stats=bool(s.stats),
@@ -331,17 +379,16 @@ class SolverSession:
         return flags
 
     def warmup_specs(self, rtol=None, atol=None):
-        """One ``aot.warmup`` spec per ladder rung <= the resident cap:
-        each warms its rung's segment program AND (``backlog=2`` +
-        ``admission=rung``) the traced compaction/admission step, so a
-        cold daemon's first streamed request compiles nothing."""
+        """One ``aot.warmup`` spec per ladder rung per energy mode
+        (isothermal + every ``spec.energy_modes`` entry) <= the
+        resident cap: each warms its rung's segment program AND
+        (``backlog=2`` + ``admission=rung``) the traced
+        compaction/admission step, so a cold daemon's first streamed
+        request — isothermal or adiabatic — compiles nothing."""
         from ..aot import bucket_ladder
 
         rtol = self.spec.rtol if rtol is None else rtol
         atol = self.spec.atol if atol is None else atol
-        # exemplar lane: an equimolar mix over the first two species is
-        # shape-complete (values never enter the program key)
-        y0, cfg_row = self._exemplar()
         if self.buckets is None:
             rungs = (self.bucket_cap,)
         else:
@@ -349,16 +396,26 @@ class SolverSession:
                 b for b in bucket_ladder(
                     range(1, self.bucket_cap + 1), self.buckets)
                 if b <= self.bucket_cap)
-        return [dict(rhs=self.rhs, y0=y0, cfg=cfg_row, lanes=[r],
+        specs = []
+        for mode in (None,) + tuple(self.spec.energy_modes or ()):
+            # exemplar lane: an equimolar mix over the first two
+            # species is shape-complete (values never enter the
+            # program key)
+            y0, cfg_row = self._exemplar(energy=mode, atol=atol)
+            rhs_m = self._energy_fns(mode)[0]
+            specs.extend(
+                dict(rhs=rhs_m, y0=y0, cfg=cfg_row, lanes=[r],
                      buckets=self.buckets, backlog=2, admission=r,
                      refill=1, poll_every=int(self.spec.poll_every),
-                     **self._stream_flags(rtol, atol))
-                for r in rungs]
+                     **self._stream_flags(rtol, atol, mode))
+                for r in rungs)
+        return specs
 
-    def _exemplar(self):
+    def _exemplar(self, energy=None, atol=None):
         """One exemplar (y0, cfg) row for warmup spec construction —
         only shapes matter, but the values must be solvable (finite
-        density)."""
+        density).  ``energy`` extends the row with the trailing T state
+        and the T-row atol weight, exactly like :meth:`request_lanes`."""
         X = np.zeros((1, len(self.species)))
         X[0, 0] = 1.0
         y0 = np.asarray(self._solution_vectors(
@@ -369,6 +426,14 @@ class SolverSession:
             y0 = y0[0]
             cfg = {k: (float(v) if np.ndim(v) == 0 else float(v[0]))
                    for k, v in cfg.items()}
+        if energy is not None:
+            y0, cfg1 = self._energy_lanes(
+                y0[None, :], {k: np.asarray([v]) for k, v in cfg.items()},
+                np.asarray([1500.0]),
+                self.spec.atol if atol is None else atol)
+            y0 = y0[0]
+            cfg = {k: (np.asarray(v)[0] if np.ndim(v) else v)
+                   for k, v in cfg1.items()}
         return y0, cfg
 
     def _pad_lanes(self, y0, cfg):
@@ -384,6 +449,27 @@ class SolverSession:
                 axis=1)
         cfg = dict(cfg)
         cfg[NLIVE_KEY] = np.full((k,), float(len(self.species)))
+        return y0, cfg
+
+    def _energy_lanes(self, y0, cfg, T, atol):
+        """Energy-mode lane extension (docs/energy.md): the trailing T
+        state row (after species padding, so it sits at S_pad), the
+        live-count bump (the T row is live), and the T-row atol weight
+        — value-identical to ``api.batch_reactor_sweep``'s
+        ``energy/eqns.py`` construction, so a served adiabatic lane and
+        a direct sweep lane are the same numbers."""
+        from ..energy.eqns import energy_atol_scale
+        from ..models.padding import NLIVE_KEY
+        from ..solver.sdirk import ATOL_SCALE_KEY
+
+        k = y0.shape[0]
+        y0 = np.concatenate(
+            [y0, np.asarray(T, dtype=np.float64)[:, None]], axis=1)
+        cfg = dict(cfg)
+        if NLIVE_KEY in cfg:
+            cfg[NLIVE_KEY] = np.asarray(cfg[NLIVE_KEY]) + 1.0
+        cfg[ATOL_SCALE_KEY] = np.asarray(
+            energy_atol_scale(k, y0.shape[1], atol))
         return y0, cfg
 
     def warmup(self, cache_dir=None, log=None):
@@ -429,23 +515,28 @@ class SolverSession:
                "Asv": np.asarray(req.Asv, dtype=np.float64)}
         if self.mech_shape is not None:
             y0, cfg = self._pad_lanes(y0, cfg)
+        if getattr(req, "energy", None) is not None:
+            self._energy_fns(req.energy)   # loud before anything queues
+            y0, cfg = self._energy_lanes(y0, cfg, req.T, req.atol)
         return y0, cfg
 
     # ---- the resident stream ----------------------------------------------
-    def stream(self, y0s, cfgs, *, t1, rtol, atol, on_harvest=None,
-               feed=None):
+    def stream(self, y0s, cfgs, *, t1, rtol, atol, energy=None,
+               on_harvest=None, feed=None):
         """Run one resident streaming sweep epoch over the given
         backlog, with the scheduler's harvest/feed hooks attached
         (``parallel.ensemble_solve_segmented`` ``_on_harvest``/
-        ``_feed`` contract).  Blocks until the feed closes and every
-        admitted lane harvests."""
+        ``_feed`` contract).  ``energy`` (a pack key's static half)
+        selects the per-mode program family.  Blocks until the feed
+        closes and every admitted lane harvests."""
         import jax.numpy as jnp
 
         from ..parallel.sweep import ensemble_solve_segmented
 
         s = self.spec
         return ensemble_solve_segmented(
-            self.rhs, jnp.asarray(y0s), 0.0, float(t1),
+            self._energy_fns(energy)[0], jnp.asarray(y0s), 0.0,
+            float(t1),
             {k: jnp.asarray(v) for k, v in cfgs.items()},
             max_segments=self.MAX_SEGMENTS,
             admission=int(s.resident),
@@ -454,7 +545,7 @@ class SolverSession:
             recorder=self.recorder,
             watch=self._watch if self._watch_entered else None,
             live=self.registry, _on_harvest=on_harvest, _feed=feed,
-            **self._stream_flags(rtol, atol))
+            **self._stream_flags(rtol, atol, energy))
 
     # ---- results -> response payload --------------------------------------
     def fractions(self, y_rows):
@@ -484,6 +575,20 @@ class SolverSession:
         }
         if result.observed is not None and "tau" in result.observed:
             payload["tau"] = [float(v) for v in result.observed["tau"]]
+        if getattr(result.request, "energy", None) is not None:
+            # the physical-ignition payload (docs/energy.md): final
+            # temperatures + the max-dT/dt delay (NaN -> null where the
+            # lane never ignited)
+            from ..energy.ignition import extract_delay
+
+            payload["energy"] = result.request.energy
+            payload["T"] = [float(v)
+                            for v in np.asarray(result.y)[:, -1]]
+            if (result.observed is not None
+                    and "ign_tau_dT" in result.observed):
+                delay = extract_delay(result.observed)
+                payload["ignition_delay"] = [
+                    None if np.isnan(v) else float(v) for v in delay]
         if result.stats is not None:
             from ..obs import counters as C
 
@@ -500,6 +605,7 @@ class SolverSession:
                 "bucket_cap": self.bucket_cap,
                 "mech_shape": self.mech_shape,
                 "mech_operands": self.mech_bundle is not None,
+                "energy_modes": list(self.spec.energy_modes or ()),
                 "warmed": (None if self.warmed is None
                            else sum(1 for r in self.warmed if r.warm)),
                 "compiles": w.get("compiles"),

@@ -42,8 +42,9 @@ from jax import lax
 
 from .linalg import (apply_factor, factor_m, factor_zeros, make_solve_m,
                      resolve_linsolve)
-from .sdirk import (DT_UNDERFLOW, MAX_STEPS_REACHED, NLIVE_KEY, RUNNING,
-                    SUCCESS, SolveResult, _scaled_norm)
+from .sdirk import (ATOL_SCALE_KEY, DT_UNDERFLOW, MAX_STEPS_REACHED,
+                    NLIVE_KEY, RUNNING, SUCCESS, SolveResult,
+                    _scaled_norm)
 
 MAXORD = 5
 _ROWS = MAXORD + 3          # D rows 0..MAXORD+2
@@ -320,9 +321,17 @@ def solve(
     nlive = cfg.get(NLIVE_KEY) if isinstance(cfg, dict) else None
     if nlive is not None:
         nlive = jnp.asarray(nlive, dtype=y0.dtype)
+    # energy T-row weight (sdirk.ATOL_SCALE_KEY, energy/eqns.py): a
+    # per-component multiplier on atol in every scaled norm and the
+    # Newton displacement scale; absent — every isothermal run — the
+    # traced program is byte-identical to the key not existing
+    atol_scale = cfg.get(ATOL_SCALE_KEY) if isinstance(cfg, dict) else None
+    if atol_scale is not None:
+        atol_scale = jnp.asarray(atol_scale, dtype=y0.dtype)
+    atol_vec = atol if atol_scale is None else atol * atol_scale
 
     def _norm(e, y):
-        return _scaled_norm(e, y, rtol, atol, nlive)
+        return _scaled_norm(e, y, rtol, atol, nlive, atol_scale)
 
     if nlive is None:
         def _rms(x):
@@ -515,7 +524,7 @@ def solve(
         y_pred = _masked_row_sum(D, jnp.ones((_ROWS,), y0.dtype), order)
         psi = _masked_row_sum(D, gamma_tab, order, lo=1) / gam
         c = h / gam
-        scale = atol + rtol * jnp.abs(y_pred)
+        scale = atol_vec + rtol * jnp.abs(y_pred)
 
         J = jac(t_new, y_pred) if J_stale is None else J_stale
         if pre is None:

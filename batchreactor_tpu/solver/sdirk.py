@@ -101,9 +101,24 @@ class SolveResult:
 #: (S, R) bucket share a single compiled executable.
 NLIVE_KEY = "_nlive"
 
+#: reserved per-lane cfg key carrying a PER-COMPONENT multiplier on
+#: ``atol`` for the scaled error norms — the energy subsystem's T-row
+#: weight (energy/eqns.py: the trailing temperature row of an
+#: adiabatic state lives on a ~1000 K scale, so it gets its own
+#: absolute tolerance ``atol_T`` while the species rows keep the plain
+#: ``atol``).  Same contract as :data:`NLIVE_KEY`: a traced per-lane
+#: ``(n,)`` operand read with ``cfg.get`` at trace time — absent
+#: (every isothermal run) the traced program is byte-identical to the
+#: key not existing (tier-C ``energy-noop-fork``).
+ATOL_SCALE_KEY = "_atol_scale"
 
-def _scaled_norm(e, y, rtol, atol, nlive=None):
-    scale = atol + rtol * jnp.abs(y)
+
+def _scaled_norm(e, y, rtol, atol, nlive=None, atol_scale=None):
+    # atol_scale (ATOL_SCALE_KEY): per-component absolute-tolerance
+    # weight — the energy T-row convention; None traces the scalar-atol
+    # program unchanged
+    a = atol if atol_scale is None else atol * atol_scale
+    scale = a + rtol * jnp.abs(y)
     if nlive is None:
         return jnp.sqrt(jnp.mean(jnp.square(e / scale)))
     # padded-state norm: trailing dead components are exactly 0.0 (zero
@@ -224,9 +239,14 @@ def solve(
     nlive = cfg.get(NLIVE_KEY) if isinstance(cfg, dict) else None
     if nlive is not None:
         nlive = jnp.asarray(nlive, dtype=y0.dtype)
+    # energy T-row weight (ATOL_SCALE_KEY, energy/eqns.py): same
+    # read-at-trace-time contract — absent, the norms are unchanged
+    atol_scale = cfg.get(ATOL_SCALE_KEY) if isinstance(cfg, dict) else None
+    if atol_scale is not None:
+        atol_scale = jnp.asarray(atol_scale, dtype=y0.dtype)
 
     def _norm(e, y):
-        return _scaled_norm(e, y, rtol, atol, nlive)
+        return _scaled_norm(e, y, rtol, atol, nlive, atol_scale)
 
     f = functools.partial(rhs, cfg=cfg)
     if jac is None:

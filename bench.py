@@ -219,9 +219,18 @@ def rung_main():
     live_env = os.environ.get("BENCH_LIVE_PORT", "")
     live_port = int(live_env) if live_env else None
     ragged = os.environ.get("BENCH_RAGGED") == "1"
+    # --ignition preset (docs/energy.md): adiabatic constant-volume h2o2
+    # ensemble over a (T0, p0, phi) grid — PHYSICAL ignition delays from
+    # the energy ODE (max-dT/dt detector), the stiffness-spike stress
+    # test for the BDF order/rejection machinery, and a continuous-
+    # batching showcase (early-igniting lanes blow through their
+    # post-ignition horizon in a handful of giant steps and park early,
+    # so freed slots refill — admission defaults to B/2 like --ragged)
+    ignition = os.environ.get("BENCH_IGNITION") == "1"
     adm_env = os.environ.get("BENCH_ADMISSION", "")
     if adm_env in ("", "0"):
-        admission = max(1, B // 2) if ragged and adm_env == "" else None
+        admission = (max(1, B // 2)
+                     if (ragged or ignition) and adm_env == "" else None)
     else:
         admission = int(adm_env)
     refill = None
@@ -255,18 +264,58 @@ def rung_main():
         solver_kw["setup_economy"] = economy
         if "BENCH_STALE_TOL" in os.environ:
             solver_kw["stale_tol"] = float(os.environ["BENCH_STALE_TOL"])
-    with ph("parse"):
-        gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
-        th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
+    if ignition:
+        # vendored h2o2 (the adiabatic workload is mechanism-light and
+        # stiffness-heavy; GRI-scale adiabatic rungs come later)
+        fix = os.path.join(REPO, "tests", "fixtures")
+        with ph("parse"):
+            gm = br.compile_gaschemistry(f"{fix}/h2o2.dat")
+            th = br.create_thermo(list(gm.species), f"{fix}/therm.dat")
+    else:
+        with ph("parse"):
+            gm = br.compile_gaschemistry(f"{LIB}/grimech.dat")
+            th = br.create_thermo(list(gm.species), f"{LIB}/therm.dat")
     sp = list(gm.species)
-    x0 = np.zeros(len(sp))
-    # the reference's batch_ch4 mixture (/root/reference/test/batch_ch4/batch.xml)
-    x0[sp.index("CH4")], x0[sp.index("O2")], x0[sp.index("N2")] = .25, .5, .25
-    rhs = make_gas_rhs(gm, th)
-    jac = make_gas_jac(gm, th)  # closed-form Jacobian: ~13x cheaper than jacfwd
-    T_grid = jnp.linspace(T_LO, T_HI, B)
-    # O(B)/step observer fold, not an n_save buffer (scatter trap)
-    obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
+    t1 = T1
+    if ignition:
+        from batchreactor_tpu.energy import (DEFAULT_ATOL_T,
+                                             energy_atol_scale,
+                                             energy_ignition_observer,
+                                             make_energy_jac,
+                                             make_energy_rhs)
+        from batchreactor_tpu.solver.sdirk import ATOL_SCALE_KEY
+
+        if "BENCH_T1" not in os.environ:
+            t1 = 1e-3   # coldest (T0, lean) corner ignites inside this
+        # (T0, p0, phi) grid: T0 sweeps the window, pressure and
+        # equivalence ratio cycle — every lane a distinct corner of the
+        # flammability map, with a wide ignition-delay spread (the
+        # admission A/B surface)
+        T0_lo = float(os.environ.get("BENCH_IGN_T_LO", "1000.0"))
+        T0_hi = float(os.environ.get("BENCH_IGN_T_HI", "1300.0"))
+        T_grid = jnp.linspace(T0_lo, T0_hi, B)
+        p_cycle = np.asarray([0.5e5, 1e5, 2e5])[np.arange(B) % 3]
+        phi_cycle = np.asarray([0.5, 1.0, 2.0])[(np.arange(B) // 3) % 3]
+        # H2/O2/N2 at equivalence ratio phi: moles 2*phi / 1 / 3.76
+        X = np.zeros((B, len(sp)))
+        X[:, sp.index("H2")] = 2.0 * phi_cycle
+        X[:, sp.index("O2")] = 1.0
+        X[:, sp.index("N2")] = 3.76
+        X /= X.sum(axis=1, keepdims=True)
+        rhs = make_energy_rhs(gm, th, "adiabatic_v")
+        jac = make_energy_jac(gm, th, "adiabatic_v")
+        obs, obs0 = energy_ignition_observer(len(sp))
+    else:
+        x0 = np.zeros(len(sp))
+        # the reference's batch_ch4 mixture (/root/reference/test/batch_ch4/batch.xml)
+        x0[sp.index("CH4")], x0[sp.index("O2")], x0[sp.index("N2")] = \
+            .25, .5, .25
+        rhs = make_gas_rhs(gm, th)
+        jac = make_gas_jac(gm, th)  # closed-form Jacobian: ~13x cheaper
+        #                             than jacfwd
+        T_grid = jnp.linspace(T_LO, T_HI, B)
+        # O(B)/step observer fold, not an n_save buffer (scatter trap)
+        obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
     seg_steps = int(os.environ.get("BENCH_SEG_STEPS", "256"))
 
     from batchreactor_tpu.obs import LiveRegistry, MetricsServer
@@ -279,12 +328,29 @@ def rung_main():
         log(f"[rung B={B}] live metrics at {live_srv.url}/metrics")
 
     def sweep():
-        rhos = jax.vmap(lambda T: density(jnp.asarray(x0), th.molwt, T, 1e5))(
-            T_grid)
-        y0 = mole_to_mass(jnp.asarray(x0), th.molwt)
-        y0s = rhos[:, None] * y0[None, :]
+        if ignition:
+            # per-lane (T0, p0, phi): density and mass fractions vary
+            # by lane; the state grows the trailing T row and the T-row
+            # atol weight rides the reserved operand (energy/eqns.py)
+            rhos = jax.vmap(
+                lambda x, T, p: density(x, th.molwt, T, p))(
+                jnp.asarray(X), T_grid, jnp.asarray(p_cycle))
+            ys = jax.vmap(lambda x: mole_to_mass(x, th.molwt))(
+                jnp.asarray(X))
+            y0s = jnp.concatenate(
+                [rhos[:, None] * ys, T_grid[:, None]], axis=1)
+            cfgs = {"T": T_grid,
+                    ATOL_SCALE_KEY: energy_atol_scale(
+                        B, y0s.shape[1], ATOL)}
+        else:
+            rhos = jax.vmap(
+                lambda T: density(jnp.asarray(x0), th.molwt, T, 1e5))(
+                T_grid)
+            y0 = mole_to_mass(jnp.asarray(x0), th.molwt)
+            y0s = rhos[:, None] * y0[None, :]
+            cfgs = {"T": T_grid}
         return ensemble_solve_segmented(
-            rhs, y0s, 0.0, T1, {"T": T_grid}, rtol=RTOL, atol=ATOL,
+            rhs, y0s, 0.0, t1, cfgs, rtol=RTOL, atol=ATOL,
             segment_steps=seg_steps, jac=jac,
             linsolve=os.environ.get("BENCH_LINSOLVE", "auto"),
             method=method, **solver_kw,
@@ -347,7 +413,12 @@ def rung_main():
                   "platform": jax.default_backend()})
         write_jsonl(os.path.join(REPO, "bench_obs.jsonl"), report)
         log(f"[rung B={B}] obs report -> bench_obs.jsonl")
-    tau = np.asarray(res.observed["tau"])
+    if ignition:
+        from batchreactor_tpu.energy import extract_delay
+
+        tau = np.asarray(extract_delay(res.observed))
+    else:
+        tau = np.asarray(res.observed["tau"])
     # segmented execution gear actually run (BENCH_PIPELINE=0 reverts to
     # the blocking per-segment host loop, BENCH_POLL_EVERY sets the
     # termination-poll stride; ONE resolution rule, parallel/sweep.py)
@@ -359,7 +430,8 @@ def rung_main():
     # mode can diverge from the one that actually ran
     linsolve_resolved = resolve_linsolve(
         os.environ.get("BENCH_LINSOLVE", "auto"), method=method,
-        platform=jax.default_backend(), batch=B, n=len(sp))
+        platform=jax.default_backend(), batch=B,
+        n=len(sp) + (1 if ignition else 0))
     bound_live_port = live_srv.port if live_srv is not None else None
     if live_srv is not None:
         live_srv.close()
@@ -377,6 +449,14 @@ def rung_main():
         # ragged-preset A/B surface (null occupancy = no recorder ran)
         "admission": admission,
         "ragged": ragged,
+        # --ignition preset: adiabatic h2o2 (T0, p0, phi) grid; the
+        # per-rung ignition-delay spread quantiles are THE physical QoI
+        # (max-dT/dt detector, energy/ignition.py)
+        "ignition": ignition,
+        "energy": "adiabatic_v" if ignition else None,
+        "tau_spread": ([round(float(v), 12) for v in
+                        np.nanpercentile(tau, [10, 50, 90])]
+                       if ignition and np.isfinite(tau).any() else None),
         "occupancy": occ,
         "admitted_lanes": ctr_delta.get("admitted_lanes", 0),
         "compactions": ctr_delta.get("compactions", 0),
@@ -445,6 +525,13 @@ def _workload_fingerprint():
     """Identifies the measured workload: cache entries from a differently
     parameterized run (shorter horizon, other T window, other tolerances)
     must never be reported as the headline metric."""
+    if os.environ.get("BENCH_IGNITION") == "1":
+        return {"preset": "ignition", "energy": "adiabatic_v",
+                "T0_lo": float(os.environ.get("BENCH_IGN_T_LO", "1000.0")),
+                "T0_hi": float(os.environ.get("BENCH_IGN_T_HI", "1300.0")),
+                "t1": float(os.environ.get("BENCH_T1", "1e-3")),
+                "rtol": RTOL, "atol": ATOL,
+                "mixture": "h2o2 H2/O2/N2 phi 0.5/1/2 x p 0.5/1/2 bar"}
     return {"T_lo": T_LO, "T_hi": T_HI, "t1": T1, "rtol": RTOL, "atol": ATOL,
             "mixture": "GRI30 CH4/O2/N2 0.25/0.5/0.25 1bar"}
 
@@ -569,7 +656,9 @@ def emit_result(best, state, cached_tpu=False):
     log(f"best rung B={best['B']}: {best['cps']} cond/s; "
         f"baseline {sec_per_lane:.3f}s/lane -> speedup {speedup:.1f}x")
     out = {
-        "metric": "GRI30_ignition_sweep_throughput",
+        "metric": ("h2o2_adiabatic_ignition_throughput"
+                   if os.environ.get("BENCH_IGNITION") == "1"
+                   else "GRI30_ignition_sweep_throughput"),
         "value": best["cps"],
         "unit": "conditions/sec",
         "vs_baseline": round(speedup, 3),
@@ -620,6 +709,17 @@ def parse_args(argv):
                         "watchable mid-flight; the rung json records "
                         "live_port for the endpoint-overhead A/B "
                         "(BENCH_LIVE_PORT is the env twin)")
+    p.add_argument("--ignition", action="store_true",
+                   help="adiabatic-ignition rung preset (docs/energy.md): "
+                        "constant-volume h2o2 energy-mode ensemble over a "
+                        "(T0, p0, phi) grid — physical ignition delays "
+                        "from the max-dT/dt detector, per-rung tau-spread "
+                        "quantiles, and continuous batching on by default "
+                        "at B/2 resident slots (early-igniting lanes park "
+                        "early; BENCH_ADMISSION=0 is the A/B off-arm).  "
+                        "BENCH_IGNITION is the env twin; BENCH_IGN_T_LO/"
+                        "HI set the T0 window, BENCH_T1 the horizon "
+                        "(default 1e-3 s)")
     p.add_argument("--ragged", action="store_true",
                    help="ragged-horizon rung preset: widens the T window "
                         "to 1100-2000 K (a stratified spread of per-lane "
@@ -649,6 +749,11 @@ if __name__ == "__main__":
             # env twin so the rung CHILDREN (which re-exec this file
             # with BENCH_MODE=rung and no argv) inherit the knob
             os.environ["BENCH_LIVE_PORT"] = str(args.live_port)
+        if args.ignition:
+            # env twin so the rung children (re-exec'd with
+            # BENCH_MODE=rung, no argv) inherit the preset — and so the
+            # parent's workload fingerprint names it
+            os.environ["BENCH_IGNITION"] = "1"
         if args.ragged:
             # explicit T_LO so the parent's workload fingerprint and the
             # rung children agree on the measured window (the banked-rung

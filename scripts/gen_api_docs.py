@@ -29,6 +29,30 @@ SECTIONS = [
      ["batch_reactor", "batch_reactor_sweep", "Chemistry",
       "SensitivityProblem", "SensitivitySolution", "compile_gaschemistry",
       "compile_mech", "create_thermo", "input_data"]),
+    # the intro carries the mode table — docstring first paragraphs are
+    # prose-wrapped, so tables live here
+    ("Non-isothermal reactors (energy equation)", "batchreactor_tpu.energy",
+     ["resolve_energy", "make_energy_rhs", "make_energy_jac",
+      "extend_states", "energy_cfg", "energy_atol_scale",
+      "energy_ignition_observer", "extract_delay", "merge_observers",
+      "interp_crossing", "grid_crossing", "temperature_ignition_qoi",
+      "delay_sensitivity_forward"],
+     """\
+The energy subsystem (equations, T-row norm convention, ignition-delay
+semantics: docs/energy.md) adds the temperature ODE behind the
+``energy=`` knob of ``batch_reactor_sweep``:
+
+| ``energy=``       | family                         | state           |
+|-------------------|--------------------------------|-----------------|
+| ``None`` (default)| isothermal (reference physics) | ``[rho_k]``     |
+| ``"adiabatic_v"`` | adiabatic, constant volume     | ``[rho_k, T]``  |
+| ``"adiabatic_p"`` | adiabatic, constant pressure   | ``[rho_k, T]``  |
+
+``energy=None`` is a traced no-op (tier-C ``energy-noop-fork``); the
+non-None modes return per-lane ``out["T"]`` / ``out["ignition_delay"]``
+and weight the T row's error norm at ``atol_T`` through the reserved
+``_atol_scale`` operand.
+"""),
     ("Parameter sensitivities", "batchreactor_tpu.sensitivity",
      ["select", "extract", "apply", "names", "ParamSpec", "make_fdot",
       "solve_forward", "solve_adjoint", "final_species_qoi",

@@ -100,8 +100,17 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
               segment_steps=256, mesh=None, rtol=1e-6, atol=1e-10,
               n_spot=8, method="bdf", jac_window=8, sort_lanes=True,
               pipeline=None, poll_every=None, admission=None, refill=None,
-              record_occupancy=False, log=print):
-    """Run the T x phi GRI ignition map; return the result record dict."""
+              record_occupancy=False, energy=None, log=print):
+    """Run the T x phi GRI ignition map; return the result record dict.
+
+    ``energy`` (NORTHSTAR_ENERGY=0/1 — docs/energy.md) switches the map
+    to the adiabatic constant-volume family: the state grows the
+    trailing T row, tau comes from the physical max-dT/dt detector
+    instead of the CH4 half-consumption proxy, and the native-BDF spot
+    check is skipped (the C++ runtime is isothermal-only).  The A/B
+    pair at one grid is the next healthy-chip lever: expect the
+    stiffness spike at ignition to shift the order histogram down and
+    the err-reject count up (PERF.md round-12 has the CPU signature)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -134,10 +143,23 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
         X = premixed_mole_fracs(sp, "CH4", grid["phi"], stoich_o2=2.0,
                                 diluent="N2", o2_to_diluent=0.5)
         y0s = sweep_solution_vectors(X, th.molwt, grid["T"], p)
-        rhs = make_gas_rhs(gm, th)
-        jac = make_gas_jac(gm, th)
-        obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
         cfgs = {"T": grid["T"]}
+        if energy is not None:
+            from batchreactor_tpu.energy import (
+                energy_atol_scale, energy_ignition_observer,
+                make_energy_jac, make_energy_rhs)
+            from batchreactor_tpu.solver.sdirk import ATOL_SCALE_KEY
+
+            rhs = make_energy_rhs(gm, th, energy)
+            jac = make_energy_jac(gm, th, energy)
+            obs, obs0 = energy_ignition_observer(len(sp))
+            y0s = jnp.concatenate([y0s, grid["T"][:, None]], axis=1)
+            cfgs[ATOL_SCALE_KEY] = energy_atol_scale(
+                B, int(y0s.shape[1]), atol)
+        else:
+            rhs = make_gas_rhs(gm, th)
+            jac = make_gas_jac(gm, th)
+            obs, obs0 = ignition_observer(sp.index("CH4"), mode="half")
 
     solve_kw = dict(rtol=rtol, atol=atol, jac=jac, observer=obs,
                     observer_init=obs0, mesh=mesh, method=method,
@@ -176,7 +198,8 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
                                      chunk_size=chunk_size,
                                      lane_cost=lane_cost, chunk_log=log,
                                      admission=admission, refill=refill,
-                                     recorder=obs_rec, **solve_kw)
+                                     recorder=obs_rec, energy=energy,
+                                     **solve_kw)
         else:
             kw = {k: v for k, v in solve_kw.items() if k != "segment_steps"}
             res = ensemble_solve_segmented(rhs, y0s, 0.0, t1, cfgs,
@@ -194,7 +217,12 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
         adm_ctrs = obs_rec.snapshot()[2]
         occ = _C.occupancy(adm_ctrs)
 
-    tau = np.asarray(res.observed["tau"])
+    if energy is not None:
+        from batchreactor_tpu.energy import extract_delay
+
+        tau = np.asarray(extract_delay(res.observed))
+    else:
+        tau = np.asarray(res.observed["tau"])
     status = np.asarray(res.status)
     if segment_steps and int(segment_steps) > 0:
         gear_run, stride_run = resolve_pipeline_defaults(pipeline,
@@ -212,6 +240,11 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
     # --- tau parity spot-check against the independent native C++ BDF ----
     parity = None
     spot = []
+    if energy is not None:
+        # the native C++ BDF oracle is isothermal-only: no parity spot
+        # check exists for the adiabatic family yet (recorded as null,
+        # not silently green)
+        n_spot = 0
     if n_spot:
         from batchreactor_tpu import native
 
@@ -258,7 +291,11 @@ def run_sweep(n_T=64, n_phi=64, T_lo=1500.0, T_hi=2000.0, phi_lo=0.6,
 
     return {
         "workload": f"GRI30 {n_T}x{n_phi} TxPhi ignition map, 1 bar, "
-                    f"t1={t1}, rtol={rtol} atol={atol}",
+                    f"t1={t1}, rtol={rtol} atol={atol}"
+                    + (f", energy={energy}" if energy else ""),
+        # NORTHSTAR_ENERGY: null = isothermal reference physics, else
+        # the adiabatic mode the map ran (docs/energy.md)
+        "energy": energy,
         "method": method,
         "exp32": os.environ.get("BR_EXP32") == "1",
         "jac_window": jac_window,
@@ -324,6 +361,14 @@ def main():
                         else True if os.environ["NORTHSTAR_ADMISSION"] == "1"
                         else int(os.environ["NORTHSTAR_ADMISSION"])),
                     record_occupancy="NORTHSTAR_ADMISSION" in os.environ,
+                    # NORTHSTAR_ENERGY=0/1 (or a mode literal): the
+                    # adiabatic A/B lever — 1 = adiabatic_v (docs/
+                    # energy.md), the next healthy-chip A/B pair
+                    energy=(None if os.environ.get(
+                        "NORTHSTAR_ENERGY", "0") in ("0", "")
+                        else "adiabatic_v"
+                        if os.environ["NORTHSTAR_ENERGY"] == "1"
+                        else os.environ["NORTHSTAR_ENERGY"]),
                     log=lambda m: print(m, file=sys.stderr, flush=True))
     out = os.environ.get("NORTHSTAR_OUT", os.path.join(REPO,
                                                        "NORTHSTAR.json"))

@@ -1,0 +1,528 @@
+"""energy/ subsystem tests (ISSUE 14 acceptance gates).
+
+Covers: the adiabatic RHS/analytic-Jacobian exactness (both modes,
+vs ``jax.jacfwd`` to roundoff); the ``energy=`` grammar (loud errors
+naming the accepted literals, incompatible-knob rejections); adiabatic
+constant-volume h2o2 ignition end-to-end through ``batch_reactor_sweep``
+(monolithic == segmented bit-exact at jac_window=1; admission parity;
+``out["T"]`` / ``out["ignition_delay"]`` semantics); padded-vs-unpadded
+step-count identity with the T row live; the energy-off structure guard
+(energy=None changes neither the result surface nor the traced solver
+program); checkpoint-resume with the energy fingerprint pin
+(SCHEMA_KNOBS); FD-golden dtau_ign/d(lnA) for the forward-IFT and
+adjoint gradient passes (tol-tiered like tests/test_sensitivity.py);
+and the serving-plane grammar (schema literals, pack-key isolation,
+request-lane packing parity).
+
+Everything runs on the CPU backend (conftest pins it) against
+tests/fixtures — no reference checkout needed.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import batchreactor_tpu as br
+from batchreactor_tpu.api import Chemistry, batch_reactor_sweep
+from batchreactor_tpu.energy import (DEFAULT_ATOL_T, ENERGY_MODES, eqns,
+                                     ignition)
+from batchreactor_tpu.models.gas import compile_gaschemistry
+from batchreactor_tpu.models.thermo import create_thermo
+from batchreactor_tpu.sensitivity import adjoint, params
+from batchreactor_tpu.solver import bdf
+from batchreactor_tpu.solver.sdirk import (ATOL_SCALE_KEY, SUCCESS,
+                                           _scaled_norm)
+from batchreactor_tpu.utils.composition import density, mole_to_mass
+
+X_MIX = {"H2": 0.3, "O2": 0.2, "N2": 0.5}
+
+
+@pytest.fixture(scope="module")
+def h2o2(fixtures_dir):
+    gm = compile_gaschemistry(os.path.join(fixtures_dir, "h2o2.dat"))
+    th = create_thermo(list(gm.species), os.path.join(fixtures_dir,
+                                                      "therm.dat"))
+    sp = list(gm.species)
+    x = np.zeros(len(sp))
+    x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = 0.3, 0.2, 0.5
+    x = jnp.asarray(x, dtype=jnp.float64)
+    y_gas = density(x, th.molwt, 1100.0, 1e5) * mole_to_mass(x, th.molwt)
+    y0e = jnp.concatenate([y_gas, jnp.asarray([1100.0])])
+    return gm, th, sp, y_gas, y0e
+
+
+@pytest.fixture(scope="module")
+def energy_theta(h2o2):
+    """3-reaction log_A selection over the ADIABATIC constant-volume
+    RHS — the physical-ignition-gradient fixture."""
+    gm, th, sp, _, _ = h2o2
+    spec = params.select(gm, fields=("log_A",), reactions=(0, 1, 5))
+    theta = params.extract(gm, spec)
+    rhs_theta = params.make_rhs_theta(
+        gm, spec, lambda m: eqns.make_energy_rhs(m, th, "adiabatic_v"))
+
+    def jac_theta(t, y, theta, cfg):
+        return eqns.make_energy_jac(params.apply(gm, theta, spec), th,
+                                    "adiabatic_v")(t, y, cfg)
+
+    return spec, theta, rhs_theta, jac_theta
+
+
+# ---------------------------------------------------------------------------
+# equations: RHS physics + analytic-Jacobian exactness
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ENERGY_MODES)
+def test_energy_jacobian_matches_jacfwd(h2o2, mode):
+    gm, th, sp, _, y0e = h2o2
+    rhs = eqns.make_energy_rhs(gm, th, mode)
+    jac = eqns.make_energy_jac(gm, th, mode)
+    cfg = {}
+    Ja = np.asarray(jac(0.0, y0e, cfg))
+    Jf = np.asarray(jax.jacfwd(lambda y: rhs(0.0, y, cfg))(y0e))
+    scale = np.abs(Jf) + 1e-6 * np.max(np.abs(Jf))
+    assert np.max(np.abs(Ja - Jf) / scale) < 1e-11
+
+
+@pytest.mark.parametrize("mode", ENERGY_MODES)
+def test_energy_rhs_species_rows(h2o2, mode):
+    """The species block closes on the isothermal production rates: at
+    constant volume exactly; at constant pressure up to the dilution
+    term (which sums to the thermal-expansion closure)."""
+    from batchreactor_tpu.ops.rhs import make_gas_rhs
+
+    gm, th, sp, y_gas, y0e = h2o2
+    dy = eqns.make_energy_rhs(gm, th, mode)(0.0, y0e, {})
+    iso = make_gas_rhs(gm, th)(0.0, y_gas, {"T": jnp.asarray(1100.0)})
+    if mode == "adiabatic_v":
+        np.testing.assert_array_equal(np.asarray(dy[:-1]), np.asarray(iso))
+    else:
+        # dilution preserves Ctot = p/(RT): d(sum c)/dt == -Ctot/T dT/dt
+        conc_dot = np.asarray(dy[:-1]) / np.asarray(th.molwt)
+        Ctot = float(jnp.sum(y0e[:-1] / th.molwt))
+        assert np.isclose(conc_dot.sum(),
+                          -Ctot / 1100.0 * float(dy[-1]), rtol=1e-10)
+
+
+def test_resolve_energy_grammar():
+    assert eqns.resolve_energy(None) is None
+    assert eqns.resolve_energy(False) is None
+    assert eqns.resolve_energy("adiabatic_v") == "adiabatic_v"
+    with pytest.raises(ValueError, match="adiabatic_v.*adiabatic_p"):
+        eqns.resolve_energy("isothermal")
+    # the schema's jax-free duplicate must never drift from the one rule
+    from batchreactor_tpu.serving import schema
+
+    assert tuple(schema.ENERGY_MODES) == tuple(ENERGY_MODES)
+
+
+def test_atol_scale_norm_weighting():
+    """The T-row weight enters the scaled norm exactly as atol * w."""
+    e = jnp.asarray([1e-8, 1e-8, 1.0])
+    y = jnp.zeros(3)
+    w = jnp.asarray([1.0, 1.0, 1e6])
+    plain = _scaled_norm(e, y, 1e-6, 1e-10)
+    weighted = _scaled_norm(e, y, 1e-6, 1e-10, None, w)
+    # hand-rolled reference: scale = atol*w + rtol*|y|
+    expect = float(jnp.sqrt(jnp.mean(
+        jnp.square(e / (1e-10 * w + 1e-6 * jnp.abs(y))))))
+    assert np.isclose(float(weighted), expect, rtol=1e-12)
+    # the big T-row error is forgiven by its big atol (factor ~1e6)
+    assert float(weighted) < float(plain) / 1e5
+    with pytest.raises(ValueError, match="atol_T"):
+        eqns.energy_atol_scale(2, 4, 1e-10, atol_T=-1.0)
+
+
+def test_padded_thermo_inert_rows(h2o2):
+    """Dead species carry cp = R, h = RT (so Cv = u = 0 in the energy
+    sums — models/padding.py inertness contract)."""
+    from batchreactor_tpu.models.padding import pad_thermo
+    from batchreactor_tpu.ops.thermo import cp_h_s_over_R
+    from batchreactor_tpu.utils.constants import R
+
+    _, th, sp, _, _ = h2o2
+    thp = pad_thermo(th, len(sp) + 3)
+    cp_R, h_RT, _ = cp_h_s_over_R(jnp.asarray(1234.5), thp)
+    assert np.allclose(np.asarray(cp_R)[-3:], 1.0)   # cp = R
+    assert np.allclose(np.asarray(h_RT)[-3:], 1.0)   # h = RT
+    # => Cv = cp - R = 0 and u = h - RT = 0 exactly
+    assert float(cp_R[-1] * R - R) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the sweep surface (acceptance: end-to-end adiabatic ignition)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def chem_gas():
+    return Chemistry(gaschem=True)
+
+
+@pytest.fixture(scope="module")
+def adiabatic_mono(h2o2, chem_gas):
+    gm, th, *_ = h2o2
+    T = np.linspace(1050.0, 1250.0, 5)
+    out = batch_reactor_sweep(X_MIX, T, 1e5, 2e-4, chem=chem_gas,
+                              thermo_obj=th, md=gm, energy="adiabatic_v")
+    return T, out
+
+
+def test_adiabatic_v_ignites(adiabatic_mono):
+    T, out = adiabatic_mono
+    assert (out["status"] == SUCCESS).all()
+    # thermal runaway: every lane ends far above its initial T
+    assert (out["T"] > T + 1500.0).all()
+    tau = out["ignition_delay"]
+    assert np.isfinite(tau).all()
+    # hotter lanes ignite earlier (the physical ignition-delay table)
+    assert (np.diff(tau) < 0).all()
+    # species surface unchanged: mole fractions sum to 1 per lane
+    x_sum = sum(out["x"].values())
+    np.testing.assert_allclose(x_sum, 1.0, rtol=1e-12)
+
+
+def test_segmented_matches_monolithic_bit_exact(h2o2, chem_gas,
+                                                adiabatic_mono):
+    gm, th, *_ = h2o2
+    T, out = adiabatic_mono
+    seg = batch_reactor_sweep(X_MIX, T, 1e5, 2e-4, chem=chem_gas,
+                              thermo_obj=th, md=gm, energy="adiabatic_v",
+                              segment_steps=64)
+    np.testing.assert_array_equal(seg["T"], out["T"])
+    np.testing.assert_array_equal(seg["t"], out["t"])
+    np.testing.assert_array_equal(seg["ignition_delay"],
+                                  out["ignition_delay"])
+    for s in out["x"]:
+        np.testing.assert_array_equal(seg["x"][s], out["x"][s])
+
+
+def test_admission_stream_parity(h2o2, chem_gas, adiabatic_mono):
+    """Streaming admission (segmented driver, PR-8 gear) carries the
+    extended state: positionally identical delays, T within the
+    documented companion-set ulp class."""
+    gm, th, *_ = h2o2
+    T, out = adiabatic_mono
+    adm = batch_reactor_sweep(X_MIX, T, 1e5, 2e-4, chem=chem_gas,
+                              thermo_obj=th, md=gm, energy="adiabatic_v",
+                              segment_steps=64, admission=3, refill=1)
+    assert (adm["status"] == SUCCESS).all()
+    np.testing.assert_allclose(adm["T"], out["T"], rtol=1e-9)
+    np.testing.assert_allclose(adm["ignition_delay"],
+                               out["ignition_delay"], rtol=1e-9)
+
+
+def test_padded_step_count_identity(h2o2, chem_gas):
+    """Mechanism padding with the T row live: step counts and order
+    histograms identical padded vs unpadded (the PR-13 contract
+    extended to the energy norm)."""
+    gm, th, *_ = h2o2
+    T = np.linspace(1100.0, 1200.0, 3)
+    kw = dict(chem=chem_gas, thermo_obj=th, md=gm, energy="adiabatic_v",
+              telemetry=True)
+    pad = batch_reactor_sweep(X_MIX, T, 1e5, 1e-4,
+                              species_buckets=(16,),
+                              reaction_buckets=(32,), **kw)
+    raw = batch_reactor_sweep(X_MIX, T, 1e5, 1e-4, **kw)
+    pl = pad["telemetry"]["solver_stats"]["per_lane"]
+    ul = raw["telemetry"]["solver_stats"]["per_lane"]
+    np.testing.assert_array_equal(pl["n_accepted"], ul["n_accepted"])
+    np.testing.assert_array_equal(pl["n_rejected"], ul["n_rejected"])
+    np.testing.assert_array_equal(pl["order_hist"], ul["order_hist"])
+    np.testing.assert_allclose(pad["T"], raw["T"], rtol=1e-12)
+    assert pad["telemetry"]["meta"]["energy"] == "adiabatic_v"
+
+
+def test_energy_off_structure_guard(h2o2, chem_gas):
+    """energy=None is a no-op: the result surface carries no energy
+    keys, the cfg dict is untouched (same object), and the traced
+    solver program is byte-identical with or without the energy cfg
+    pass."""
+    gm, th, sp, y_gas, _ = h2o2
+    out = batch_reactor_sweep(X_MIX, np.asarray([1100.0]), 1e5, 1e-6,
+                              chem=chem_gas, thermo_obj=th, md=gm)
+    assert "T" not in out and "ignition_delay" not in out
+    assert sorted(out) == ["report", "status", "t", "x"]
+    cfg = {"T": jnp.asarray(1100.0)}
+    assert eqns.energy_cfg(cfg, None, 1, len(sp), 1e-10) is cfg
+
+    from batchreactor_tpu.ops.rhs import make_gas_jac, make_gas_rhs
+
+    rhs, jac = make_gas_rhs(gm, th), make_gas_jac(gm, th)
+
+    def run(cfg_):
+        def f(y):
+            return bdf.solve(rhs, y, 0.0, 1e-8, cfg_, rtol=1e-6,
+                             atol=1e-10, max_steps=3, jac=jac).y
+        return str(jax.make_jaxpr(f)(y_gas))
+
+    assert run(cfg) == run(eqns.energy_cfg(cfg, None, 1, len(sp), 1e-10))
+    # and the weighted program IS different (the key is live, not dead)
+    cfg_e = dict(cfg)
+    cfg_e[ATOL_SCALE_KEY] = jnp.ones_like(y_gas)
+    assert run(cfg_e) != run(cfg)
+
+
+def test_energy_validation_errors(h2o2, chem_gas):
+    gm, th, *_ = h2o2
+    smd = None
+    with pytest.raises(ValueError, match="adiabatic_v"):
+        batch_reactor_sweep(X_MIX, 1100.0, 1e5, 1e-5, chem=chem_gas,
+                            thermo_obj=th, md=gm, energy="bogus")
+    with pytest.raises(ValueError, match="atol_T"):
+        batch_reactor_sweep(X_MIX, 1100.0, 1e5, 1e-5, chem=chem_gas,
+                            thermo_obj=th, md=gm, atol_T=1e-3)
+    with pytest.raises(ValueError, match="isothermal-only"):
+        batch_reactor_sweep(X_MIX, 1100.0, 1e5, 1e-5, chem=chem_gas,
+                            thermo_obj=th, md=gm, energy="adiabatic_v",
+                            quarantine={"oracle": True})
+    with pytest.raises(ValueError, match="gas chemistry only"):
+        batch_reactor_sweep({"H2": 1.0}, 1100.0, 1e5, 1e-5,
+                            chem=Chemistry(userchem=True,
+                                           udf=lambda t, s: 0.0),
+                            thermo_obj=th, energy="adiabatic_v")
+
+
+def test_merge_observers_collision():
+    obs, init = ignition.energy_ignition_observer(3)
+    with pytest.raises(ValueError, match="collide"):
+        ignition.merge_observers(obs, init, obs, init)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint resume: the extended state + the SCHEMA_KNOBS pin
+# ---------------------------------------------------------------------------
+def test_checkpoint_resume_energy(h2o2, tmp_path):
+    from batchreactor_tpu.parallel.checkpoint import checkpointed_sweep
+
+    gm, th, sp, _, _ = h2o2
+    B = 4
+    T = jnp.linspace(1100.0, 1200.0, B)
+    x = np.zeros(len(sp))
+    x[sp.index("H2")], x[sp.index("O2")], x[sp.index("N2")] = .3, .2, .5
+    rhos = jax.vmap(lambda t: density(jnp.asarray(x), th.molwt, t, 1e5))(T)
+    y0s = jnp.concatenate(
+        [rhos[:, None] * mole_to_mass(jnp.asarray(x), th.molwt)[None, :],
+         T[:, None]], axis=1)
+    cfgs = {"T": T, ATOL_SCALE_KEY: eqns.energy_atol_scale(
+        B, int(y0s.shape[1]), 1e-10)}
+    rhs = eqns.make_energy_rhs(gm, th, "adiabatic_v")
+    jac = eqns.make_energy_jac(gm, th, "adiabatic_v")
+    obs, obs0 = ignition.energy_ignition_observer(len(sp))
+    kw = dict(chunk_size=2, jac=jac, observer=obs, observer_init=obs0,
+              energy="adiabatic_v")
+    ck = str(tmp_path / "ck")
+    r1 = checkpointed_sweep(rhs, y0s, 0.0, 1e-4, cfgs, ck, **kw)
+    # resume: chunks load from disk, results identical
+    r2 = checkpointed_sweep(rhs, y0s, 0.0, 1e-4, cfgs, ck, **kw)
+    np.testing.assert_array_equal(np.asarray(r1.y), np.asarray(r2.y))
+    np.testing.assert_array_equal(np.asarray(r1.observed["ign_tau_dT"]),
+                                  np.asarray(r2.observed["ign_tau_dT"]))
+    # the energy mode PINS the fingerprint: a resume that drops (or
+    # changes) the declaration fails loudly instead of serving chunks
+    # from a different state schema
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpointed_sweep(rhs, y0s, 0.0, 1e-4, cfgs, ck,
+                           **{**kw, "energy": "adiabatic_p"})
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpointed_sweep(rhs, y0s, 0.0, 1e-4, cfgs, ck,
+                           **{**kw, "energy": None})
+
+
+def test_fingerprint_energy_knob(h2o2):
+    """SCHEMA_KNOBS registry behavior: the energy declaration moves the
+    hash; explicit None fingerprints identical to absent."""
+    from batchreactor_tpu.parallel import checkpoint as ck
+
+    def rhs(t, y, cfg):
+        return -y
+
+    y0s = np.ones((2, 2))
+    cfgs = {"k": np.ones((2,))}
+    base = ck._sweep_fingerprint(rhs, y0s, cfgs, {})
+    assert ck._sweep_fingerprint(rhs, y0s, cfgs,
+                                 {"energy": "adiabatic_v"}) != base
+    assert "energy" in ck.SCHEMA_KNOBS
+
+
+# ---------------------------------------------------------------------------
+# gradients: FD-golden dtau_ign/d(lnA), forward IFT and adjoint
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tau_gradients(h2o2, energy_theta):
+    """One forward-IFT gradient pass shared by the FD and adjoint
+    comparisons (rtol 1e-8 — the docs/sensitivity.md tangent tier)."""
+    gm, th, sp, _, y0e = h2o2
+    spec, theta, rhs_theta, jac_theta = energy_theta
+    cfg = {ATOL_SCALE_KEY: jnp.ones_like(y0e).at[-1].set(
+        DEFAULT_ATOL_T / 1e-12)}
+    tau, grad, aux = ignition.delay_sensitivity_forward(
+        rhs_theta, y0e, theta, cfg, len(sp), t_max=2e-4, jac=jac_theta,
+        rtol=1e-8, atol=1e-12)
+    assert aux["ignited"] and aux["Tdot"] > 0
+    return cfg, tau, np.asarray(grad["log_A"]), aux
+
+
+def test_forward_ift_vs_fd(h2o2, energy_theta, tau_gradients):
+    """dtau_ign/d(lnA) via the forward IFT pass vs central finite
+    differences of the threshold-crossing detector (tier: 5e-3 relative
+    — the FD noise floor of an interpolated crossing at eps=1e-4)."""
+    gm, th, sp, _, y0e = h2o2
+    spec, theta, rhs_theta, jac_theta = energy_theta
+    cfg, tau, gf, _ = tau_gradients
+    obs, obs0 = ignition.energy_ignition_observer(len(sp))
+
+    def tau_of(th_):
+        def r(t, y, cfg):
+            return rhs_theta(t, y, th_, cfg)
+
+        def j(t, y, cfg):
+            return jac_theta(t, y, th_, cfg)
+
+        res = bdf.solve(r, y0e, 0.0, 2e-4, cfg, rtol=1e-8, atol=1e-12,
+                        jac=j, observer=obs, observer_init=obs0)
+        return float(np.asarray(res.observed["ign_tau_thr"]))
+
+    eps = 1e-4
+    for i in range(gf.shape[0]):
+        tp = {"log_A": theta["log_A"].at[i].add(eps)}
+        tm = {"log_A": theta["log_A"].at[i].add(-eps)}
+        fd = (tau_of(tp) - tau_of(tm)) / (2 * eps)
+        assert abs(gf[i] - fd) < 5e-3 * abs(fd) + 1e-12, (i, gf[i], fd)
+
+
+def test_adjoint_vs_forward_ift(h2o2, energy_theta, tau_gradients):
+    """The adjoint temperature-threshold QoI agrees with the forward
+    IFT gradient (tier: 1e-2 relative — two independent
+    discretizations of the same crossing)."""
+    gm, th, sp, _, y0e = h2o2
+    spec, theta, rhs_theta, jac_theta = energy_theta
+    cfg, tau, gf, _ = tau_gradients
+    qoi_fn = ignition.temperature_ignition_qoi(len(sp))
+    qoi, grad, aux = adjoint.solve_adjoint(
+        rhs_theta, qoi_fn, y0e, 0.0, 2e-4, theta, cfg,
+        jac_theta=jac_theta, rtol=1e-8, atol=1e-12, grid_size=1024,
+        segments=8)
+    assert not bool(aux["truncated"])
+    assert abs(float(qoi) - tau) < 5e-3 * tau
+    ga = np.asarray(grad["log_A"])
+    np.testing.assert_allclose(ga, gf, rtol=1e-2)
+
+
+def test_adjoint_species_qoi_delegates(h2o2):
+    """The promoted crossing helper serves the legacy species QoI: the
+    refactored adjoint detector reproduces the observer's tau."""
+    tk = jnp.linspace(0.0, 1.0, 11)
+    m = jnp.asarray(1.0 - tk)          # falls through 0.5 at t=0.5
+    q = adjoint.ignition_delay_qoi(0, frac=0.5)
+    tau = q(tk, m[:, None], m[-1:])
+    assert np.isclose(float(tau), 0.5)
+    # rising crossing (the temperature form) through the same helper
+    assert np.isclose(float(ignition.grid_crossing(tk, 2.0 * tk, 1.0,
+                                                   rising=True)), 0.5)
+    # never-crossed -> NaN (both directions)
+    assert np.isnan(float(ignition.grid_crossing(tk, m, -1.0)))
+
+
+# ---------------------------------------------------------------------------
+# serving plane: grammar + lane packing
+# ---------------------------------------------------------------------------
+def test_schema_energy_grammar():
+    from batchreactor_tpu.serving import schema
+
+    base = {"id": "r", "T": 1100.0, "X": {"H2": 1.0}, "t1": 1e-4}
+    req = schema.validate_request({**base, "energy": "adiabatic_v"},
+                                  energy_modes=("adiabatic_v",))
+    assert req.energy == "adiabatic_v"
+    assert req.pack_key() == (1e-4, 1e-6, 1e-10, "adiabatic_v")
+    # isothermal pack key carries the None slot (never collides)
+    req0 = schema.validate_request(base, energy_modes=("adiabatic_v",))
+    assert req0.pack_key() == (1e-4, 1e-6, 1e-10, None)
+    # unknown literal: the error NAMES the accepted modes
+    with pytest.raises(ValueError,
+                       match=r"adiabatic_v.*adiabatic_p"):
+        schema.validate_request({**base, "energy": "adiabatic"},
+                                energy_modes=("adiabatic_v",))
+    # a mode the session never warmed
+    with pytest.raises(ValueError, match="not enabled"):
+        schema.validate_request({**base, "energy": "adiabatic_p"},
+                                energy_modes=("adiabatic_v",))
+    with pytest.raises(ValueError, match="not enabled"):
+        schema.validate_request({**base, "energy": "adiabatic_v"})
+    # incompatible knob: Asv with an energy mode rejects loudly
+    with pytest.raises(ValueError, match="Asv"):
+        schema.validate_request(
+            {**base, "energy": "adiabatic_v", "Asv": 2.0},
+            energy_modes=("adiabatic_v",))
+
+
+def test_session_energy_lanes(h2o2):
+    """Session lane packing matches the api's energy state construction
+    (trailing T row + T-row atol weight)."""
+    from batchreactor_tpu.serving import schema
+    from batchreactor_tpu.serving.session import SolverSession, load_spec
+
+    gm, th, *_ = h2o2
+    spec = load_spec({"mechanism": {"mech": "x", "therm": "y"},
+                      "solver": {"segment_steps": 16,
+                                 "energy_modes": ["adiabatic_v"]},
+                      "serve": {"resident": 2, "buckets": None}})
+    sess = SolverSession(gm, th, spec)
+    req = schema.validate_request(
+        {"id": "e", "T": [1100.0, 1200.0], "X": X_MIX, "t1": 1e-4,
+         "energy": "adiabatic_v"},
+        species=sess.species, energy_modes=spec.energy_modes)
+    y0, cfg = sess.request_lanes(req)
+    assert y0.shape == (2, len(sess.species) + 1)
+    np.testing.assert_array_equal(y0[:, -1], [1100.0, 1200.0])
+    assert cfg[ATOL_SCALE_KEY].shape == y0.shape
+    np.testing.assert_allclose(cfg[ATOL_SCALE_KEY][:, -1],
+                               DEFAULT_ATOL_T / spec.atol)
+    np.testing.assert_allclose(cfg[ATOL_SCALE_KEY][:, :-1], 1.0)
+    # warmup specs cover both families: isothermal + the energy mode
+    specs = sess.warmup_specs()
+    widths = {np.asarray(s["y0"]).shape[0] for s in specs}
+    assert widths == {len(sess.species), len(sess.species) + 1}
+    # a mode the session never built is loud
+    with pytest.raises(ValueError, match="not enabled"):
+        sess._energy_fns("adiabatic_p")
+    # spec grammar: unknown mode literals reject at load
+    with pytest.raises(ValueError, match="adiabatic_v"):
+        load_spec({"mechanism": {"mech": "x", "therm": "y"},
+                   "solver": {"energy_modes": ["bogus"]}})
+
+
+@pytest.mark.slow
+def test_served_adiabatic_matches_direct(h2o2, chem_gas):
+    """Acceptance e2e (scheduler, HTTP-free): a served adiabatic
+    request is bit-exact vs direct batch_reactor_sweep on the same
+    conditions at the same bucket."""
+    from batchreactor_tpu.serving import schema
+    from batchreactor_tpu.serving.scheduler import Scheduler
+    from batchreactor_tpu.serving.session import SolverSession, load_spec
+
+    gm, th, *_ = h2o2
+    spec = load_spec({"mechanism": {"mech": "x", "therm": "y"},
+                      "solver": {"segment_steps": 64, "stats": True,
+                                 "energy_modes": ["adiabatic_v"]},
+                      "serve": {"resident": 4, "refill": 1,
+                                "buckets": [2, 4], "poll_every": 1}})
+    T = np.asarray([1100.0, 1200.0])
+    with SolverSession(gm, th, spec) as sess:
+        sched = Scheduler(sess).start()
+        req = schema.validate_request(
+            {"id": "e1", "T": list(T), "X": X_MIX, "t1": 2e-4,
+             "energy": "adiabatic_v"},
+            species=sess.species, energy_modes=spec.energy_modes)
+        payload = sess.render_result(
+            sched.submit(req).result(timeout=300))
+        assert sched.drain(60)
+    out = batch_reactor_sweep(X_MIX, T, 1e5, 2e-4, chem=chem_gas,
+                              thermo_obj=th, md=gm,
+                              energy="adiabatic_v", segment_steps=64,
+                              admission=4, refill=1, buckets=(2, 4))
+    assert payload["energy"] == "adiabatic_v"
+    np.testing.assert_array_equal(payload["T"], out["T"])
+    np.testing.assert_array_equal(payload["ignition_delay"],
+                                  out["ignition_delay"])
+    assert payload["solver_status"] == ["Success", "Success"]

@@ -58,7 +58,9 @@ class TestSchema:
         np.testing.assert_array_equal(r.T, [1200.0, 1300.0])
         np.testing.assert_array_equal(r.p, [2e5, 2e5])
         np.testing.assert_array_equal(r.X["H2"], [0.3, 0.3])
-        assert r.pack_key() == (1e-4, 1e-7, 1e-10)
+        # the trailing slot is the energy mode (None = isothermal —
+        # docs/energy.md; energy lanes never share a resident program)
+        assert r.pack_key() == (1e-4, 1e-7, 1e-10, None)
 
     def test_default_id_and_defaults(self):
         obj = _req()
