@@ -654,11 +654,13 @@ def counter_registry_findings():
                 f"counter key {k!r} is declared by multiple families "
                 f"{sorted(where)}: reductions would double-apply"))
     for fam, meta in sorted(fams.items()):
-        if meta.get("semantics") not in ("additive", "gauge", "sample"):
+        if meta.get("semantics") not in ("additive", "gauge", "sample",
+                                         "histogram"):
             findings.append(Finding(
                 "counter-registry", "<audit:counters>", 0, 0,
                 f"family {fam!r} declares unknown semantics "
-                f"{meta.get('semantics')!r} (additive|gauge|sample)"))
+                f"{meta.get('semantics')!r} "
+                f"(additive|gauge|sample|histogram)"))
         if meta.get("kind") == "host" and not meta.get("missing_zero"):
             findings.append(Finding(
                 "counter-registry", "<audit:counters>", 0, 0,
@@ -701,4 +703,25 @@ def counter_registry_findings():
                 f"sample key(s) {bad} of family {fam!r} leak into "
                 f"counters.totals(): summing ring slots reports a "
                 f"number with no meaning"))
+
+    # 6. behavioral: histogram families follow the missing->EMPTY diff
+    #    convention through the REAL renderer (the missing->0 rule
+    #    lifted to distributions: a baseline that never served must
+    #    diff as "n 0 -> n", never "None -> ...")
+    for fam, meta in sorted(fams.items()):
+        if meta.get("semantics") != "histogram":
+            continue
+        for k in meta.get("keys", ()):
+            ser = C.hist_observe(C.hist_new(), 0.01)
+            out = R.diff(
+                {"counters": {}},
+                {"counters": {},
+                 "histograms": {k: [{"labels": {"stage": "probe"},
+                                     **ser}]}})
+            if f'hist {k}{{stage="probe"}}: n 0 -> 1' not in out:
+                findings.append(Finding(
+                    "counter-registry", "<audit:counters>", 0, 0,
+                    f"histogram key {k!r} does not follow the obs.diff "
+                    f"missing->empty convention (got: "
+                    f"{[ln for ln in out.splitlines() if k in ln]!r})"))
     return findings

@@ -21,6 +21,14 @@ for every question the ad-hoc fragments it supersedes answered separately:
   ``jax.monitoring`` and counts traces/compiles per program label,
   flagging unexpected recompilation (the runtime complement to brlint's
   static pass).
+* **where did one REQUEST's latency go** — :class:`~.trace.RequestTrace`
+  (:mod:`.trace`): monotonic stage marks over the fixed vocabulary
+  ``submitted -> coalesced -> admitted -> first_harvest -> resolved``,
+  captured by the serving scheduler, exported per-request (the
+  ``trace=`` response section + ``request_trace`` recorder events) and
+  aggregated into the fixed-bucket ``serve_stage_seconds`` histograms
+  (:func:`Recorder.observe` / :mod:`.counters` ``HIST_KEYS``) a
+  mid-flight ``/metrics`` scrape shows moving.
 * **machine-readable exports** — :mod:`.export` writes the assembled
   report (:func:`~.report.build_report`) as JSON-Lines or a
   Prometheus-style text exposition; ``scripts/obs_report.py`` renders and
@@ -36,10 +44,11 @@ from .retrace import CompileWatch
 from .report import build_report, render, diff, stats_totals
 from .export import (to_jsonl, from_jsonl, to_prometheus, write_jsonl,
                      read_jsonl)
-from . import live, timeline  # noqa: F401  (submodule re-exports)
+from . import live, timeline, trace  # noqa: F401  (submodule re-exports)
 from .live import (FlightRecorder, LiveRegistry, MetricsServer,
                    arm_flight, armed_flight, disarm_flight, flight_dump,
                    resolve_live_metrics)
+from .trace import RequestTrace, STAGES, TRACE_VERSION
 
 __all__ = [
     "Recorder",
@@ -56,6 +65,10 @@ __all__ = [
     "read_jsonl",
     "live",
     "timeline",
+    "trace",
+    "RequestTrace",
+    "STAGES",
+    "TRACE_VERSION",
     "LiveRegistry",
     "MetricsServer",
     "FlightRecorder",

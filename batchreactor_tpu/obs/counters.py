@@ -53,6 +53,8 @@ program keeps executing its lanes), so they report algorithmic work, not
 SIMD occupancy.
 """
 
+import bisect
+
 import numpy as np
 
 #: counter keys common to both solvers (beyond the SolveResult aliases)
@@ -108,14 +110,16 @@ LIVE_KEYS = ("metrics_scrapes", "live_publishes", "fleet_snapshots",
 #: rejection / resolution, epoch turnover, injected stalls), the
 #: streaming driver's live feed (``fed_lanes`` — lanes appended to a
 #: resident backlog mid-stream), and the session warmup wall.
-#: ``serve_latency_s`` accumulates answered-request wall like
-#: ``poll_wait_s`` (divide by ``serve_answered`` for the mean).  Absent
-#: from a report whose run served nothing — ``obs.diff`` maps a missing
-#: key to 0 (the FAULT_KEYS convention).
+#: Request latency is NOT here: the old ``serve_latency_s`` additive
+#: counter summed seconds across requests into a meaningless total —
+#: it migrated to the ``serve_stage_seconds`` HISTOGRAM family
+#: (``HIST_KEYS`` below, ``{stage="total"}``).  Absent from a report
+#: whose run served nothing — ``obs.diff`` maps a missing key to 0
+#: (the FAULT_KEYS convention).
 SERVE_KEYS = ("serve_requests", "serve_lanes", "serve_answered",
               "serve_failed", "serve_rejects_overload",
               "serve_rejects_draining", "serve_stalls", "serve_epochs",
-              "serve_latency_s", "serve_warmup_s", "fed_lanes")
+              "serve_warmup_s", "fed_lanes")
 #: AOT program-store counters (aot/registry.py — docs/performance.md
 #: "Mechanism-shape economy"): Recorder counters incremented by the
 #: registry's LRU capacity policy (``enforce_capacity`` — entries
@@ -125,6 +129,17 @@ SERVE_KEYS = ("serve_requests", "serve_lanes", "serve_answered",
 #: the registry — ``obs.diff`` maps a missing key to 0 (the FAULT_KEYS
 #: convention).
 AOT_KEYS = ("aot_evictions", "mech_admitted", "mech_evicted")
+#: request-latency HISTOGRAM families (obs/trace.py + serving/ —
+#: docs/observability.md "Histograms"): Recorder histograms
+#: (``Recorder.observe``) over the FIXED log-spaced bucket ladder
+#: :data:`HIST_BUCKET_EDGES`, so merge is slot-wise sum by
+#: construction.  ``serve_stage_seconds`` is labeled by destination
+#: stage (``RequestTrace.segments`` + ``total`` — the migrated
+#: ``serve_latency_s``) and renders as the Prometheus
+#: ``br_serve_stage_seconds_bucket/_sum/_count`` exposition
+#: (obs/export.py).  A missing histogram family diffs as EMPTY (count
+#: 0), the missing->0 convention lifted to distributions.
+HIST_KEYS = ("serve_stage_seconds",)
 
 
 #: THE counter-family registry (brlint tier-C counter-registry audit,
@@ -137,9 +152,13 @@ AOT_KEYS = ("aot_evictions", "mech_admitted", "mech_evicted")
 #: ``kind``: ``device`` counters ride the solver stats carry; ``host``
 #: counters are Recorder counters.  ``semantics``: ``additive`` keys
 #: sum across lanes/segments/hosts; ``sample`` keys are slot-keyed
-#: payload buffers that must never enter counter totals; per-key
-#: ``gauges`` overrides mark high-water marks reduced by max (the
-#: ``GAUGE_KEYS`` marker is derived-equal by the audit).
+#: payload buffers that must never enter counter totals; ``histogram``
+#: keys are fixed-bucket distributions (``HIST_BUCKET_EDGES``) merged
+#: by slot-wise sum and rendered as Prometheus ``_bucket``/``_sum``/
+#: ``_count`` families — they live in the report's ``histograms``
+#: section, never in ``counters``; per-key ``gauges`` overrides mark
+#: high-water marks reduced by max (the ``GAUGE_KEYS`` marker is
+#: derived-equal by the audit).
 #: ``missing_zero``: the key is absent from a report whose run never
 #: exercised the surface, and ``obs.diff`` maps missing to 0 — REQUIRED
 #: for every host family (a fault-free baseline must diff cleanly
@@ -164,6 +183,9 @@ FAMILIES = {
               "semantics": "additive", "missing_zero": True},
     "aot": {"keys": AOT_KEYS, "kind": "host",
             "semantics": "additive", "missing_zero": True},
+    "serve-stage-hist": {"keys": HIST_KEYS, "kind": "host",
+                         "semantics": "histogram",
+                         "missing_zero": True},
 }
 
 
@@ -173,6 +195,82 @@ def missing_zero_keys():
     so registering a family enrolls its keys automatically)."""
     return {k for meta in FAMILIES.values() if meta.get("missing_zero")
             for k in meta["keys"]}
+
+
+# --------------------------------------------------------------------------
+# histograms (the HIST_KEYS family machinery — docs/observability.md)
+# --------------------------------------------------------------------------
+#: THE fixed log-spaced bucket ladder every duration histogram shares:
+#: upper bounds in seconds, 100 us doubling to ~52 s (20 slots), plus
+#: an implicit +Inf overflow slot (``counts`` has one more entry than
+#: edges).  Fixed and global so two histograms — two segments of one
+#: run, two hosts, baseline vs candidate — merge by SLOT-WISE SUM with
+#: no re-bucketing, the same reason Prometheus histograms fix ``le``.
+HIST_BUCKET_EDGES = tuple(1e-4 * 2.0 ** i for i in range(20))
+
+
+def hist_new():
+    """An empty histogram dict: ``{"counts", "sum", "count"}`` over
+    :data:`HIST_BUCKET_EDGES` (+1 overflow slot)."""
+    return {"counts": [0] * (len(HIST_BUCKET_EDGES) + 1),
+            "sum": 0.0, "count": 0}
+
+
+def hist_observe(h, value):
+    """Fold one observation into histogram dict ``h`` (in place)."""
+    v = float(value)
+    idx = bisect.bisect_left(HIST_BUCKET_EDGES, v)
+    h["counts"][idx] += 1
+    h["sum"] += v
+    h["count"] += 1
+    return h
+
+
+def hist_merge(a, b):
+    """Slot-wise sum of two histogram dicts (the fleet/segment merge);
+    loud on a bucket-schema mismatch — merging differently-bucketed
+    histograms would silently mis-shelve counts."""
+    if len(a["counts"]) != len(b["counts"]):
+        raise ValueError(
+            f"histogram bucket schemas differ ({len(a['counts'])} vs "
+            f"{len(b['counts'])} slots); merge needs one fixed ladder")
+    return {"counts": [x + y for x, y in zip(a["counts"], b["counts"])],
+            "sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+
+
+def hist_quantile(h, q):
+    """The ``q`` quantile (0..1) estimated from the bucket counts with
+    linear interpolation inside the landing bucket (the
+    ``histogram_quantile`` rule); ``None`` on an empty histogram.  An
+    overflow-bucket landing returns the top edge — a LOWER bound, the
+    honest answer a bounded ladder can give.  Uses the series' own
+    ``le`` edges when present (an archived report is self-describing),
+    else the process-wide :data:`HIST_BUCKET_EDGES`."""
+    n = int(h.get("count", 0))
+    if n <= 0:
+        return None
+    le = h.get("le") or HIST_BUCKET_EDGES
+    rank = q * n
+    cum = 0
+    for i, c in enumerate(h["counts"]):
+        if c == 0:
+            continue
+        if cum + c >= rank:
+            if i >= len(le):
+                return le[-1]
+            lo = le[i - 1] if i > 0 else 0.0
+            hi = le[i]
+            frac = (rank - cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return le[-1]
+
+
+def hist_mean(h):
+    """Mean of the exact observation sum (not bucket-estimated);
+    ``None`` on empty."""
+    n = int(h.get("count", 0))
+    return (h["sum"] / n) if n else None
 
 
 def occupancy(counters):

@@ -34,6 +34,10 @@ def to_jsonl(report):
     for k, v in sorted((report.get("counters") or {}).items()):
         lines.append(json.dumps({"kind": "counter", "name": k, "value": v},
                                 sort_keys=True))
+    for name in sorted(report.get("histograms") or {}):
+        for ser in report["histograms"][name]:
+            lines.append(json.dumps({"kind": "histogram", "name": name,
+                                     **ser}, sort_keys=True))
     if report.get("solver_stats") is not None:
         lines.append(json.dumps({"kind": "solver_stats",
                                  **report["solver_stats"]}, sort_keys=True))
@@ -46,7 +50,8 @@ def to_jsonl(report):
 def from_jsonl(text):
     """Inverse of :func:`to_jsonl`: rebuild the report dict."""
     report = {"schema": SCHEMA, "meta": {}, "spans": [], "events": [],
-              "counters": {}, "solver_stats": None, "compile": None}
+              "counters": {}, "histograms": None, "solver_stats": None,
+              "compile": None}
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -62,6 +67,11 @@ def from_jsonl(text):
             report["events"].append(rec)
         elif kind == "counter":
             report["counters"][rec["name"]] = rec["value"]
+        elif kind == "histogram":
+            if report["histograms"] is None:
+                report["histograms"] = {}
+            report["histograms"].setdefault(rec.pop("name"),
+                                            []).append(rec)
         elif kind == "solver_stats":
             report["solver_stats"] = rec
         elif kind == "compile":
@@ -92,6 +102,16 @@ def _esc(value):
             .replace("\n", r"\n"))
 
 
+def _labels(labels):
+    """``{k: v}`` -> ``{k="v",...}`` (sorted, escaped; "" when empty) —
+    THE label serializer every exposition family shares."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
 def _metric(lines, name, mtype, help_, samples):
     """Append one metric family; ``samples`` is [(labels_dict, value)]."""
     if not samples:
@@ -99,12 +119,40 @@ def _metric(lines, name, mtype, help_, samples):
     lines.append(f"# HELP {name} {help_}")
     lines.append(f"# TYPE {name} {mtype}")
     for labels, value in samples:
-        lab = ""
-        if labels:
-            inner = ",".join(f'{k}="{_esc(v)}"'
-                             for k, v in sorted(labels.items()))
-            lab = "{" + inner + "}"
-        lines.append(f"{name}{lab} {value}")
+        lines.append(f"{name}{_labels(labels)} {value}")
+
+
+def _histogram(lines, name, help_, series):
+    """Append one Prometheus histogram family: ``series`` is the
+    report's per-label list (``{"labels", "le", "counts", "sum",
+    "count"}`` — ``counts`` has a trailing +Inf overflow slot, checked
+    loudly like ``hist_merge``).  Bucket counts render CUMULATIVE with
+    the closing ``le="+Inf"`` sample equal to ``_count``, per the
+    exposition format."""
+    if not series:
+        return
+    lines.append(f"# HELP {name} {help_}")
+    lines.append(f"# TYPE {name} histogram")
+    for ser in series:
+        labels = ser.get("labels") or {}
+        if len(ser["counts"]) != len(ser["le"]) + 1:
+            raise ValueError(
+                f"histogram {name}{_labels(labels)} has "
+                f"{len(ser['counts'])} count slots for "
+                f"{len(ser['le'])} le edges (want edges + 1 overflow "
+                f"slot); a silently mis-shelved series would render "
+                f"_bucket{{le=\"+Inf\"}} != _count")
+        cum = 0
+        for le, c in zip(ser["le"], ser["counts"]):
+            cum += c
+            lines.append(f"{name}_bucket"
+                         f"{_labels({**labels, 'le': f'{le:.6g}'})} "
+                         f"{cum}")
+        cum += ser["counts"][len(ser["le"])]
+        lines.append(f"{name}_bucket"
+                     f"{_labels({**labels, 'le': '+Inf'})} {cum}")
+        lines.append(f"{name}_sum{_labels(labels)} {ser['sum']:.6f}")
+        lines.append(f"{name}_count{_labels(labels)} {ser['count']}")
 
 
 def to_prometheus(report):
@@ -127,6 +175,17 @@ def to_prometheus(report):
             "Recorder counters.",
             [({"name": k}, v) for k, v in
              sorted((report.get("counters") or {}).items())])
+
+    # histogram families (obs/counters.py HIST_KEYS): the standard
+    # Prometheus histogram triple — cumulative _bucket{le=} counts, the
+    # exact observation _sum, and _count — one series per label set
+    # (``br_serve_stage_seconds_bucket{le="0.0128",stage="total"}`` —
+    # labels render sorted, so ``le`` comes first)
+    for name in sorted(report.get("histograms") or {}):
+        _histogram(lines, f"br_{name}",
+                   f"Fixed log-spaced latency histogram '{name}' "
+                   f"(seconds; obs/counters.py bucket ladder).",
+                   report["histograms"][name])
 
     # continuous batching (parallel/sweep.py admission=): occupancy is a
     # DERIVED ratio of the additive lane_attempts/lane_capacity pair —

@@ -322,12 +322,22 @@ def snapshot_path(ckpt_dir, process_id):
 
 def write_fleet_snapshot(ckpt_dir, process_id, registry):
     """Atomically drop this process's metric snapshot beside its
-    heartbeat (``hosts/p<id>.metrics.json``): merged counters + gauges,
-    the payload :func:`merge_fleet` reduces.  Crash-safe (tmp +
-    ``os.replace``) and cheap enough for the elastic tier's poll loop."""
+    heartbeat (``hosts/p<id>.metrics.json``): merged counters + gauges
+    + the recorder's histograms, the payload :func:`merge_fleet`
+    reduces.  Crash-safe (tmp + ``os.replace``) and cheap enough for
+    the elastic tier's poll loop."""
+    from . import counters as C
+
     counters, gauges = registry._merged()
+    hists = {}
+    if registry.recorder is not None:
+        le = list(C.HIST_BUCKET_EDGES)
+        hists = {name: [{"le": le, **ser} for ser in series]
+                 for name, series
+                 in registry.recorder.hist_snapshot().items()}
     snap = {"pid": int(process_id), "time": time.time(),
-            "counters": counters, "gauges": gauges}
+            "counters": counters, "gauges": gauges,
+            "histograms": hists}
     path = snapshot_path(ckpt_dir, process_id)
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "w") as f:
@@ -363,15 +373,35 @@ def merge_fleet(snapshots):
     """Reduce per-host snapshots to one fleet view: counters SUMMED
     across hosts, gauges MAX-reduced — the ``obs/counters.py`` GAUGE
     convention (summing a per-host high-water mark or ratio would
-    report a value no host ever saw)."""
-    counters, gauges = {}, {}
+    report a value no host ever saw) — and histogram series merged by
+    slot-wise sum (``hist_merge``: the fixed bucket ladder is exactly
+    what makes a cross-host latency distribution well-defined)."""
+    from . import counters as C
+
+    counters, gauges, hists = {}, {}, {}
     for s in snapshots:
         for k, v in (s.get("counters") or {}).items():
             counters[k] = counters.get(k, 0) + v
         for k, v in (s.get("gauges") or {}).items():
             gauges[k] = max(gauges.get(k, v), v)
+        for name, series in (s.get("histograms") or {}).items():
+            fam = hists.setdefault(name, {})
+            for ser in series:
+                key = tuple(sorted((ser.get("labels") or {}).items()))
+                if key in fam:
+                    fam[key] = {"labels": dict(key),
+                                "le": fam[key].get("le"),
+                                **C.hist_merge(fam[key], ser)}
+                else:
+                    fam[key] = {"labels": dict(key),
+                                "le": ser.get("le"),
+                                "counts": list(ser["counts"]),
+                                "sum": ser["sum"],
+                                "count": ser["count"]}
     return {"hosts": len(snapshots), "counters": counters,
-            "gauges": gauges}
+            "gauges": gauges,
+            "histograms": {name: [fam[k] for k in sorted(fam)]
+                           for name, fam in sorted(hists.items())}}
 
 
 def fleet_prometheus(snapshots):
@@ -406,6 +436,19 @@ def fleet_prometheus(snapshots):
         _metric(lines, "br_fleet_occupancy", "gauge",
                 "Fleet-wide sweep occupancy (counters summed across "
                 "hosts before the ratio).", [({}, round(occ, 6))])
+    # fleet-merged latency histograms (slot-wise summed across hosts —
+    # the fixed bucket ladder makes the cross-host distribution
+    # well-defined); series missing their ``le`` (a pre-histogram
+    # snapshot) are skipped rather than guessed at
+    from .export import _histogram
+
+    for name in sorted(merged.get("histograms") or {}):
+        series = [ser for ser in merged["histograms"][name]
+                  if ser.get("le")]
+        _histogram(lines, f"br_fleet_{name}",
+                   f"Fleet-merged latency histogram '{name}' "
+                   f"(seconds; per-host series summed slot-wise).",
+                   series)
     return "\n".join(lines) + ("\n" if lines else "")
 
 

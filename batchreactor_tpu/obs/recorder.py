@@ -13,6 +13,13 @@ A Recorder collects three record kinds:
 * **events** — zero-duration points (a retrace warning, a chunk load).
 * **counters** — monotonically accumulated named floats (bytes written,
   segments launched).
+* **histograms** — labeled distributions over the FIXED log-spaced
+  bucket ladder ``obs.counters.HIST_BUCKET_EDGES``
+  (:meth:`Recorder.observe`): per-request latency stages land here
+  (``serve_stage_seconds{stage=}``) instead of as lying summed
+  counters; the report carries them in its ``histograms`` section and
+  ``obs.export`` renders the Prometheus ``_bucket``/``_sum``/``_count``
+  exposition.
 
 The Recorder never imports jax at module scope and is safe to create on
 hosts with no usable accelerator; ``block=`` imports jax lazily.  All
@@ -57,6 +64,7 @@ class Recorder:
         self.spans = []     # append order = start order (per the lock)
         self.events = []
         self.counters = {}
+        self.histograms = {}   # name -> {label-items tuple -> hist dict}
         #: optional observer ``tap(kind, record)`` called (outside the
         #: lock) once per COMPLETED span, event, and counter update —
         #: the flight recorder's attachment point (obs/live.py); must be
@@ -120,6 +128,25 @@ class Recorder:
             tap("counter", {"name": name, "value": value,
                             "total": total})
 
+    def observe(self, name, value, **labels):
+        """Fold one observation into the named histogram (fixed
+        log-spaced buckets — ``obs.counters.HIST_BUCKET_EDGES``);
+        ``labels`` select the series within the family (e.g.
+        ``observe("serve_stage_seconds", dur, stage="coalesced")``)."""
+        from . import counters as C
+
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            fam = self.histograms.setdefault(name, {})
+            ser = fam.get(key)
+            if ser is None:
+                ser = fam[key] = C.hist_new()
+            C.hist_observe(ser, value)
+        tap = self.tap
+        if tap is not None:
+            tap("histogram", {"name": name, "labels": dict(labels),
+                              "value": value})
+
     # ---- views ------------------------------------------------------------
     def by_name(self):
         """Aggregate spans by *name* -> ``{"total_s", "count"}`` (the
@@ -154,8 +181,21 @@ class Recorder:
 
     def snapshot(self):
         """Copies of (spans, events, counters) safe to serialize while
-        other threads keep recording."""
+        other threads keep recording.  (Histograms have their own
+        :meth:`hist_snapshot` — the 3-tuple shape predates them and is
+        consumed positionally all over the live plane.)"""
         with self._lock:
             return ([dict(s) for s in self.spans],
                     [dict(e) for e in self.events],
                     dict(self.counters))
+
+    def hist_snapshot(self):
+        """Report-shaped histogram copies: ``{name: [{"labels", "counts",
+        "sum", "count"}, ...]}``, series sorted by label items — the
+        ``build_report`` ``histograms`` section."""
+        with self._lock:
+            return {name: [{"labels": dict(key),
+                            "counts": list(ser["counts"]),
+                            "sum": ser["sum"], "count": ser["count"]}
+                           for key, ser in sorted(fam.items())]
+                    for name, fam in sorted(self.histograms.items())}

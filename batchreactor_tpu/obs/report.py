@@ -13,9 +13,18 @@ Report layout::
      "spans":    [{name, path, depth, start, dur, attrs, seq}, ...],
      "events":   [{name, time, attrs}, ...],
      "counters": {name: number},
+     "histograms": {name: [{"labels": {...}, "le": [...],
+                            "counts": [...], "sum", "count"}, ...]}
+                   | None,
      "solver_stats": {"totals": {...}, "per_lane": {key: [...]}} | None,
      "compile": {"available", "compiles", "traces", "retraces",
                  "compile_s", "by_label": {...}} | None}
+
+``histograms`` (the ``obs/counters.py`` HIST_KEYS family —
+docs/observability.md "Histograms") carries one series per label set:
+``counts`` has one slot per ``le`` upper bound plus a trailing +Inf
+overflow slot, and a MISSING family diffs as empty (count 0) — the
+missing->0 convention lifted to distributions.
 """
 
 import numpy as np
@@ -65,8 +74,14 @@ def build_report(recorder=None, solver_stats=None, watch=None, meta=None):
     vmap-batched); per-lane arrays are included only when batched (a
     single-condition solve's totals ARE its per-lane view)."""
     spans, events, ctrs = ([], [], {})
+    hists = None
     if recorder is not None:
         spans, events, ctrs = recorder.snapshot()
+        snap = getattr(recorder, "hist_snapshot", None)
+        if snap is not None:
+            le = list(C.HIST_BUCKET_EDGES)
+            hists = {name: [{"le": le, **ser} for ser in series]
+                     for name, series in snap().items()} or None
     stats_block = None
     if solver_stats is not None:
         totals = C.totals(solver_stats)
@@ -84,6 +99,7 @@ def build_report(recorder=None, solver_stats=None, watch=None, meta=None):
         "spans": spans,
         "events": events,
         "counters": ctrs,
+        "histograms": hists,
         "solver_stats": stats_block,
         "compile": watch.summary() if watch is not None else None,
     })
@@ -94,6 +110,22 @@ def build_report(recorder=None, solver_stats=None, watch=None, meta=None):
 # --------------------------------------------------------------------------
 def _fmt_dur(d):
     return "   ...  " if d is None else f"{d:8.3f}s"
+
+
+def hist_series_name(name, labels):
+    """``serve_stage_seconds{stage="total"}`` — the one series-naming
+    rule render, diff, and the gate share."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return name + "{" + inner + "}"
+
+
+def _fmt_hs(v):
+    """Histogram seconds, human-scaled (quantiles are None on empty)."""
+    if v is None:
+        return "-"
+    return f"{1e3 * v:.1f}ms" if v < 1.0 else f"{v:.3f}s"
 
 
 def render(report):
@@ -125,6 +157,18 @@ def render(report):
         if occ is not None:
             lines.append(f"  occupancy: {occ:.4f} "
                          f"(lane_attempts / lane_capacity)")
+
+    hists = report.get("histograms") or {}
+    if hists:
+        lines.append("histograms:")
+        for name in sorted(hists):
+            for ser in hists[name]:
+                lines.append(
+                    f"  {hist_series_name(name, ser.get('labels'))}: "
+                    f"n={ser['count']} mean={_fmt_hs(C.hist_mean(ser))} "
+                    f"p50={_fmt_hs(C.hist_quantile(ser, 0.50))} "
+                    f"p95={_fmt_hs(C.hist_quantile(ser, 0.95))} "
+                    f"p99={_fmt_hs(C.hist_quantile(ser, 0.99))}")
 
     st = (report.get("solver_stats") or {}).get("totals")
     if st:
@@ -224,6 +268,31 @@ def diff(a, b):
                 continue
         if va != vb:
             lines.append(f"  counter {k}: {_fmt_ctr(va)} -> {_fmt_ctr(vb)}")
+    # histogram families (HIST_KEYS — the serve_stage_seconds latency
+    # decomposition): missing is EMPTY (count 0, quantiles None), the
+    # missing->0 convention lifted to distributions, so a baseline that
+    # never served diffs cleanly against a serving run.  Rendered as
+    # count + p50/p99 shifts, not raw bucket vectors.
+    def hist_series(rep):
+        out = {}
+        for name, series in (rep.get("histograms") or {}).items():
+            for ser in series:
+                out[hist_series_name(name, ser.get("labels"))] = ser
+        return out
+
+    ha, hb = hist_series(a), hist_series(b)
+    empty = C.hist_new()
+    for key in sorted(set(ha) | set(hb)):
+        va, vb = ha.get(key, empty), hb.get(key, empty)
+        if va["count"] == vb["count"] and va["counts"] == vb["counts"]:
+            continue
+        lines.append(
+            f"  hist {key}: n {va['count']} -> {vb['count']}, "
+            f"p50 {_fmt_hs(C.hist_quantile(va, 0.5))} -> "
+            f"{_fmt_hs(C.hist_quantile(vb, 0.5))}, "
+            f"p99 {_fmt_hs(C.hist_quantile(va, 0.99))} -> "
+            f"{_fmt_hs(C.hist_quantile(vb, 0.99))}")
+
     # derived occupancy gauge (continuous batching): shown whenever either
     # side recorded capacity, so an admission A/B reads as one ratio
     # instead of two raw counter deltas

@@ -35,7 +35,8 @@ from .schema import (SCHEMA_VERSION, Request, error_response,  # noqa: F401
                      ok_response, validate_request, validate_upload)
 from .scheduler import (Draining, Overloaded, RequestResult,  # noqa: F401
                         Scheduler, SchedulerReject)
-from .client import ServeError, SolveClient, poisson_trace  # noqa: F401
+from .client import (ServeError, SolveClient, poisson_trace,  # noqa: F401
+                     trace_summary)
 
 __all__ = [
     "SCHEMA_VERSION", "Request", "validate_request", "validate_upload",
@@ -44,7 +45,7 @@ __all__ = [
     "Draining", "RequestResult", "SolverSession", "SessionSpec",
     "SessionStore", "UnknownMechanism",
     "load_spec", "ServingServer", "serve_jsonl", "SolveClient",
-    "ServeError", "poisson_trace",
+    "ServeError", "poisson_trace", "trace_summary",
 ]
 
 _LAZY = {"SolverSession": "session", "SessionSpec": "session",

@@ -14,7 +14,10 @@ server, not the load.  :func:`run_trace` fires a trace against a
 client from worker threads (open-loop: a slow response does not slow
 the arrival process — the honest way to find the knee) and returns
 per-request latency records for the p50/p95/p99 + cond/s summary
-(:func:`summarize`).
+(:func:`summarize`); when the requests carried ``trace: true``,
+:func:`trace_summary` adds the server-side stage decomposition and the
+client~server latency-attribution check (docs/observability.md
+"Request tracing").
 """
 
 import json
@@ -194,4 +197,53 @@ def summarize(records, wall_s):
         "p50_ms": round(1e3 * _percentile(lat, 0.50), 3) if lat else None,
         "p95_ms": round(1e3 * _percentile(lat, 0.95), 3) if lat else None,
         "p99_ms": round(1e3 * _percentile(lat, 0.99), 3) if lat else None,
+    }
+
+
+def trace_summary(records, attribution_tol_ms=2000.0):
+    """The SERVER-side half of the bench evidence, from the ``trace``
+    sections of answered responses (requests sent with ``trace:
+    true``): per-stage p50/p95/mean over the waterfall segments
+    (obs/trace.py vocabulary), server total percentiles, and the
+    client~server attribution check — client ``latency_s`` must cover
+    the server ``submitted -> resolved`` wall (small negative slack
+    for clock granularity) and exceed it by at most
+    ``attribution_tol_ms`` of transport/thread-wakeup overhead, which
+    pins the two clocks against stage-attribution bugs.  Returns
+    ``None`` when no record carries a trace."""
+    traced = [(r, r["response"]["trace"]) for r in records
+              if r and r["ok"] and (r.get("response") or {}).get("trace")]
+    if not traced:
+        return None
+    by_stage = {}
+    for _r, tr in traced:
+        for stage, dur in (tr.get("segments") or {}).items():
+            by_stage.setdefault(stage, []).append(float(dur))
+
+    def pct(vals, q):
+        return _percentile(sorted(vals), q)
+
+    totals = [float(tr["total_s"]) for _r, tr in traced]
+    gaps_ms = [1e3 * (r["latency_s"] - float(tr["total_s"]))
+               for r, tr in traced]
+    violations = [
+        {"id": r["id"], "gap_ms": round(g, 3)}
+        for (r, _t), g in zip(traced, gaps_ms)
+        if g < -5.0 or g > attribution_tol_ms]
+    return {
+        "server_stages": {
+            stage: {"n": len(durs),
+                    "mean_ms": round(1e3 * sum(durs) / len(durs), 3),
+                    "p50_ms": round(1e3 * pct(durs, 0.50), 3),
+                    "p95_ms": round(1e3 * pct(durs, 0.95), 3)}
+            for stage, durs in sorted(by_stage.items())},
+        "server_total_p50_ms": round(1e3 * pct(totals, 0.50), 3),
+        "server_total_p95_ms": round(1e3 * pct(totals, 0.95), 3),
+        "attribution": {
+            "n": len(gaps_ms),
+            "max_gap_ms": round(max(gaps_ms), 3),
+            "p50_gap_ms": round(pct(gaps_ms, 0.50), 3),
+            "tol_ms": attribution_tol_ms,
+            "ok": not violations,
+            "violations": violations[:8]},
     }

@@ -31,6 +31,19 @@ always answered exactly once (drain finishes the backlog first, and a
 dead stream resolves its requests with ``internal`` errors rather than
 dropping them).
 
+**Request-lifecycle tracing** (obs/trace.py — docs/observability.md
+"Request tracing"): every accepted request carries a
+:class:`~..obs.trace.RequestTrace` marked lock-cheaply at the points
+that already exist — ``submitted`` in :meth:`Scheduler.submit`,
+``coalesced`` in ``_pop_work_locked``, ``admitted`` on joining the
+epoch backlog, ``first_harvest`` in the harvest hook (idempotent),
+``stalled`` under the injected fault, ``resolved`` at
+``_resolve``/``_fail``.  Resolution folds the per-stage durations into
+the ``serve_stage_seconds`` histograms (the live ``/metrics``
+decomposition), emits the ``request_trace`` JSONL event, and — past
+``spec.slow_request_s`` — a structured ``slow_request`` event that
+arms the flight recorder.
+
 The module imports stdlib + numpy only (no jax): the session object
 carries all device work, so the scheduler invariants are unit-testable
 against a fake session (tests/test_serving.py).
@@ -43,6 +56,8 @@ import time
 from concurrent.futures import Future
 
 import numpy as np
+
+from ..obs.trace import RequestTrace
 
 #: brlint host-concurrency lint (analysis/concurrency.py): the producer
 #: surface is called from arbitrary front-end threads (HTTP handler
@@ -89,15 +104,23 @@ class RequestResult:
     observed: dict | None
     provenance: list
     elapsed_s: float
+    #: the request's lifecycle trace (obs/trace.py) — stage marks the
+    #: scheduler captured; ``render_result`` exports it behind the
+    #: request's ``trace=`` key
+    trace: object = None
 
 
 class _Work:
     """One accepted request in flight: its future, pre-packed lane
-    blocks, per-lane result buffers, and the harvest countdown."""
+    blocks, per-lane result buffers, the harvest countdown, and the
+    lifecycle trace (obs/trace.py — constructing it marks
+    ``submitted``; the other stages mark at the existing scheduler
+    points, one clock read each, no locks of their own: the trace is
+    touched by the submit thread once and the worker thereafter)."""
 
     __slots__ = ("request", "future", "y0", "cfg", "t", "y", "status",
                  "n_acc", "n_rej", "stats", "observed", "remaining",
-                 "submitted", "stall_s", "seq")
+                 "trace", "stall_s", "seq")
 
     def __init__(self, request, y0, cfg, seq):
         self.request = request
@@ -113,7 +136,8 @@ class _Work:
         self.stats = None
         self.observed = None
         self.remaining = k
-        self.submitted = time.perf_counter()
+        self.trace = RequestTrace(request.id,
+                                  pack_key=request.pack_key(), lanes=k)
         self.stall_s = 0.0
         self.seq = seq
 
@@ -264,6 +288,7 @@ class Scheduler:
         while q and (not works or lanes + q[0].request.n_lanes
                      <= max(int(n_space), 1)):
             w = q.popleft()
+            w.trace.mark("coalesced")   # left the queue into an epoch
             works.append(w)
             lanes += w.request.n_lanes
         if q is not None and not q:
@@ -292,6 +317,7 @@ class Scheduler:
 
         def _admit(works):
             for w in works:
+                w.trace.mark("admitted")   # joins the resident backlog
                 w.stall_s = inject.slow_request_delay(w.request.id)
                 epoch_works.append(w)
                 for off in range(w.request.n_lanes):
@@ -366,6 +392,7 @@ class Scheduler:
             finished = []
             for row, gid in enumerate(np.asarray(gids)):
                 w, off = gid_map[int(gid)]
+                w.trace.mark("first_harvest")   # idempotent: FIRST wins
                 w.t[off] = payload["t"][row]
                 w.y[off] = payload["y"][row]
                 w.status[off] = payload["status"][row]
@@ -426,33 +453,67 @@ class Scheduler:
         if w.stall_s:
             # deterministic slow_request fault injection: the stall sits
             # between admission and harvest-resolution, exactly where a
-            # slow consumer would (resilience/inject.py)
+            # slow consumer would (resilience/inject.py); the trace's
+            # ``stalled`` mark opens here, so ``stalled -> resolved``
+            # carries the injected delay in the waterfall
+            w.trace.mark("stalled")
             rec = getattr(self.session, "recorder", None)
             if rec is not None:
                 rec.counter("serve_stalls")
                 rec.event("fault", kind="slow_request",
                           request=w.request.id, delay_s=w.stall_s)
             time.sleep(w.stall_s)
+        w.trace.mark("resolved")
         prov = ["success" if int(c) == int(SUCCESS) else "failed"
                 for c in w.status]
         result = RequestResult(
             request=w.request, t=w.t, y=w.y, status=w.status,
             n_accepted=w.n_acc, n_rejected=w.n_rej, stats=w.stats,
             observed=w.observed, provenance=prov,
-            elapsed_s=time.perf_counter() - w.submitted)
+            elapsed_s=w.trace.total_s(), trace=w.trace)
         with self._cond:
             self._settle_locked(w)
         rec = getattr(self.session, "recorder", None)
         if rec is not None:
             rec.counter("serve_answered")
-            rec.counter("serve_latency_s",
-                        time.perf_counter() - w.submitted)
+            self._record_trace(rec, w.trace)
         w.future.set_result(result)
 
+    def _record_trace(self, rec, trace):
+        """Fold one resolved trace onto the obs plane: the per-stage
+        ``serve_stage_seconds`` histograms (``{stage="total"}`` is the
+        request latency — the old summed ``serve_latency_s`` counter,
+        migrated), the ``request_trace`` JSONL event, and — past the
+        spec's ``slow_request_s`` threshold — a structured
+        ``slow_request`` event that arms the flight recorder with a
+        counter snapshot (obs/live.py), so a latency excursion leaves
+        postmortem evidence behind."""
+        total = trace.total_s()
+        for stage, dur in trace.segments().items():
+            rec.observe("serve_stage_seconds", dur, stage=stage)
+        rec.observe("serve_stage_seconds", total, stage="total")
+        rec.event("request_trace", **trace.to_attrs())
+        slow = float(getattr(self.session.spec, "slow_request_s", 0.0)
+                     or 0.0)
+        if slow and total >= slow:
+            from ..obs.live import flight_note_counters
+
+            rec.event("slow_request", request=trace.request_id,
+                      total_s=round(total, 6), threshold_s=slow,
+                      stages={s: round(v, 6)
+                              for s, v in trace.segments().items()})
+            flight_note_counters(rec)
+
     def _fail(self, w, exc):
+        w.trace.mark("resolved")
         with self._cond:
             self._settle_locked(w)
         rec = getattr(self.session, "recorder", None)
         if rec is not None:
             rec.counter("serve_failed")
+            # failed requests export their trace (a stream death's
+            # timing is postmortem evidence) but never enter the
+            # latency histograms — a half-served request's wall would
+            # poison the distributions the gate bands check
+            rec.event("request_trace", failed=True, **w.trace.to_attrs())
         w.future.set_exception(exc)

@@ -27,6 +27,12 @@ Request JSON (``POST /solve`` body, or one stdin-JSONL line)::
      "mech": "user-mech-7",       # optional mechanism routing key
                                   # (multi-mechanism store; upload id or
                                   # fingerprint prefix — docs/serving.md)
+     "trace": true,               # optional; the ok response gains a
+                                  # versioned "trace" section — the
+                                  # request-lifecycle stage waterfall
+                                  # (obs/trace.py; docs/serving.md).
+                                  # Absent/false responses are
+                                  # byte-identical to pre-trace ones
      "energy": "adiabatic_v"}     # optional non-isothermal mode
                                   # (docs/energy.md: adiabatic_v /
                                   # adiabatic_p; the session spec must
@@ -37,7 +43,8 @@ Request JSON (``POST /solve`` body, or one stdin-JSONL line)::
 Responses are ``{"v": 1, "id": ..., "status": "ok" | "error", ...}``:
 ``ok`` carries per-lane ``t`` / ``solver_status`` / ``provenance`` /
 final mole fractions ``x`` (+ ``tau`` when the session runs an ignition
-observer, and solver counter ``stats`` when it runs instrumented);
+observer, solver counter ``stats`` when it runs instrumented, and the
+``trace`` stage waterfall when the request asked for it);
 ``error`` carries ``{"code", "message"}`` with the codes ``invalid``
 (schema/species rejection), ``overloaded`` (admission-control
 backpressure — the queue bound is a promise, never silent queueing),
@@ -55,7 +62,7 @@ SCHEMA_VERSION = 1
 
 #: the only keys a request may carry (anything else is a loud error)
 _REQUEST_KEYS = ("v", "id", "T", "p", "X", "t1", "rtol", "atol", "Asv",
-                 "n_save", "mech", "energy")
+                 "n_save", "mech", "energy", "trace")
 
 #: the non-None energy-mode literals (energy/eqns.py ENERGY_MODES,
 #: duplicated here because the schema imports no jax-reaching module —
@@ -97,6 +104,13 @@ class Request:
     #: energy lane carries the trailing T state row, so it can never
     #: share a resident program with isothermal lanes.
     energy: str | None = None
+    #: request-lifecycle trace export (obs/trace.py): True adds the
+    #: versioned ``"trace"`` stage-waterfall section to the ok
+    #: response.  Pure response shaping — never part of pack_key, and
+    #: the server-side capture runs either way (the histograms are
+    #: always-on); False/absent responses are byte-identical to
+    #: pre-trace ones.
+    trace: bool = False
 
     @property
     def n_lanes(self):
@@ -235,6 +249,13 @@ def validate_request(obj, *, species=None, rtol_default=1e-6,
             f"request {rid!r}: mech must be a non-empty mechanism id "
             f"string; got {mech!r}")
 
+    trace = obj.get("trace", False)
+    if not isinstance(trace, bool):
+        raise ValueError(
+            f"request {rid!r}: trace must be a JSON boolean; got "
+            f"{trace!r} (true = add the stage-waterfall section to "
+            f"the response)")
+
     energy = obj.get("energy")
     if energy is not None:
         if energy not in ENERGY_MODES:
@@ -281,7 +302,7 @@ def validate_request(obj, *, species=None, rtol_default=1e-6,
             f"every lane")
     return Request(id=rid, T=bcast(T), p=bcast(p), Asv=bcast(Asv),
                    X=X, t1=t1, rtol=rtol, atol=atol, mech=mech,
-                   energy=energy)
+                   energy=energy, trace=trace)
 
 
 def validate_upload(obj, *, default_id=None):

@@ -13,7 +13,10 @@ discipline the whole test/CI tier uses) serving
   rejections to ``400``, a dead stream to ``500``.
 * ``GET /metrics`` — the session registry's Prometheus exposition (the
   PR-9 live plane: ``br_sweep_occupancy``, backlog depth, and the
-  ``serve_*`` queue gauges move between mid-flight scrapes).
+  ``serve_*`` queue gauges move between mid-flight scrapes, and the
+  ``br_serve_stage_seconds`` latency-stage histograms show the live
+  queue-wait vs solve-time distributions — docs/observability.md
+  "Histograms").
 * ``GET /healthz`` — registry liveness + the session's serving block
   (fingerprint, warm state, compile count, drain flag).
 
@@ -191,6 +194,14 @@ class ServingServer:
         h["serving"] = {**self.session.healthz_extra(),
                         "queued_lanes": queued,
                         "inflight_lanes": inflight,
+                        # the request-tracing plane's alarm config
+                        # (docs/observability.md "Request tracing"):
+                        # operators read whether slow-request
+                        # flight-recorder dumps are armed, and at what
+                        # threshold, off the daemon itself
+                        "slow_request_s": float(getattr(
+                            self.session.spec, "slow_request_s", 0.0)
+                            or 0.0),
                         "draining": bool(self.scheduler._draining)}
         if self.store is not None:
             h["serving"]["store"] = self.store.healthz()

@@ -55,7 +55,8 @@ _SOLVER_KEYS = ("method", "rtol", "atol", "jac_window", "linsolve",
                 "reaction_buckets", "energy_modes")
 _SERVE_KEYS = ("resident", "refill", "buckets", "poll_every",
                "max_queue_lanes", "idle_timeout_s", "request_timeout_s",
-               "max_lanes_per_request", "coalesce_s", "max_mechanisms")
+               "max_lanes_per_request", "coalesce_s", "max_mechanisms",
+               "slow_request_s")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,6 +116,13 @@ class SessionSpec:
     #: beyond this LRU-evict (their manifest entries unpin; the
     #: ``mech_evicted``/``aot_evictions`` counters record it)
     max_mechanisms: int = 8
+    #: slow-request alarm threshold [s] (docs/observability.md "Request
+    #: tracing"): a request whose server-side ``submitted -> resolved``
+    #: wall reaches this emits a structured ``slow_request`` event with
+    #: its stage decomposition and arms the flight recorder with a
+    #: counter snapshot.  0 (default) disables the alarm; the
+    #: histograms and per-request traces record regardless.
+    slow_request_s: float = 0.0
 
 
 def load_spec(source):
@@ -595,7 +603,27 @@ class SolverSession:
             payload["stats"] = {
                 k: np.asarray(v).tolist() for k, v in result.stats.items()
                 if k not in C.AUDIT_KEYS and k not in C.TIMELINE_KEYS}
+        if getattr(result.request, "trace", False) \
+                and result.trace is not None:
+            # the trace= opt-in (docs/serving.md): the versioned stage
+            # waterfall; absent-key requests get byte-identical
+            # pre-trace responses
+            payload["trace"] = result.trace.to_payload()
         return payload
+
+    def obs_report(self, meta=None):
+        """The session's full obs report (``obs.build_report`` over the
+        session recorder + compile watch): spans, counters, the
+        ``serve_stage_seconds`` histograms, and the per-request
+        ``request_trace`` events — the serving evidence artifact
+        ``scripts/serve.py --obs-out`` / ``serve_bench.py --obs-out``
+        write and ``scripts/obs_trace.py`` / ``obs_gate.py`` consume."""
+        from ..obs import build_report
+
+        base = {"entry": "serving", "fingerprint": self.fingerprint,
+                "mech": os.path.basename(self.spec.mech)}
+        return build_report(recorder=self.recorder, watch=self._watch,
+                            meta={**base, **(meta or {})})
 
     def healthz_extra(self):
         """Serving fields the daemon folds into ``/healthz``."""

@@ -109,6 +109,28 @@ snapshots that merge into one fleet view (counters summed, gauges
 max-reduced; `scripts/obs_fleet.py`), and the armed `FlightRecorder`
 dumps `flight_<ts>.jsonl` postmortems on wedges, retry exhaustion, and
 SIGTERM."""),
+    ("Request tracing", "batchreactor_tpu.obs.trace",
+     ["RequestTrace"],
+     """\
+Per-request latency waterfalls (docs/observability.md "Request
+tracing"): monotonic stage marks over the fixed vocabulary
+`submitted -> coalesced -> admitted -> first_harvest -> resolved`
+(+ `stalled` under fault injection), captured by the serving
+scheduler, exported in responses behind the `trace=` request key and
+as `request_trace` recorder events (`scripts/obs_trace.py` renders
+the waterfalls; `scripts/obs_gate.py` band-checks the derived
+`serve_stage_seconds` histograms against a banked baseline)."""),
+    ("Histograms", "batchreactor_tpu.obs.counters",
+     ["hist_new", "hist_observe", "hist_merge", "hist_quantile",
+      "hist_mean"],
+     """\
+Fixed log-spaced latency histograms (docs/observability.md
+"Histograms"): one shared bucket ladder (`HIST_BUCKET_EDGES`, 100 us
+doubling to ~52 s + overflow) so any two histograms merge by
+slot-wise sum; `Recorder.observe(name, seconds, **labels)` records,
+reports carry a `histograms` section, and `obs.export` renders the
+Prometheus `_bucket`/`_sum`/`_count` triple
+(`br_serve_stage_seconds{stage=}`)."""),
     ("Solver timelines", "batchreactor_tpu.obs.timeline",
      ["validate", "decode", "render", "has_timeline"],
      """\
@@ -178,7 +200,8 @@ policy (`aot_evictions` counter).  CLI: `scripts/warm_cache.py`
       "load_spec", "SessionSpec", "SolverSession", "SessionStore",
       "UnknownMechanism", "Scheduler",
       "RequestResult", "Overloaded", "Draining", "ServingServer",
-      "serve_jsonl", "SolveClient", "ServeError", "poisson_trace"],
+      "serve_jsonl", "SolveClient", "ServeError", "poisson_trace",
+      "trace_summary"],
      """\
 Sweep-as-a-service (docs/serving.md): a resident daemon answering a
 live stream of `(T, p, X, t1, rtol/atol)` requests from one warm,

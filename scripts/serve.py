@@ -59,6 +59,13 @@ def main(argv=None):
                     help="persistent compilation cache directory")
     ap.add_argument("--flight-dir", default=".",
                     help="directory for flight_*.jsonl postmortem dumps")
+    ap.add_argument("--obs-out",
+                    help="write the session obs report JSONL here at "
+                         "drain: spans, serve_stage_seconds histograms, "
+                         "and per-request request_trace events — the "
+                         "scripts/obs_trace.py waterfall and "
+                         "scripts/obs_gate.py input (the CI serve-smoke "
+                         "/ latency-gate artifact)")
     ap.add_argument("--store", action="store_true",
                     help="enable the multi-mechanism session store: "
                          "POST /mechanism uploads + per-request 'mech' "
@@ -122,11 +129,20 @@ def main(argv=None):
     arm_flight(recorder=session.recorder, dir=args.flight_dir,
                install_signal=True)
 
+    def _write_obs():
+        if not args.obs_out:
+            return
+        from batchreactor_tpu.obs import write_jsonl
+
+        write_jsonl(args.obs_out, session.obs_report())
+        print(f"[serve] obs report -> {args.obs_out}", file=sys.stderr)
+
     with session:
         if args.jsonl:
             scheduler.start()
             accepted, rejected = serve_jsonl(session, scheduler,
                                              sys.stdin, sys.stdout)
+            _write_obs()
             print(json.dumps({"served": {"accepted": accepted,
                                          "rejected": rejected,
                                          "compiles": session
@@ -150,6 +166,7 @@ def main(argv=None):
             # ServingServer.close drains the scheduler (every accepted
             # request answers) before stopping the HTTP thread
         flight_dump("serve-drain")
+        _write_obs()
         w = session.compile_summary()
         print(json.dumps({"drained": {
             "compiles": w["compiles"], "retraces": w["retraces"]}}),

@@ -21,6 +21,19 @@ The trace randomizes T within ``--T-lo/--T-hi`` and lane counts within
 ``--lanes`` (e.g. ``1,4``) from the seed's own rng, so two runs of one
 seed issue identical schedules AND identical conditions — a throughput
 delta is the server's, not the load's.
+
+Requests carry ``trace: true`` by default (``--no-trace`` reverts to
+the round-10 request shape), so the summary reports the SERVER-side
+stage decomposition (obs/trace.py waterfall stages, p50/p95 per stage)
+next to the client percentiles, and every answered request's client
+``latency_s`` is checked against the server ``submitted -> resolved``
+wall: server <= client always (the server cannot out-wait its own
+caller), and the gap — HTTP + JSON + thread-wakeup overhead — must
+stay under ``--attribution-tol-ms``, which catches clock and
+stage-attribution bugs (the seeded ``slow_request`` injection makes
+the stalled stage deterministic).  ``--obs-out`` banks the in-process
+session's obs report JSONL (histograms + request_trace events), the
+``scripts/obs_gate.py`` / ``obs_trace.py`` input.
 """
 
 import argparse
@@ -70,14 +83,39 @@ def main(argv=None):
                          "serve-smoke artifact)")
     ap.add_argument("--require-success", action="store_true",
                     help="exit 1 unless every request is answered ok "
-                         "with all-success per-lane provenance")
+                         "with all-success per-lane provenance (and, "
+                         "with traces on, client~server latency "
+                         "attribution within tolerance)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="drop the trace:true request key (the "
+                         "round-10 request shape; disables the "
+                         "server-stage summary + attribution check)")
+    ap.add_argument("--attribution-tol-ms", type=float, default=2000.0,
+                    help="max client latency minus server "
+                         "submitted->resolved wall per request "
+                         "(transport + client-thread-wakeup overhead; "
+                         "p50 is ~20 ms but open-loop thread "
+                         "contention spikes the tail, so the default "
+                         "stays CI-loose — an attribution BUG shows "
+                         "as server > client or a gap of order the "
+                         "total latency, far outside any band here)")
+    ap.add_argument("--obs-out",
+                    help="write the in-process session's obs report "
+                         "JSONL here after the trace (histograms + "
+                         "request_trace events; the obs_gate.py / "
+                         "obs_trace.py input — needs --spec)")
     args = ap.parse_args(argv)
     if not args.url and not args.spec:
         ap.error("--spec (in-process daemon) or --url (external) needed")
+    if args.obs_out and args.url:
+        ap.error("--obs-out reads the in-process session's recorder; "
+                 "use --spec (an external daemon writes its own via "
+                 "scripts/serve.py --obs-out)")
 
     from batchreactor_tpu.serving.client import (SolveClient,
                                                  poisson_trace,
-                                                 run_trace, summarize)
+                                                 run_trace, summarize,
+                                                 trace_summary)
 
     comp = {}
     for part in args.comp.split(","):
@@ -101,6 +139,10 @@ def main(argv=None):
                "T": [round(rng.uniform(args.T_lo, args.T_hi), 3)
                      for _ in range(k)],
                "X": comp, "t1": args.t1}
+        if not args.no_trace:
+            # no rng draw: the seeded schedule/conditions stay
+            # identical to the round-10 baselines with traces on or off
+            req["trace"] = True
         if len(mech_choices) > 1:
             # draw only in multi-mechanism mode: an unconditional draw
             # would consume rng state and silently change every seeded
@@ -210,6 +252,21 @@ def main(argv=None):
         for r in records)
     summary["all_success"] = bool(all_success)
 
+    # the server-side half of the evidence: stage decomposition next to
+    # the client percentiles + the client~server attribution check
+    # (serving.client.trace_summary — a violation is a clock or
+    # stage-attribution bug)
+    attribution_ok = True
+    tsum = trace_summary(records,
+                         attribution_tol_ms=args.attribution_tol_ms)
+    if tsum is not None:
+        attribution_ok = tsum["attribution"]["ok"]
+        summary.update(tsum)
+        if not attribution_ok:
+            print(f"[serve-bench] ATTRIBUTION violations (first 8): "
+                  f"{tsum['attribution']['violations']}",
+                  file=sys.stderr)
+
     if server is not None:
         if store is not None:
             # the compile/wall split per resident mechanism — the
@@ -220,6 +277,14 @@ def main(argv=None):
                     m["program_compiles"]
                 for m in store.mechanisms()}
         server.close()
+        if args.obs_out:
+            from batchreactor_tpu.obs import write_jsonl
+
+            write_jsonl(args.obs_out, session.obs_report(
+                meta={"bench_seed": args.seed,
+                      "bench_rate_hz": args.rate}))
+            print(f"[serve-bench] obs report -> {args.obs_out}",
+                  file=sys.stderr)
         w = session.compile_summary()
         # program_compiles is the warm-serving contract (0 after
         # warmup); "compiles" totals additionally count sub-ms host
@@ -239,11 +304,12 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(summary, fh, indent=1)
-    if args.require_success and not all_success:
-        bad = [r["id"] for r in records
-               if not (r and r["ok"])][:8]
-        print(f"[serve-bench] FAILED requests (first 8): {bad}",
-              file=sys.stderr)
+    if args.require_success and not (all_success and attribution_ok):
+        if not all_success:
+            bad = [r["id"] for r in records
+                   if not (r and r["ok"])][:8]
+            print(f"[serve-bench] FAILED requests (first 8): {bad}",
+                  file=sys.stderr)
         return 1
     return 0
 

@@ -559,6 +559,57 @@ class TestServingEndToEnd:
         assert counters["serve_answered"] >= 1
         assert counters["serve_lanes"] >= 2
 
+    def test_trace_request_and_histogram_scrape(self, h2o2_session):
+        """Acceptance (request tracing): a trace=true request over real
+        HTTP returns stage timestamps whose stages sum to the
+        client-observed latency within tolerance, the
+        br_serve_stage_seconds histogram buckets appear on a /metrics
+        scrape of the live daemon and MOVE between scrapes, and a
+        trace-less request's response carries no trace section."""
+        from batchreactor_tpu.serving.client import SolveClient
+        from batchreactor_tpu.serving.server import ServingServer
+
+        session = h2o2_session
+        sched = Scheduler(session)
+
+        def total_count(prom):
+            line = [ln for ln in prom.splitlines()
+                    if ln.startswith('br_serve_stage_seconds_count'
+                                     '{stage="total"}')]
+            return int(line[0].rsplit(" ", 1)[1]) if line else 0
+
+        with ServingServer(session, sched) as srv:
+            client = SolveClient(srv.url)
+            t0 = time.perf_counter()
+            resp = client.solve({"id": "traced", "T": [1200.0, 1300.0],
+                                 "X": _COMP, "t1": 5e-5,
+                                 "trace": True})
+            client_lat = time.perf_counter() - t0
+            tr = resp["trace"]
+            assert tr["v"] == 1 and tr["lanes"] == 2
+            offs = tr["stages"]
+            assert list(offs) == ["submitted", "coalesced", "admitted",
+                                  "first_harvest", "resolved"]
+            assert list(offs.values()) == sorted(offs.values())
+            # the stages decompose the total exactly, and the server
+            # wall matches the client-observed latency: server never
+            # exceeds client, transport/scheduling overhead bounded
+            assert sum(tr["segments"].values()) == pytest.approx(
+                tr["total_s"], abs=5e-5)
+            assert tr["total_s"] <= client_lat + 5e-3
+            assert client_lat - tr["total_s"] <= 0.75
+            prom1 = client.metrics()
+            n1 = total_count(prom1)
+            assert n1 >= 1
+            assert 'br_serve_stage_seconds_bucket{' in prom1
+            assert '# TYPE br_serve_stage_seconds histogram' in prom1
+            # the migrated summed counter must be gone for good
+            assert "serve_latency_s" not in prom1
+            resp2 = client.solve({"id": "plain", "T": [1250.0],
+                                  "X": _COMP, "t1": 5e-5})
+            assert "trace" not in resp2   # trace-off no-op
+            assert total_count(client.metrics()) == n1 + 1   # it moved
+
     def test_http_invalid_and_overload_codes(self, h2o2_session):
         from batchreactor_tpu.serving.client import (ServeError,
                                                      SolveClient)

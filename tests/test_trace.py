@@ -1,0 +1,522 @@
+"""Request-lifecycle tracing plane (obs/trace.py + the HISTOGRAM
+family + the gate/waterfall CLIs — docs/observability.md "Request
+tracing"/"Histograms").
+
+Tiers, device-free by construction (the fake-session scheduler and the
+obs layer import no jax):
+
+* **RequestTrace** — vocabulary, idempotent marks, monotone stage
+  offsets, payload/attrs exports;
+* **histograms** — fixed-bucket observe/merge/quantile math, the
+  report JSONL <-> Prometheus round trip, the ``serve_latency_s``
+  migration regression, and ``obs.diff``'s missing->empty convention;
+* **scheduler capture** against the fake session: all stages marked in
+  order (out-of-order harvest included), the trace-off no-op (response
+  payloads byte-identical with ``trace`` absent), the stalled stage
+  under injection, and the ``slow_request`` threshold event;
+* **CLIs** — ``scripts/obs_gate.py`` passing on in-band reports and
+  failing loudly on perturbed ones; ``scripts/obs_trace.py``
+  waterfalls + ``--slowest``.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from batchreactor_tpu.obs import (RequestTrace, Recorder,  # noqa: E402
+                                  build_report, diff, from_jsonl,
+                                  to_jsonl, to_prometheus)
+from batchreactor_tpu.obs import counters as C  # noqa: E402
+from batchreactor_tpu.obs import trace as T  # noqa: E402
+from batchreactor_tpu.resilience import inject  # noqa: E402
+from batchreactor_tpu.serving import schema  # noqa: E402
+from batchreactor_tpu.serving.scheduler import Scheduler  # noqa: E402
+
+from test_serving import FakeSession, _req, _request  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# RequestTrace
+# --------------------------------------------------------------------------
+class TestRequestTrace:
+    def test_vocabulary_and_monotone_offsets(self):
+        tr = RequestTrace("r1", pack_key=(1e-4, 1e-6, 1e-10, None),
+                          lanes=3)
+        for stage in ("coalesced", "admitted", "first_harvest",
+                      "resolved"):
+            tr.mark(stage)
+        offs = tr.stages()
+        assert list(offs) == ["submitted", "coalesced", "admitted",
+                              "first_harvest", "resolved"]
+        vals = list(offs.values())
+        assert vals == sorted(vals) and vals[0] == 0.0
+        segs = tr.segments()
+        assert all(d >= 0 for d in segs.values())
+        assert tr.total_s() == pytest.approx(sum(segs.values()))
+
+    def test_mark_idempotent_first_wins(self):
+        tr = RequestTrace("r1")
+        assert tr.mark("first_harvest", at=tr.at("submitted") + 1.0)
+        assert not tr.mark("first_harvest",
+                           at=tr.at("submitted") + 9.0)
+        assert tr.stages()["first_harvest"] == pytest.approx(1.0)
+
+    def test_unknown_stage_is_loud(self):
+        with pytest.raises(ValueError, match="unknown trace stage"):
+            RequestTrace("r1").mark("harvested")
+
+    def test_stalled_rides_between_harvest_and_resolve(self):
+        tr = RequestTrace("r1")
+        t0 = tr.at("submitted")
+        tr.mark("admitted", at=t0 + 0.1)
+        tr.mark("first_harvest", at=t0 + 0.2)
+        tr.mark("stalled", at=t0 + 0.25)
+        tr.mark("resolved", at=t0 + 0.75)
+        segs = tr.segments()
+        assert segs["stalled"] == pytest.approx(0.05)
+        assert segs["resolved"] == pytest.approx(0.5)
+
+    def test_exports_are_versioned_and_jsonable(self):
+        tr = RequestTrace("r9", pack_key=(1e-4, 1e-6, 1e-10, None),
+                          lanes=2)
+        tr.mark("resolved")
+        payload = tr.to_payload()
+        assert payload["v"] == T.TRACE_VERSION
+        attrs = tr.to_attrs()
+        assert attrs["request"] == "r9" and attrs["lanes"] == 2
+        json.dumps(attrs)   # the recorder-event JSONL contract
+
+
+# --------------------------------------------------------------------------
+# histogram math + exports
+# --------------------------------------------------------------------------
+class TestHistograms:
+    def test_observe_merge_quantile(self):
+        h = C.hist_new()
+        for v in (0.001, 0.001, 0.004, 0.03, 0.5):
+            C.hist_observe(h, v)
+        assert h["count"] == 5 and sum(h["counts"]) == 5
+        assert h["sum"] == pytest.approx(0.536)
+        m = C.hist_merge(h, h)
+        assert m["count"] == 10 and m["sum"] == pytest.approx(1.072)
+        # the single-slot ladder invariant: quantiles bracket the data
+        assert 0.0008 <= C.hist_quantile(h, 0.5) <= 0.0064
+        assert C.hist_quantile(C.hist_new(), 0.5) is None
+        assert C.hist_mean(h) == pytest.approx(0.536 / 5)
+
+    def test_overflow_quantile_is_top_edge(self):
+        h = C.hist_observe(C.hist_new(), 1e6)
+        assert C.hist_quantile(h, 0.99) == C.HIST_BUCKET_EDGES[-1]
+
+    def test_merge_rejects_schema_mismatch(self):
+        a, b = C.hist_new(), C.hist_new()
+        b["counts"] = b["counts"][:-1]
+        with pytest.raises(ValueError, match="bucket schemas differ"):
+            C.hist_merge(a, b)
+
+    def test_family_registered_with_histogram_semantics(self):
+        fams = [meta for meta in C.FAMILIES.values()
+                if tuple(meta["keys"]) == C.HIST_KEYS]
+        assert len(fams) == 1
+        assert fams[0]["semantics"] == "histogram"
+        assert fams[0]["missing_zero"]
+
+    def _recorder_with_hist(self):
+        r = Recorder()
+        r.counter("serve_answered", 3)
+        for v in (0.002, 0.02, 0.2):
+            r.observe("serve_stage_seconds", v, stage="total")
+        r.observe("serve_stage_seconds", 0.01, stage="first_harvest")
+        return r
+
+    def test_jsonl_round_trip_exact(self):
+        rep = build_report(recorder=self._recorder_with_hist())
+        assert from_jsonl(to_jsonl(rep)) == rep
+        series = rep["histograms"]["serve_stage_seconds"]
+        assert {tuple(s["labels"].items()) for s in series} == {
+            (("stage", "first_harvest"),), (("stage", "total"),)}
+
+    def test_prometheus_exposition_bucket_sum_count(self):
+        """The serve_latency_s migration regression: the exposition
+        carries the full histogram triple (cumulative buckets closing
+        at +Inf == _count) and NO summed latency counter."""
+        prom = to_prometheus(
+            build_report(recorder=self._recorder_with_hist()))
+        assert "# TYPE br_serve_stage_seconds histogram" in prom
+        assert ('br_serve_stage_seconds_bucket{le="+Inf",'
+                'stage="total"} 3') in prom
+        assert 'br_serve_stage_seconds_count{stage="total"} 3' in prom
+        assert 'br_serve_stage_seconds_sum{stage="total"}' in prom
+        # cumulative: each bucket line's value never decreases
+        cums = [int(ln.rsplit(" ", 1)[1]) for ln in prom.splitlines()
+                if ln.startswith("br_serve_stage_seconds_bucket")
+                and 'stage="total"' in ln]
+        assert cums == sorted(cums)
+        assert "serve_latency_s" not in prom
+
+    def test_diff_missing_is_empty(self):
+        """obs.diff on reports with/without the histogram family: the
+        missing side reads as empty (n 0), never None."""
+        with_h = build_report(recorder=self._recorder_with_hist())
+        without = build_report(recorder=Recorder())
+        out = diff(without, with_h)
+        assert 'hist serve_stage_seconds{stage="total"}: n 0 -> 3' \
+            in out
+        assert "None" not in out
+        assert diff(with_h, with_h).splitlines()[-1].startswith(
+            "  (no differences")
+
+
+# --------------------------------------------------------------------------
+# scheduler capture (fake session — no device work)
+# --------------------------------------------------------------------------
+@pytest.fixture(autouse=True)
+def _disarm_inject():
+    yield
+    inject.disarm()
+
+
+class TestSchedulerCapture:
+    def _serve(self, sess, requests, timeout=10.0):
+        sched = Scheduler(sess).start()
+        futs = [sched.submit(r) for r in requests]
+        results = [f.result(timeout) for f in futs]
+        sched.drain(5.0)
+        return results
+
+    @pytest.mark.parametrize("order", ["fifo", "reverse", "scramble"])
+    def test_stages_marked_monotone_under_any_harvest_order(self,
+                                                            order):
+        sess = FakeSession(harvest=order)
+        results = self._serve(sess, [
+            _request("a", [1000.0, 1100.0, 1200.0]),
+            _request("b", [1300.0])])
+        for res in results:
+            tr = res.trace
+            offs = list(tr.stages().values())
+            assert offs == sorted(offs)
+            assert set(tr.stages()) == {"submitted", "coalesced",
+                                        "admitted", "first_harvest",
+                                        "resolved"}
+            assert res.elapsed_s == pytest.approx(tr.total_s())
+
+    def test_histograms_and_trace_events_recorded(self):
+        sess = FakeSession()
+        self._serve(sess, [_request("a", [1000.0]),
+                           _request("b", [1100.0, 1200.0])])
+        hists = sess.recorder.hist_snapshot()
+        fam = hists["serve_stage_seconds"]
+        by_stage = {ser["labels"]["stage"]: ser["count"]
+                    for ser in fam}
+        assert by_stage["total"] == 2
+        assert by_stage["first_harvest"] == 2
+        _s, events, counters = sess.recorder.snapshot()
+        traces = [e for e in events if e["name"] == "request_trace"]
+        assert {e["attrs"]["request"] for e in traces} == {"a", "b"}
+        assert all(e["attrs"]["v"] == T.TRACE_VERSION for e in traces)
+        # the migrated counter must be gone
+        assert "serve_latency_s" not in counters
+
+    def test_trace_off_payload_byte_identical(self):
+        """The trace-off no-op: with ``trace`` absent the response
+        payload carries exactly the pre-trace keys (and an explicit
+        ``trace: false`` is indistinguishable from absent)."""
+        reqs = [schema.validate_request(_req(id=i, T=[1000.0], **kw))
+                for i, kw in (("plain", {}), ("off", {"trace": False}),
+                              ("on", {"trace": True}))]
+        sess = FakeSession()
+        by_id = {r.request.id: r for r in self._serve(sess, reqs)}
+
+        def payload(res):
+            # the render_result trace gate, minus the session's
+            # device-side rendering (fake session has none)
+            out = {"elapsed_ms": round(1e3 * res.elapsed_s, 3)}
+            if getattr(res.request, "trace", False) \
+                    and res.trace is not None:
+                out["trace"] = res.trace.to_payload()
+            return out
+
+        assert set(payload(by_id["plain"])) == {"elapsed_ms"}
+        assert set(payload(by_id["off"])) == {"elapsed_ms"}
+        assert set(payload(by_id["on"])) == {"elapsed_ms", "trace"}
+        tr = payload(by_id["on"])["trace"]
+        assert tr["v"] == T.TRACE_VERSION and tr["lanes"] == 1
+
+    def test_stalled_stage_under_injection(self):
+        inject.arm("slow_request:delay=0.2,request=slow")
+        sess = FakeSession()
+        results = self._serve(sess, [_request("slow", [1000.0])])
+        segs = results[0].trace.segments()
+        assert segs["stalled"] >= 0  # stall opens the stage...
+        assert segs["resolved"] >= 0.2  # ...and resolve carries it
+        by_stage = {ser["labels"]["stage"]: ser
+                    for ser in sess.recorder.hist_snapshot()
+                    ["serve_stage_seconds"]}
+        assert by_stage["resolved"]["sum"] >= 0.2
+
+    def test_slow_request_threshold_event_arms_flight(self):
+        from batchreactor_tpu.obs.live import (arm_flight,
+                                               disarm_flight)
+
+        inject.arm("slow_request:delay=0.15,request=slow")
+        sess = FakeSession(slow_request_s=0.1)
+        flight = arm_flight(recorder=sess.recorder,
+                            install_signal=False)
+        try:
+            self._serve(sess, [_request("slow", [1000.0]),
+                               _request("fast", [1100.0])])
+        finally:
+            disarm_flight()
+        _s, events, _c = sess.recorder.snapshot()
+        slow = [e for e in events if e["name"] == "slow_request"]
+        # BOTH requests breach: the injected stall pauses the driver
+        # thread exactly where a slow consumer would, so the
+        # co-harvested "fast" request feels it too (the inject.py
+        # contract) — and its waterfall shows where the time went
+        assert {e["attrs"]["request"] for e in slow} == {"slow",
+                                                         "fast"}
+        by_id = {e["attrs"]["request"]: e["attrs"] for e in slow}
+        assert by_id["slow"]["total_s"] >= 0.1
+        assert "stalled" in by_id["slow"]["stages"]
+        assert "stalled" not in by_id["fast"]["stages"]
+        # the flight ring saw the event AND the armed counter snapshot
+        kinds = [r["kind"] for r in flight.records()]
+        assert "counter_snapshot" in kinds
+        assert any(r.get("name") == "slow_request"
+                   for r in flight.records() if r["kind"] == "event")
+
+    def test_failed_requests_skip_histograms(self):
+        sess = FakeSession(fail=True)
+        sched = Scheduler(sess).start()
+        fut = sched.submit(_request("dead", [1000.0]))
+        with pytest.raises(RuntimeError):
+            fut.result(5.0)
+        sched.drain(5.0)
+        assert "serve_stage_seconds" not in \
+            sess.recorder.hist_snapshot()
+        _s, events, _c = sess.recorder.snapshot()
+        tr = [e for e in events if e["name"] == "request_trace"]
+        assert len(tr) == 1 and tr[0]["attrs"]["failed"] is True
+
+
+# --------------------------------------------------------------------------
+# schema: the trace request key
+# --------------------------------------------------------------------------
+class TestTraceKey:
+    def test_default_false_and_not_in_pack_key(self):
+        r = schema.validate_request(_req())
+        assert r.trace is False
+        r_on = schema.validate_request(_req(trace=True))
+        assert r_on.trace is True
+        assert r.pack_key() == r_on.pack_key()
+
+    def test_non_boolean_is_loud(self):
+        with pytest.raises(ValueError, match="trace must be a JSON "
+                                             "boolean"):
+            schema.validate_request(_req(trace="yes"))
+
+
+class TestFleetHistograms:
+    def test_snapshot_merge_and_fleet_exposition(self, tmp_path):
+        """Per-host snapshots carry the latency histograms, merge_fleet
+        sums them slot-wise, and the fleet exposition renders the
+        merged family — the cross-host latency view."""
+        from batchreactor_tpu.obs.live import (LiveRegistry,
+                                               fleet_prometheus,
+                                               merge_fleet,
+                                               read_fleet_snapshots,
+                                               write_fleet_snapshot)
+
+        for pid, durs in ((0, (0.01, 0.02)), (1, (0.04,))):
+            rec = Recorder()
+            for d in durs:
+                rec.observe("serve_stage_seconds", d, stage="total")
+            write_fleet_snapshot(str(tmp_path), pid,
+                                 LiveRegistry(recorder=rec))
+        snaps = read_fleet_snapshots(str(tmp_path))
+        merged = merge_fleet(snaps)
+        ser = merged["histograms"]["serve_stage_seconds"][0]
+        assert ser["labels"] == {"stage": "total"}
+        assert ser["count"] == 3
+        assert ser["sum"] == pytest.approx(0.07)
+        prom = fleet_prometheus(snaps)
+        assert ('br_fleet_serve_stage_seconds_count{stage="total"} 3'
+                in prom)
+        assert 'br_fleet_serve_stage_seconds_bucket{le="+Inf"' in prom
+
+    def test_merge_tolerates_pre_histogram_snapshots(self):
+        from batchreactor_tpu.obs.live import merge_fleet
+
+        merged = merge_fleet([{"pid": 0, "counters": {"x": 1},
+                               "gauges": {}}])
+        assert merged["histograms"] == {}
+        assert merged["counters"] == {"x": 1}
+
+
+class TestClientTraceSummary:
+    def _record(self, rid, latency_s, total_s, segments):
+        return {"id": rid, "ok": True, "latency_s": latency_s,
+                "send_at": 0.0, "code": None,
+                "response": {"trace": {"v": 1, "total_s": total_s,
+                                       "segments": segments,
+                                       "stages": {}, "lanes": 1}}}
+
+    def test_stage_decomposition_and_attribution(self):
+        from batchreactor_tpu.serving.client import trace_summary
+
+        recs = [self._record(f"r{i}", 0.05 + 0.01 * i, 0.04 + 0.01 * i,
+                             {"coalesced": 0.01,
+                              "first_harvest": 0.02 + 0.01 * i,
+                              "resolved": 0.01})
+                for i in range(4)]
+        s = trace_summary(recs, attribution_tol_ms=100.0)
+        assert set(s["server_stages"]) == {"coalesced", "first_harvest",
+                                           "resolved"}
+        assert s["server_stages"]["coalesced"]["p50_ms"] == 10.0
+        assert s["attribution"]["ok"]
+        assert s["attribution"]["max_gap_ms"] == pytest.approx(10.0)
+
+    def test_attribution_violations(self):
+        from batchreactor_tpu.serving.client import trace_summary
+
+        good = self._record("good", 0.05, 0.04, {})
+        server_exceeds = self._record("impossible", 0.02, 0.08, {})
+        huge_gap = self._record("gap", 3.0, 0.04, {})
+        s = trace_summary([good, server_exceeds, huge_gap],
+                          attribution_tol_ms=500.0)
+        assert not s["attribution"]["ok"]
+        assert {v["id"] for v in s["attribution"]["violations"]} == {
+            "impossible", "gap"}
+
+    def test_none_without_traces(self):
+        from batchreactor_tpu.serving.client import trace_summary
+
+        assert trace_summary([{"id": "x", "ok": True, "latency_s": 0.1,
+                               "response": {}}]) is None
+
+
+# --------------------------------------------------------------------------
+# the gate + waterfall CLIs
+# --------------------------------------------------------------------------
+def _bench_like_report():
+    r = Recorder()
+    r.counter("serve_requests", 5)
+    r.counter("serve_answered", 5)
+    for i in range(5):
+        tr = RequestTrace(f"req-{i}", pack_key=(1e-4, 1e-6, 1e-10,
+                                                None), lanes=1)
+        t0 = tr.at("submitted")
+        tr.mark("coalesced", at=t0 + 0.001 * (i + 1))
+        tr.mark("admitted", at=t0 + 0.002 * (i + 1))
+        tr.mark("first_harvest", at=t0 + 0.01 * (i + 1))
+        tr.mark("resolved", at=t0 + 0.012 * (i + 1))
+        for stage, dur in tr.segments().items():
+            r.observe("serve_stage_seconds", dur, stage=stage)
+        r.observe("serve_stage_seconds", tr.total_s(), stage="total")
+        r.event("request_trace", **tr.to_attrs())
+    return build_report(recorder=r, meta={"entry": "serving"})
+
+
+class TestObsGateCLI:
+    BASELINE = {
+        "schema": "br-obs-gate-v1",
+        "counters": {"serve_answered": {"equals": 5},
+                     "serve_failed": {"max": 0}},
+        "histograms": {"serve_stage_seconds": {
+            "stage=total": {"count": {"equals": 5},
+                            "p50_s": {"max": 1.0},
+                            "p99_s": {"max": 2.0}}}},
+        "compile": {"retraces": {"max": 0}},
+    }
+
+    def _run(self, tmp_path, baseline, capsys):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import obs_gate
+        from batchreactor_tpu.obs import write_jsonl
+
+        rep_path = tmp_path / "rep.jsonl"
+        write_jsonl(str(rep_path), _bench_like_report())
+        base_path = tmp_path / "base.json"
+        base_path.write_text(json.dumps(baseline))
+        rc = obs_gate.main(["--baseline", str(base_path),
+                            "--report", str(rep_path)])
+        return rc, capsys.readouterr()
+
+    def test_passes_in_band(self, tmp_path, capsys):
+        rc, out = self._run(tmp_path, self.BASELINE, capsys)
+        assert rc == 0
+        assert "gate passed" in out.out
+        assert "[FAIL]" not in out.out
+
+    def test_fails_loudly_on_perturbation(self, tmp_path, capsys):
+        bad = json.loads(json.dumps(self.BASELINE))
+        bad["histograms"]["serve_stage_seconds"]["stage=total"][
+            "p50_s"]["max"] = 1e-6
+        bad["counters"]["serve_answered"]["equals"] = 7
+        rc, out = self._run(tmp_path, bad, capsys)
+        assert rc == 1
+        assert "GATE FAILED: 2 band(s)" in out.err
+        assert "p50_s" in out.err and "serve_answered" in out.err
+
+    def test_missing_histogram_fails_quantile_band(self, tmp_path,
+                                                   capsys):
+        """A disappeared metric must fail, not vacuously pass: a
+        quantile band against an absent series reads 'no
+        observations'."""
+        bad = json.loads(json.dumps(self.BASELINE))
+        bad["histograms"]["serve_stage_seconds"] = {
+            "stage=nonexistent": {"p50_s": {"max": 1.0}}}
+        rc, out = self._run(tmp_path, bad, capsys)
+        assert rc == 1
+        assert "no observations" in out.err
+
+    def test_unknown_sections_and_bands_are_loud(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        from obs_gate import run_gate
+
+        with pytest.raises(ValueError, match="unknown gate section"):
+            run_gate({"frontier": {}}, _bench_like_report())
+        with pytest.raises(ValueError, match="unknown band key"):
+            run_gate({"counters": {"x": {"atmost": 1}}},
+                     _bench_like_report())
+
+
+class TestObsTraceCLI:
+    def test_waterfall_render_and_slowest(self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import obs_trace
+        from batchreactor_tpu.obs import write_jsonl
+
+        rep_path = tmp_path / "rep.jsonl"
+        write_jsonl(str(rep_path), _bench_like_report())
+        out_path = tmp_path / "wf.txt"
+        rc = obs_trace.main([str(rep_path), "--slowest", "2",
+                             "--out", str(out_path)])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "2 requests, slowest first" in text
+        # slowest first: req-4 (60ms total) before req-3
+        assert text.index("req-4") < text.index("req-3")
+        assert "submitted -> coalesced" in text
+        assert "admitted -> first_harvest" in text
+        assert out_path.read_text().strip() == text.strip()
+
+    def test_json_and_threshold(self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import obs_trace
+        from batchreactor_tpu.obs import write_jsonl
+
+        rep_path = tmp_path / "rep.jsonl"
+        write_jsonl(str(rep_path), _bench_like_report())
+        rc = obs_trace.main([str(rep_path), "--threshold-ms", "40",
+                             "--json"])
+        assert rc == 0
+        recs = [json.loads(ln) for ln in
+                capsys.readouterr().out.splitlines()]
+        # only req-3 (48ms) and req-4 (60ms) pass the 40ms threshold
+        assert {r["request"] for r in recs} == {"req-3", "req-4"}
