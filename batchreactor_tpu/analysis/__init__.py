@@ -24,8 +24,18 @@ solvers must stay pure, vmap-able, fixed-shape JAX programs.
   threaded host modules (serving/, obs/live.py, resilience/watchdog.py,
   parallel/sweep.py).
 
-CLI: ``python scripts/brlint.py batchreactor_tpu/`` / ``--tier C`` (see
-docs/development.md for the rule catalogue and suppression policy).
+* **Tier D** (:mod:`.costmodel` + :mod:`.budgets`) — the static jaxpr
+  cost/memory model: per-program FLOPs, bytes moved, and peak
+  live-buffer residency from per-primitive rules, with optional
+  ``budget=`` cost obligations on every ``@program_contract``
+  evaluated by the same engine (``--budgets`` / ``--tier D``).  The
+  stdlib :func:`~.costmodel.estimate_rung` half powers the
+  ``scripts/brcost.py`` (B, S, R) HBM ladder and S-ladder sweeps with
+  no jax at all.
+
+CLI: ``python scripts/brlint.py batchreactor_tpu/`` / ``--tier D``
+(rule catalogue and suppression policy: docs/development.md);
+``python scripts/brcost.py`` for cost tables and ladder reports.
 """
 
 from .core import (Finding, Baseline, all_rules, lint_file, lint_paths,
@@ -33,14 +43,22 @@ from .core import (Finding, Baseline, all_rules, lint_file, lint_paths,
 from . import rules_ast  # noqa: F401,E402  (registers the tier-A rules:
 #                          without this import the registry is empty and
 #                          lint_paths would vacuously scan clean)
+from .budgets import (  # noqa: E402  (stdlib-only)
+    BUDGET_RULES, Budget, CostProbe, check_budget)
 from .concurrency import (  # noqa: E402
     CONCURRENCY_RULES, lint_concurrency_file, lint_concurrency_paths)
 from .contracts import (  # noqa: E402  (stdlib-only at module scope;
     #                      jax loads lazily inside the engine)
     ProgramContract, all_contracts, program_contract, run_contracts)
+from .costmodel import (  # noqa: E402  (stdlib-only at module scope;
+    #                      jax loads lazily inside the walker)
+    Cost, contract_cost_table, cost_jaxpr, estimate_rung, fits_hbm,
+    lu32p_vmem_bytes)
 
 __all__ = ["Finding", "Baseline", "all_rules", "lint_file", "lint_paths",
            "load_suppressions", "CONCURRENCY_RULES",
            "lint_concurrency_file", "lint_concurrency_paths",
            "ProgramContract", "all_contracts", "program_contract",
-           "run_contracts"]
+           "run_contracts", "BUDGET_RULES", "Budget", "CostProbe",
+           "check_budget", "Cost", "contract_cost_table", "cost_jaxpr",
+           "estimate_rung", "fits_hbm", "lu32p_vmem_bytes"]

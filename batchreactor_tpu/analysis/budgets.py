@@ -1,0 +1,97 @@
+"""Tier D budget contracts: cost obligations on the traced programs.
+
+Every :func:`~.contracts.program_contract` may arm a :class:`Budget` —
+a set of static cost bounds evaluated by the SAME ``run_contracts``
+engine that checks purity/no-op-fork obligations (``budgets=True``,
+the ``--tier D`` CLI surface).  The engine costs the contract's traced
+program with :mod:`.costmodel` and every bound renders as a finding
+when violated, so a program whose FLOPs/step or peak residency
+silently regresses fails CI exactly like a purity leak would.
+
+Bands are authored against the vendored fixture mechanism (h2o2:
+S=9/R=27-ish scale) and deliberately loose — they catch structural
+regressions (an accidental O(n^3) in the step carry, a doubled
+Jacobian build, a kernel falling back to a library path with a fatter
+footprint), not single-flop drift across jax versions.  Absolute
+rung-scale budgets live in the brcost ladder (scripts/brcost.py), not
+here.
+"""
+
+import dataclasses
+
+from .core import Finding
+
+#: the tier-D rule catalogue (``brlint --list-rules``)
+BUDGET_RULES = {
+    "budget-flops": "traced program's FLOPs/step outside its "
+                    "contract's budget band",
+    "budget-peak-bytes": "traced program's peak live-buffer residency "
+                         "above its contract's budget",
+    "budget-vmem": "Pallas kernel's per-program VMEM footprint above "
+                   "its contract's budget (~16 MiB per core)",
+    "budget-unbound": "contract arms a budget= but yields no traced "
+                      "obligation to cost",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Budget:
+    """Static cost bounds for one contracted program, all optional:
+    ``flops_per_step`` is a ``(lo, hi)`` band on the one-trip walk
+    (catching both a cost explosion and a program that stopped doing
+    its work), ``peak_bytes`` / ``vmem_bytes`` are ceilings.  ``doc``
+    says how the band was chosen — it is echoed in the finding."""
+
+    flops_per_step: tuple = None     # (lo, hi) inclusive band
+    peak_bytes: int = None           # ceiling on live-buffer high-water
+    vmem_bytes: int = None           # ceiling on Pallas footprint
+    doc: str = ""
+
+
+@dataclasses.dataclass
+class CostProbe:
+    """An explicit 'cost THIS trace' obligation.  Contracts whose
+    other obligations carry the right jaxpr don't need one (the engine
+    budgets the first jaxpr-bearing obligation); contracts built from
+    ``Identical`` string pairs yield a CostProbe to opt into tier D.
+    Checked as a no-op outside ``budgets=True`` runs."""
+
+    tag: str
+    jaxpr: object
+
+
+def _fmt_bytes(b):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.4g} {unit}" if unit != "B" else f"{int(b)} B"
+        b /= 1024.0
+
+
+def check_budget(name, module, budget, cost, tag=None):
+    """Evaluate one contract's :class:`Budget` against its program's
+    walked :class:`~.costmodel.Cost`; returns findings (empty = the
+    program fits its budget)."""
+    where = f"<budget:{tag or name}>"
+    note = f" [{budget.doc}]" if budget.doc else ""
+    findings = []
+    if budget.flops_per_step is not None:
+        lo, hi = budget.flops_per_step
+        if not (lo <= cost.flops <= hi):
+            findings.append(Finding(
+                "budget-flops", where, 0, 0,
+                f"contract {name!r} ({module}): {cost.flops:.4g} "
+                f"FLOPs/step outside budget band [{lo:.4g}, {hi:.4g}]"
+                f"{note}"))
+    if budget.peak_bytes is not None and cost.peak_bytes > budget.peak_bytes:
+        findings.append(Finding(
+            "budget-peak-bytes", where, 0, 0,
+            f"contract {name!r} ({module}): peak residency "
+            f"{_fmt_bytes(cost.peak_bytes)} exceeds budget "
+            f"{_fmt_bytes(budget.peak_bytes)}{note}"))
+    if budget.vmem_bytes is not None and cost.vmem_bytes > budget.vmem_bytes:
+        findings.append(Finding(
+            "budget-vmem", where, 0, 0,
+            f"contract {name!r} ({module}): Pallas VMEM footprint "
+            f"{_fmt_bytes(cost.vmem_bytes)} exceeds budget "
+            f"{_fmt_bytes(budget.vmem_bytes)}{note}"))
+    return findings

@@ -13,13 +13,26 @@ static analysis.
   registry audits); ``--concurrency`` runs the host-concurrency lint
   (:mod:`.concurrency`) over the threaded host modules; ``--tier C``
   is shorthand for both (plus the tier-A scan of any paths given).
+* **Tier D** — ``--budgets`` additionally evaluates every contract's
+  armed cost :class:`~.budgets.Budget` against the static
+  :mod:`.costmodel` walk of its traced program (FLOPs/step band, peak
+  residency and Pallas-VMEM ceilings); ``--tier D`` = tier C +
+  ``--budgets``.  Rung-scale cost tables and the (B, S, R) HBM ladder
+  live in ``scripts/brcost.py``.
 
-Exit codes: 0 clean (or fully baselined), 1 findings, 2 usage error.
+**Exit-code contract** (regression-tested; the CI gates depend on it
+holding for ``--json`` exactly as for human output): 0 = clean (or
+fully baselined), 1 = one or more findings survived, 2 = usage error.
+With ``--json`` the findings land on stdout as one JSON document and
+the exit code is the ONLY failure signal a pipeline may trust — a
+crashed lint propagates its nonzero status rather than printing an
+empty findings list.
 
 Examples (docs/development.md):
   python scripts/brlint.py batchreactor_tpu/            # tier A
   python scripts/brlint.py --jaxpr                      # tier B
   python scripts/brlint.py --tier C --json              # full tier C
+  python scripts/brlint.py --tier D --json              # tier C + budgets
   python scripts/brlint.py --concurrency                # host lint only
   python scripts/brlint.py batchreactor_tpu/ --baseline brlint_baseline.json
 """
@@ -40,10 +53,12 @@ def _build_parser():
                     "docs/development.md)")
     p.add_argument("paths", nargs="*", help="files or directories to "
                                             "scan (tier A)")
-    p.add_argument("--tier", choices=["A", "B", "C", "a", "b", "c"],
+    p.add_argument("--tier",
+                   choices=["A", "B", "C", "D", "a", "b", "c", "d"],
                    help="run a whole tier: A = AST scan of paths, "
                         "B = --jaxpr, C = --contracts + --concurrency "
-                        "(plus the tier-A scan of any paths given)")
+                        "(plus the tier-A scan of any paths given), "
+                        "D = tier C + --budgets")
     p.add_argument("--select", help="comma-separated rule names to run "
                                     "(default: all)")
     p.add_argument("--list-rules", action="store_true",
@@ -70,6 +85,12 @@ def _build_parser():
                         "every registered traced program, the "
                         "CompileWatch-label completeness check, and "
                         "the fingerprint/counter registry audits")
+    p.add_argument("--budgets", action="store_true",
+                   help="tier D: evaluate every contract's armed cost "
+                        "Budget against the static jaxpr cost model "
+                        "(analysis/costmodel.py) — FLOPs/step band, "
+                        "peak-residency and Pallas-VMEM ceilings; "
+                        "implies --contracts")
     p.add_argument("--concurrency", action="store_true",
                    help="tier C: host-concurrency lint (lock "
                         "discipline, lock ordering, blocking-under-"
@@ -94,12 +115,22 @@ def main(argv=None):
         elif tier == "C":
             args.contracts = True
             args.concurrency = True
+        elif tier == "D":
+            args.contracts = True
+            args.concurrency = True
+            args.budgets = True
+    if args.budgets:
+        args.contracts = True   # budgets ride the contract engine
 
     if args.list_rules:
+        from .budgets import BUDGET_RULES
+
         for name, rule in sorted(all_rules().items()):
             print(f"{name:28s} {rule.rule_doc}")
         for name, doc in sorted(CONCURRENCY_RULES.items()):
             print(f"{name:28s} [concurrency] {doc}")
+        for name, doc in sorted(BUDGET_RULES.items()):
+            print(f"{name:28s} [budget] {doc}")
         return 0
 
     run_traced = args.jaxpr or args.contracts
@@ -155,7 +186,8 @@ def main(argv=None):
 
         traced_findings = run_contracts(
             fixtures_dir=args.fixtures,
-            registry_audits=bool(args.contracts))
+            registry_audits=bool(args.contracts),
+            budgets=bool(args.budgets))
         findings = findings + traced_findings
 
     if args.as_json:

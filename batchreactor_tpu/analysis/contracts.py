@@ -62,6 +62,9 @@ import dataclasses
 import os
 import traceback
 
+from .budgets import Budget, CostProbe, check_budget  # noqa: F401
+#                      (re-exported: contracts author budgets at their
+#                       definition sites; stdlib-only at module scope)
 from .core import Finding
 
 _CALLBACK_MARKERS = ("callback", "outside_call", "host_local")
@@ -165,6 +168,8 @@ class Contains:
 
 
 def _check_obligation(ob):
+    if isinstance(ob, CostProbe):
+        return []   # costed by the tier-D budget pass, not here
     if isinstance(ob, Pure):
         return _audit_jaxpr(ob.tag, ob.jaxpr, ob.check_dtype)
     if isinstance(ob, Identical):
@@ -191,6 +196,7 @@ class ProgramContract:
     labels: tuple      # CompileWatch single-program labels this covers
     doc: str
     module: str        # definition site, for reports
+    budget: object = None   # optional tier-D Budget (cost bounds)
 
 
 _REGISTRY = {}
@@ -212,7 +218,7 @@ OWNER_MODULES = (
 )
 
 
-def program_contract(name, *, labels=(), doc=""):
+def program_contract(name, *, labels=(), doc="", budget=None):
     """Decorator registering a traced program's contract at its
     definition site:
 
@@ -223,14 +229,18 @@ def program_contract(name, *, labels=(), doc=""):
     ``name`` is the registry key; ``labels`` lists the CompileWatch
     ``single_program`` region labels the program runs under (the
     completeness check matches them); the builder receives the shared
-    :class:`Harness` and yields obligations.  Re-registration under the
-    same name replaces (module reload in tests)."""
+    :class:`Harness` and yields obligations.  ``budget`` arms an
+    optional tier-D :class:`~.budgets.Budget`: the engine costs the
+    contract's first jaxpr-bearing obligation (or an explicit
+    :class:`~.budgets.CostProbe`) with :mod:`.costmodel` and bands it
+    when run with ``budgets=True``.  Re-registration under the same
+    name replaces (module reload in tests)."""
 
     def deco(fn):
         _REGISTRY[name] = ProgramContract(
             name=name, build=fn, labels=tuple(labels),
             doc=doc or (fn.__doc__ or "").strip().split("\n")[0],
-            module=fn.__module__)
+            module=fn.__module__, budget=budget)
         return fn
 
     return deco
@@ -499,12 +509,16 @@ def completeness_findings(root=None):
 # --------------------------------------------------------------------------
 # the engine
 # --------------------------------------------------------------------------
-def run_contracts(fixtures_dir=None, select=None, registry_audits=True):
+def run_contracts(fixtures_dir=None, select=None, registry_audits=True,
+                  budgets=False):
     """Tier C (a): import the owner modules (populating the registry),
     build the shared harness, evaluate every contract's obligations,
     and append the completeness check plus — ``registry_audits`` — the
-    fingerprint-completeness and counter-registry audits.  Returns a
-    list of :class:`~.core.Finding` (empty = every contract holds)."""
+    fingerprint-completeness and counter-registry audits.  With
+    ``budgets=True`` (tier D) each contract's armed
+    :class:`~.budgets.Budget` is additionally evaluated against the
+    :mod:`.costmodel` walk of its traced program.  Returns a list of
+    :class:`~.core.Finding` (empty = every contract holds)."""
     _import_owners()
     findings = []
     harness = Harness(fixtures_dir)
@@ -512,10 +526,21 @@ def run_contracts(fixtures_dir=None, select=None, registry_audits=True):
         if select is not None and name not in select:
             continue
         n_obligations = 0
+        probe_tag, probe_jaxpr, probe_explicit = None, None, False
         try:
             for ob in contract.build(harness):
                 n_obligations += 1
                 findings.extend(_check_obligation(ob))
+                jaxpr = getattr(ob, "jaxpr", None)
+                if jaxpr is not None and not isinstance(jaxpr, str):
+                    # an explicit CostProbe wins; else the first
+                    # jaxpr-bearing obligation is the costed program
+                    explicit = isinstance(ob, CostProbe)
+                    if (explicit and not probe_explicit) or \
+                            probe_jaxpr is None:
+                        probe_tag = getattr(ob, "tag", name)
+                        probe_jaxpr = jaxpr
+                        probe_explicit = explicit
         except Exception as e:  # noqa: BLE001 — one broken contract
             #                     must not silence the rest of the run
             tb = traceback.format_exc(limit=3)
@@ -529,6 +554,19 @@ def run_contracts(fixtures_dir=None, select=None, registry_audits=True):
                 "contract-empty", f"<contracts:{name}>", 0, 0,
                 f"contract {name!r} ({contract.module}) yielded no "
                 f"obligations: it verifies nothing"))
+        if budgets and contract.budget is not None:
+            if probe_jaxpr is None:
+                findings.append(Finding(
+                    "budget-unbound", f"<budget:{name}>", 0, 0,
+                    f"contract {name!r} ({contract.module}) arms a "
+                    f"budget= but yielded no jaxpr-bearing obligation "
+                    f"to cost; yield a CostProbe"))
+            else:
+                from .costmodel import cost_jaxpr
+
+                findings.extend(check_budget(
+                    name, contract.module, contract.budget,
+                    cost_jaxpr(probe_jaxpr), tag=probe_tag))
     if select is None:
         findings.extend(completeness_findings())
         if registry_audits:

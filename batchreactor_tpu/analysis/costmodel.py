@@ -1,0 +1,525 @@
+"""brcost tier D: the static jaxpr cost/memory model.
+
+Chip sessions are the scarcest resource in this repo (ROADMAP 1), yet
+nothing predicted whether a (B, S, R) ladder rung even fits HBM, and
+the dense-LU wall (ROADMAP 4) was asserted from complexity arguments
+rather than measured on the programs we actually trace.  This module
+turns both into checkable numbers *before* any device time is spent:
+
+* :func:`cost_jaxpr` — a jaxpr walker computing per-program **FLOPs,
+  bytes moved, and peak live-buffer residency** from per-primitive
+  cost rules (this tree is dot/conv-free: elementwise, reductions,
+  gathers/scatters, ``lu``/``triangular_solve``, and the ``exp``/
+  ``log`` rate transcendentals dominate), with structural handling of
+  ``while``/``cond``/``scan`` (per-iteration cost x trip bound, carry
+  residency), ``pjit`` sharding divisors, closed-over consts, and a
+  special-cased VMEM-footprint entry for the Pallas lu32p kernel.
+* :func:`estimate_rung` — a **stdlib closed-form** estimator of the
+  dense-Newton rung cost as a function of (B, S, R).  It needs no jax
+  (``warm_cache.py --list`` and the brcost ladder sweeps run on hosts
+  with no or a wedged jax install) and exposes the S^3 factorization /
+  (S+1)^2 Jacobian structure directly.
+* :func:`contract_cost_table` — costs every jaxpr the tier-C program
+  contracts already trace on the vendored fixtures; the table feeds
+  ``scripts/brcost.py`` and the CI ``cost-gate`` job.
+
+Model conventions and known error bounds (docs/development.md):
+
+* ``while`` bodies are counted at ``while_trip`` iterations (default
+  1), so "FLOPs/step" for a solver program means ONE pass through
+  every while body — one step attempt with one Newton iteration.
+  Real iteration counts come from the obs counters (``newton_iters``,
+  ``n_accepted``); the model supplies the per-iteration coefficient.
+  ``scan`` uses its static ``length``; ``cond`` takes the max branch.
+* Transcendentals (``exp``/``log``/``pow``/...) are weighted at
+  :data:`TRANSCENDENTAL_WEIGHT` flops/element and also counted
+  separately — on TPU they bound the rate-kernel cost, not the adds.
+* Peak residency holds program inputs + closed-over consts live for
+  the whole program (XLA input buffers persist unless donated) and
+  frees intermediates at last use.  It does not model fusion or
+  rematerialization, so it over-estimates small intermediates and
+  ignores XLA padding: treat it as a ~2x band, not a byte count.
+* ``pjit`` costs divide by the mesh device count when a sharded
+  in/out sharding is visible (even-sharding assumption); VMEM
+  footprints never divide.
+"""
+
+import dataclasses
+import math
+
+#: flops charged per transcendental element (exp/log/pow/erf...);
+#: also tallied separately in ``Cost.transcendentals``.  8 matches the
+#: order-of-magnitude ratio of TPU transcendental to add/mul issue
+#: rates; the absolute value is a convention, so bands in budgets and
+#: gate baselines must be regenerated if it ever changes.
+TRANSCENDENTAL_WEIGHT = 8
+
+#: per-core VMEM working budget the lu32p Pallas kernel must fit
+#: (v5e/v6e ~16 MiB of usable VMEM per core).
+VMEM_BUDGET_BYTES = 16 * 2 ** 20
+
+#: single-chip HBM of the v5e target (16 GB) — the ladder go/no-go.
+V5E_HBM_BYTES = 16 * 2 ** 30
+
+# solver/bdf.py history block: MAXORD + 3 rows of state per lane
+BDF_MAXORD = 5
+BDF_HIST_ROWS = BDF_MAXORD + 3
+
+# solver/linalg_pallas.py block size (padded_n mirrors it)
+LU32P_BLOCK = 8
+
+_ELEMWISE = {
+    "add", "sub", "mul", "max", "min", "rem", "neg", "abs", "sign",
+    "floor", "ceil", "round", "nextafter", "clamp", "select_n",
+    "and", "or", "xor", "not", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "eq", "ne", "lt", "le", "gt", "ge",
+    "is_finite", "copy", "real", "imag", "conj", "add_any",
+    "square",
+}
+_ELEMWISE_WEIGHTED = {"div": 4, "integer_pow": 3, "sqrt": 4, "rsqrt": 4}
+_TRANSCENDENTAL = {
+    "exp", "exp2", "expm1", "log", "log2", "log1p", "pow", "tanh",
+    "sinh", "cosh", "sin", "cos", "tan", "asin", "acos", "atan",
+    "atan2", "logistic", "erf", "erfc", "erf_inv", "lgamma", "digamma",
+    "cbrt",
+}
+_REDUCTION = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+    "reduce_precision", "cumsum", "cumprod", "cummax", "cummin",
+    "cumlogsumexp",
+}
+# pure data movement: bytes only, zero flops
+_MOVEMENT = {
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims",
+    "transpose", "convert_element_type", "slice", "dynamic_slice",
+    "concatenate", "pad", "rev", "iota", "stop_gradient",
+    "device_put", "gather", "bitcast_convert_type", "split",
+}
+# scatter family: one combine flop per updated element on the -add/
+# -mul/-min/-max variants, pure movement otherwise
+_SCATTER_COMBINE = {"scatter-add", "scatter_add", "scatter-mul",
+                    "scatter_mul", "scatter-min", "scatter_min",
+                    "scatter-max", "scatter_max"}
+# call-like primitives: descend, add nothing for the call itself
+_CALL_LIKE = {"pjit", "closed_call", "core_call", "custom_jvp_call",
+              "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+              "remat2", "checkpoint", "custom_jvp_call_jaxpr"}
+
+
+@dataclasses.dataclass
+class Cost:
+    """One program's static cost: floating-point work, memory traffic,
+    and residency.  ``flops`` includes the weighted transcendentals;
+    ``transcendentals`` counts their elements separately (the rate
+    kernels' real bound).  ``peak_bytes`` is the live-buffer high-water
+    mark; ``vmem_bytes`` the largest per-program Pallas footprint seen
+    (0 when no Pallas call)."""
+
+    flops: float = 0.0
+    transcendentals: float = 0.0
+    bytes_moved: float = 0.0
+    peak_bytes: int = 0
+    vmem_bytes: int = 0
+    n_while: int = 0
+    n_scan: int = 0
+    n_pallas: int = 0
+    by_prim: dict = dataclasses.field(default_factory=dict)
+
+    def _tally(self, prim, flops, count=1):
+        c, f = self.by_prim.get(prim, (0, 0.0))
+        self.by_prim[prim] = (c + count, f + flops)
+
+    def add_scaled(self, other, k=1):
+        """Fold ``other`` in at multiplicity ``k`` (loop trip counts
+        scale work and traffic; residency and VMEM take the max — a
+        loop reuses its carry, it does not allocate per trip)."""
+        self.flops += k * other.flops
+        self.transcendentals += k * other.transcendentals
+        self.bytes_moved += k * other.bytes_moved
+        self.peak_bytes = max(self.peak_bytes, other.peak_bytes)
+        self.vmem_bytes = max(self.vmem_bytes, other.vmem_bytes)
+        self.n_while += other.n_while
+        self.n_scan += other.n_scan
+        self.n_pallas += other.n_pallas
+        for prim, (c, f) in other.by_prim.items():
+            self._tally(prim, k * f, k * c)
+        return self
+
+    def as_dict(self, top=8):
+        """JSON-ready summary; ``by_prim`` keeps the ``top`` heaviest
+        primitives by flops."""
+        heavy = sorted(self.by_prim.items(), key=lambda kv: -kv[1][1])
+        return {
+            "flops": round(self.flops, 1),
+            "transcendentals": round(self.transcendentals, 1),
+            "bytes_moved": round(self.bytes_moved, 1),
+            "peak_bytes": int(self.peak_bytes),
+            "vmem_bytes": int(self.vmem_bytes),
+            "n_while": self.n_while,
+            "n_scan": self.n_scan,
+            "n_pallas": self.n_pallas,
+            "by_prim": {p: {"count": round(c, 1), "flops": round(f, 1)}
+                        for p, (c, f) in heavy[:top]},
+        }
+
+
+def _aval_bytes(aval):
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0               # tokens / abstract values: no storage
+    try:
+        return int(math.prod(int(d) for d in shape)) * dtype.itemsize
+    except TypeError:          # symbolic dims — count as 1
+        n = 1
+        for d in shape:
+            try:
+                n *= int(d)
+            except TypeError:
+                pass
+        return n * dtype.itemsize
+
+
+def _aval_elems(aval):
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    try:
+        return int(math.prod(int(d) for d in shape))
+    except TypeError:
+        return 1
+
+
+def _out_elems(eqn):
+    return sum(_aval_elems(v.aval) for v in eqn.outvars)
+
+
+def _in_elems(eqn):
+    return sum(_aval_elems(getattr(v, "aval", None))
+               for v in eqn.invars if hasattr(v, "aval"))
+
+
+def _eqn_bytes(eqn):
+    out = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+    inp = sum(_aval_bytes(getattr(v, "aval", None))
+              for v in eqn.invars if hasattr(v, "aval"))
+    return inp + out
+
+
+def _linalg_dims(eqn):
+    """(batch, n, k) for the lu / triangular_solve operands."""
+    aval = eqn.invars[0].aval
+    shape = tuple(int(d) for d in getattr(aval, "shape", ()) or (1, 1))
+    n = shape[-1] if shape else 1
+    batch = int(math.prod(shape[:-2])) if len(shape) > 2 else 1
+    k = 1
+    if eqn.primitive.name == "triangular_solve" and len(eqn.invars) > 1:
+        bshape = tuple(int(d)
+                       for d in getattr(eqn.invars[1].aval, "shape", ()))
+        if len(bshape) >= 2:
+            k = bshape[-1]
+    return batch, n, k
+
+
+def _dot_flops(eqn):
+    """2*M*N*K from dot_general dimension_numbers (absent from the hot
+    path here — kept so the model stays honest if one ever appears)."""
+    try:
+        (cdims, _), (bdims, _) = eqn.params["dimension_numbers"]
+        a = tuple(int(d) for d in eqn.invars[0].aval.shape)
+        contract = math.prod(a[i] for i in cdims) or 1
+        return 2.0 * _out_elems(eqn) * contract
+    except Exception:  # noqa: BLE001 — unknown layout: elementwise floor
+        return float(_out_elems(eqn))
+
+
+def _pjit_divisor(eqn):
+    """Mesh device count when a sharded in/out sharding is visible on a
+    pjit eqn (even-sharding assumption); 1 otherwise."""
+    best = 1
+    try:
+        for key in ("in_shardings", "out_shardings"):
+            for s in eqn.params.get(key) or ():
+                mesh = getattr(s, "mesh", None)
+                size = getattr(mesh, "size", None)
+                if size:
+                    best = max(best, int(size))
+    except Exception:  # noqa: BLE001 — sharding APIs drift across jax
+        return 1
+    return best
+
+
+def _pallas_vmem_bytes(eqn):
+    """Per-program VMEM footprint of a Pallas call.  The lu32p kernel
+    grids over the batch dimension with one whole padded matrix per
+    program, so the footprint is the trailing-2D block of every
+    operand/result plus one row-panel of scratch; without a readable
+    grid mapping this trailing-2D heuristic IS the special case."""
+    total = 0
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", None)
+        if shape is None:
+            continue
+        block = tuple(int(d) for d in shape[-2:]) or (1,)
+        total += int(math.prod(block)) * aval.dtype.itemsize
+    # row-panel scratch (the unblocked panel factorization works on a
+    # _BLOCK-row slab)
+    if total:
+        lead = max((int(v.aval.shape[-1])
+                    for v in eqn.invars
+                    if len(getattr(v.aval, "shape", ())) >= 2),
+                   default=0)
+        total += lead * LU32P_BLOCK * 4
+    return total
+
+
+def _sub_jaxprs(val):
+    if hasattr(val, "eqns") or hasattr(val, "jaxpr"):
+        yield val
+    elif isinstance(val, (list, tuple)):
+        for v in val:
+            yield from _sub_jaxprs(v)
+
+
+def _eqn_sub_jaxprs(eqn):
+    for val in eqn.params.values():
+        yield from _sub_jaxprs(val)
+
+
+def _walk(jaxpr, while_trip):
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    cost = Cost()
+
+    # residency: inputs + closed-over consts live for the whole
+    # program; intermediates freed at last use
+    base = sum(_aval_bytes(v.aval)
+               for v in list(jaxpr.invars) + list(jaxpr.constvars))
+    pinned = {id(v) for v in list(jaxpr.invars) + list(jaxpr.constvars)}
+    last_use = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if hasattr(v, "count"):        # Var, not Literal
+                last_use[id(v)] = i
+    for v in jaxpr.outvars:
+        if hasattr(v, "count"):
+            last_use[id(v)] = len(jaxpr.eqns)
+    cur = base
+    peak = base
+    alloc = {}                              # id(var) -> bytes
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        prim = eqn.primitive.name
+        out_elems = _out_elems(eqn)
+        moved = _eqn_bytes(eqn)
+        flops = 0.0
+        trans = 0.0
+        inner = None
+        inner_mult = 1
+
+        if prim == "while":
+            cost.n_while += 1
+            body = _walk(eqn.params["body_jaxpr"], while_trip)
+            cond = _walk(eqn.params["cond_jaxpr"], while_trip)
+            inner = Cost().add_scaled(body).add_scaled(cond)
+            inner_mult = while_trip
+        elif prim == "scan":
+            cost.n_scan += 1
+            inner = _walk(eqn.params["jaxpr"], while_trip)
+            inner_mult = int(eqn.params.get("length", 1) or 1)
+        elif prim == "cond":
+            branches = [_walk(b, while_trip)
+                        for b in eqn.params.get("branches", ())]
+            if branches:                     # max branch: conservative
+                inner = max(branches, key=lambda c: c.flops)
+                inner.peak_bytes = max(b.peak_bytes for b in branches)
+        elif prim in _CALL_LIKE:
+            inner = Cost()
+            for sub in _eqn_sub_jaxprs(eqn):
+                inner.add_scaled(_walk(sub, while_trip))
+            div = _pjit_divisor(eqn) if prim == "pjit" else 1
+            if div > 1:
+                inner.flops /= div
+                inner.transcendentals /= div
+                inner.bytes_moved /= div
+                inner.peak_bytes = -(-inner.peak_bytes // div)
+        elif "pallas" in prim:
+            cost.n_pallas += 1
+            vmem = _pallas_vmem_bytes(eqn)
+            cost.vmem_bytes = max(cost.vmem_bytes, vmem)
+            # work inside the kernel: the lu32p factorization is the
+            # only Pallas program in-tree — charge the dense LU count
+            batch, n, _ = _linalg_dims(eqn)
+            flops = batch * (2.0 / 3.0) * n ** 3
+        elif prim == "lu":
+            batch, n, _ = _linalg_dims(eqn)
+            flops = batch * (2.0 / 3.0) * n ** 3
+        elif prim == "triangular_solve":
+            batch, n, k = _linalg_dims(eqn)
+            flops = batch * float(n * n * k)
+        elif prim == "dot_general":
+            flops = _dot_flops(eqn)
+        elif prim in _TRANSCENDENTAL:
+            trans = float(out_elems)
+            flops = float(TRANSCENDENTAL_WEIGHT * out_elems)
+        elif prim in _ELEMWISE_WEIGHTED:
+            flops = float(_ELEMWISE_WEIGHTED[prim] * out_elems)
+        elif prim in _ELEMWISE:
+            flops = float(out_elems)
+        elif prim in _REDUCTION:
+            flops = float(_in_elems(eqn))
+        elif prim in _SCATTER_COMBINE:
+            flops = float(_aval_elems(eqn.invars[-1].aval)
+                          if eqn.invars else out_elems)
+        elif prim in _MOVEMENT or prim.startswith(("scatter",
+                                                   "dynamic_update")):
+            flops = 0.0
+        else:
+            # unknown primitive: elementwise floor, tallied visibly so
+            # a new heavy op cannot hide at zero cost
+            flops = float(out_elems)
+
+        if inner is not None:
+            cost.add_scaled(inner, inner_mult)
+            moved = 0.0                      # inner eqns counted theirs
+            peak = max(peak, cur + inner.peak_bytes)
+        cost.flops += flops
+        cost.transcendentals += trans
+        cost.bytes_moved += moved
+        cost._tally(prim, flops + (inner.flops * inner_mult
+                                   if inner is not None else 0.0))
+
+        for v in eqn.outvars:
+            if hasattr(v, "count") and id(v) not in alloc:
+                b = _aval_bytes(v.aval)
+                alloc[id(v)] = b
+                cur += b
+        peak = max(peak, cur)
+        for vid, b in list(alloc.items()):
+            if vid not in pinned and last_use.get(vid, -1) <= i:
+                cur -= b
+                del alloc[vid]
+
+    cost.peak_bytes = max(cost.peak_bytes, peak)
+    return cost
+
+
+def cost_jaxpr(jaxpr, *, while_trip=1):
+    """Cost a (closed) jaxpr.  ``while_trip`` is the symbolic trip
+    bound applied to every ``while`` body (default 1: the per-step /
+    per-Newton-iteration coefficient — see the module docstring for
+    the convention).  Returns a :class:`Cost`."""
+    return _walk(jaxpr, while_trip)
+
+
+# --------------------------------------------------------------------------
+# stdlib closed-form half: (B, S, R) rung estimates, no jax required
+# --------------------------------------------------------------------------
+def padded8(n):
+    """solver/linalg_pallas.py ``padded_n``: next multiple of 8."""
+    return max(int(-(-int(n) // LU32P_BLOCK)) * LU32P_BLOCK, LU32P_BLOCK)
+
+
+def lu32p_vmem_bytes(n):
+    """Per-program VMEM footprint of the lu32p kernel at state size
+    ``n``: padded f32 matrix in + LU out, an i32 pivot row, and one
+    _BLOCK-row panel slab of scratch."""
+    npad = padded8(n)
+    return npad * npad * 4 * 2 + npad * 4 + npad * LU32P_BLOCK * 4
+
+
+def estimate_rung(B, S, R=None, *, method="bdf", energy=False,
+                  linsolve="lu", jac_window=1, newton_iters=2,
+                  itemsize=8):
+    """Closed-form dense-Newton rung estimate at batch ``B``, ``S``
+    species, ``R`` reactions (``R=None``: the 4*S mechanism-shape
+    heuristic, flagged in the result).  Pure stdlib — callable from
+    ``warm_cache.py --list`` and the brcost ladder with no jax.
+
+    The structure IS the point (ROADMAP 4): the per-lane step cost is
+
+        (jac + lu)/jac_window + stages*(1+newton)*(rhs + trisolve)
+
+    with ``rhs ~ R*(10*T + 250) + 12*n`` (forward + reverse rates,
+    equilibrium constants from the Gibbs polynomials, third-body sums
+    — ~10 transcendentals at weight ``T`` and ~250 plain flops per
+    reaction, calibrated against the walked h2o2 fixture RHS),
+    ``jac ~ 2*rhs + 6*n^2`` (the closed-form dense Jacobian costs ~2
+    RHS evaluations plus the n^2 assembly), ``lu = 2/3 n^3`` (the S^3
+    wall), and ``trisolve = 2*n^2``.  HBM residency per lane is the
+    BDF history block (8 rows), the cached dense factor + Jacobian,
+    and O(n) of carry temporaries.  Calibrated against
+    :func:`cost_jaxpr` on the fixture mechanism in
+    tests/test_costmodel.py; treat absolute numbers as a ~3x band and
+    *ratios across rungs* as the signal."""
+    B, S = int(B), int(S)
+    n = S + (1 if energy else 0)
+    r_assumed = R is None
+    R = int(R) if R is not None else 4 * S
+    t = TRANSCENDENTAL_WEIGHT
+    rhs = R * (10.0 * t + 250.0) + 12.0 * n
+    jac = 2.0 * rhs + 6.0 * n * n
+    lu_f = (2.0 / 3.0) * n ** 3
+    tri = 2.0 * n * n
+    stages = 5 if method == "sdirk" else 1
+    jw = max(1, int(jac_window))
+    per_lane = (jac + lu_f) / jw + stages * (1 + newton_iters) * (rhs + tri)
+
+    factor_item = 4 if str(linsolve) in ("lu32p", "inv32") else itemsize
+    lane_bytes = ((BDF_HIST_ROWS + 16) * n * itemsize
+                  + n * n * (itemsize + factor_item))
+    const_bytes = (16 * R + n * R) * itemsize   # rate coeffs + stoich
+    hbm = B * lane_bytes + const_bytes
+    bytes_step = B * itemsize * (n * n * (1.0 / jw
+                                          + stages * newton_iters)
+                                 + 16.0 * n)
+    return {
+        "B": B, "S": S, "R": R, "n": n, "method": method,
+        "energy": bool(energy), "linsolve": str(linsolve),
+        "jac_window": jw, "r_assumed": r_assumed,
+        "flops_per_lane_step": per_lane,
+        "flops_per_step": B * per_lane,
+        "bytes_per_step": bytes_step,
+        "hbm_bytes": int(hbm),
+        "vmem_bytes": (lu32p_vmem_bytes(n)
+                       if str(linsolve) == "lu32p" else 0),
+        "arithmetic_intensity": (B * per_lane / bytes_step
+                                 if bytes_step else 0.0),
+    }
+
+
+def fits_hbm(est, hbm_bytes=V5E_HBM_BYTES, headroom=0.8):
+    """Go/no-go: does the estimated resident footprint fit the chip's
+    HBM at the given headroom fraction (XLA scratch, executables, and
+    the model's own error band eat the rest)?"""
+    return est["hbm_bytes"] <= headroom * hbm_bytes
+
+
+# --------------------------------------------------------------------------
+# the contract-registry bridge: cost every traced program
+# --------------------------------------------------------------------------
+def contract_cost_table(fixtures_dir=None, select=None, while_trip=1):
+    """Trace every registered program contract on the vendored
+    fixtures and cost each jaxpr-bearing obligation.  Returns
+    ``{key: Cost}`` with ``key = "<contract>/<tag>"`` (collapsed to
+    the contract name when the tag matches) — the table rendered by
+    ``scripts/brcost.py`` and band-checked by the CI cost-gate."""
+    from . import contracts as C
+
+    C._import_owners()
+    harness = C.Harness(fixtures_dir)
+    table = {}
+    for name in sorted(C._REGISTRY):
+        if select is not None and name not in select:
+            continue
+        contract = C._REGISTRY[name]
+        for ob in contract.build(harness):
+            jaxpr = getattr(ob, "jaxpr", None)
+            if jaxpr is None or isinstance(jaxpr, str):
+                continue
+            tag = getattr(ob, "tag", name)
+            key = name if tag == name else f"{name}/{tag}"
+            if key not in table:
+                table[key] = cost_jaxpr(jaxpr, while_trip=while_trip)
+    return table

@@ -347,6 +347,88 @@ def env_read_in_trace(ctx):
                     symbol=info.qualname)
 
 
+def _env_read(ctx, node):
+    """``(name_node, form)`` when ``node`` is an environment READ:
+    ``os.getenv(...)`` / ``os.environ.get(...)``, a Load-context
+    ``os.environ[...]`` subscript, or an ``in os.environ`` membership
+    test.  Writes (assignment, ``setdefault``, ``pop``, ``del``) are
+    not reads and return None."""
+    if isinstance(node, ast.Call):
+        resolved = _resolve(ctx, node.func) or ""
+        if resolved in ("os.getenv", "os.environ.get") and node.args:
+            return node.args[0], resolved
+    elif (isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Load)
+            and _resolve(ctx, node.value) == "os.environ"):
+        return node.slice, "os.environ[...]"
+    elif (isinstance(node, ast.Compare) and len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.In, ast.NotIn))
+            and _resolve(ctx, node.comparators[0]) == "os.environ"):
+        return node.left, "in os.environ"
+    return None
+
+
+@register("env-var-unregistered",
+          "os.environ read of a knob absent from the ENV_KNOBS registry")
+def env_var_unregistered(ctx):
+    """Every environment read must name a knob declared in the
+    ``ENV_KNOBS`` registry (batchreactor_tpu/envknobs.py) with its
+    read-time class.  Two failure modes:
+
+    * an **unregistered** name — the knob surface grows silently and
+      nothing documents who owns the variable or when it is resolved;
+    * a knob registered ``read="import"`` (frozen at module import,
+      the BR_JAC_BARRIER convention) read **inside a function** — the
+      read-once contract would quietly become a read-sometimes bug.
+
+    Non-literal names are flagged too: a computed variable name is
+    unauditable by construction.  Runs everywhere (module scope
+    included — import-time reads are precisely the interesting ones),
+    unlike ``env-read-in-trace`` which only polices device-reachable
+    code."""
+    from ..envknobs import ENV_KNOBS
+
+    def visit(node, in_func):
+        hit = _env_read(ctx, node)
+        if hit is not None:
+            name_node, form = hit
+            if (isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str)):
+                var = name_node.value
+                knob = ENV_KNOBS.get(var)
+                if knob is None:
+                    yield Finding(
+                        "env-var-unregistered", ctx.path, node.lineno,
+                        node.col_offset,
+                        f"environment variable {var!r} (read via {form}) "
+                        f"is not declared in ENV_KNOBS "
+                        f"(batchreactor_tpu/envknobs.py); register its "
+                        f"name, read-time class and owner")
+                elif knob.read == "import" and in_func:
+                    yield Finding(
+                        "env-var-unregistered", ctx.path, node.lineno,
+                        node.col_offset,
+                        f"{var!r} is registered import-once "
+                        f"(ENV_KNOBS read='import', owner "
+                        f"{knob.owner}) but is read inside a function: "
+                        f"the read-once freeze becomes a read-sometimes "
+                        f"bug (BR_JAC_BARRIER class); read it at module "
+                        f"scope or re-class it")
+            else:
+                yield Finding(
+                    "env-var-unregistered", ctx.path, node.lineno,
+                    node.col_offset,
+                    f"non-literal environment variable name read via "
+                    f"{form}: the ENV_KNOBS registry can only audit "
+                    f"literal names")
+        nf = in_func or isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, nf)
+
+    yield from visit(ctx.tree, False)
+
+
 @register("implicit-dtype",
           "array creation without explicit dtype in device code")
 def implicit_dtype(ctx):

@@ -182,7 +182,7 @@ def _fold_entry(dst, src):
         if vals:
             dst[k] = max(vals) if k != "created" else min(vals)
     for k in ("bucket", "method", "flags", "jax", "package", "s_bucket",
-              "r_bucket"):
+              "r_bucket", "est_hbm_bytes", "est_flops_per_step"):
         if k in src:
             dst[k] = src[k]
     dst["pinned"] = bool(dst.get("pinned")) or bool(src.get("pinned"))
@@ -457,6 +457,19 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None,
                 if mech_shape is not None:
                     e["s_bucket"] = int(mech_shape[0])
                     e["r_bucket"] = int(mech_shape[1])
+                # static cost-model footprint for this bucket program
+                # (analysis/costmodel.py estimate_rung, ~3x band):
+                # warm_cache.py --list renders these columns with no
+                # jax, so an operator can audit resident-set sizing
+                # from the manifest alone
+                from ..analysis.costmodel import estimate_rung
+
+                est = estimate_rung(
+                    bucket, int(y0s.shape[-1]),
+                    int(mech_shape[1]) if mech_shape is not None
+                    else None, method=method)
+                e["est_hbm_bytes"] = int(est["hbm_bytes"])
+                e["est_flops_per_step"] = float(est["flops_per_step"])
     if man is not None:
         _save_manifest(cache_dir, man, manifest_tag)
     return results

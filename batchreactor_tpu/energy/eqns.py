@@ -308,8 +308,15 @@ def _contract_energy_eqns(h):
         "isothermal builders' programs byte-identical, and the cfg "
         "extension leaves the per-lane dict untouched")
 def _contract_energy_noop(h):
+    from ..analysis.contracts import CostProbe
     from ..ops.rhs import make_gas_jac, make_gas_rhs
 
+    # tier-D opt-in: every contract must produce a cost-table row
+    # (tests/test_costmodel.py), and this one's obligations are all
+    # string pairs — probe the mode=None RHS trace the fork pins
+    yield CostProbe("energy-rhs-none",
+                    h.jaxpr(make_energy_rhs(h.gm, h.th, None), 0.0,
+                            h.y0, h.cfg))
     yield Identical(
         "energy-noop-fork", "gas-rhs-energy-none",
         h.memo("gas-rhs-baseline",

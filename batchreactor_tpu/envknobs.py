@@ -1,0 +1,170 @@
+"""The declarative environment-knob registry (tier-A rule
+``env-var-unregistered``).
+
+Every ``os.environ`` / ``os.getenv`` read in this tree must name a
+knob registered here, with its **read-time class**:
+
+* ``"import"`` — read ONCE at module import and frozen (the
+  ``BR_JAC_BARRIER`` convention from the round-5 bug: a knob that is
+  baked into traces must have exactly one documented freeze point).
+  The lint additionally rejects an import-once knob being read inside
+  a function body, so the read-once bug class is structurally
+  impossible rather than a code-review convention.
+* ``"call"`` — resolved per call/construction; safe to toggle between
+  runs (but never inside a traced region — ``env-read-in-trace``
+  covers that independently).
+
+Owners name the module (package knobs) or script that resolves the
+knob; scripts are registered rather than scoped out so the probe-
+script surface (BENCH_*/NORTHSTAR_*/CP_*/...) is auditable with the
+same rule.  Stdlib-only: the brlint shim imports this with no jax.
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvKnob:
+    name: str
+    read: str        # "import" (frozen at module import) | "call"
+    owner: str       # module or script that resolves it
+    doc: str = ""
+
+
+def _build(rows):
+    knobs = {}
+    for row in rows:
+        name, read, owner = row[:3]
+        doc = row[3] if len(row) > 3 else ""
+        if name in knobs:
+            raise ValueError(f"duplicate env knob {name!r}")
+        if read not in ("import", "call"):
+            raise ValueError(f"env knob {name!r}: read-time class "
+                             f"{read!r} (want 'import' or 'call')")
+        knobs[name] = EnvKnob(name, read, owner, doc)
+    return knobs
+
+
+#: name -> :class:`EnvKnob`; the single source of truth the tier-A
+#: rule checks literal env reads against.
+ENV_KNOBS = _build([
+    # ---- package knobs -------------------------------------------------
+    ("BR_PLATFORM", "import", "batchreactor_tpu.__init__",
+     "pin jax_platforms before backend init (also read by "
+     "scripts/sens_rank.py pre-import)"),
+    ("BR_JAC_BARRIER", "import", "ops.rhs",
+     "opt_barrier around the Jacobian assembly; frozen at import BY "
+     "DESIGN (the round-5 read-once bug made this registry exist)"),
+    ("BR_EXP32", "call", "ops.gas_kinetics",
+     "f32 rate-exponential formulation; resolved when a rate kernel "
+     "is built (probe scripts set it before importing the package)"),
+    ("BR_METRICS_PORT", "call", "obs.live",
+     "default port for the live /metrics endpoint"),
+    ("BR_CHUNK_BUDGET_S", "call", "parallel.checkpoint",
+     "wall-clock chunk budget for checkpointed sweeps"),
+    ("BR_CHUNK_BUDGET_MULT", "call", "parallel.checkpoint",
+     "chunk-budget safety multiplier"),
+    ("BR_CHUNK_BUDGET_MIN_S", "call", "parallel.checkpoint",
+     "chunk-budget floor, seconds"),
+    ("BR_FETCH_DEADLINE_S", "call", "resilience.watchdog",
+     "device-fetch watchdog deadline (sweep contract arms it too)"),
+    ("BR_FAULT_INJECT", "call", "resilience.inject",
+     "armed fault-injection plan string"),
+    ("BR_LIB", "call", "native",
+     "path override for the native C++ runtime shared library"),
+    ("BENCH_PIPELINE", "call", "parallel.sweep",
+     "segmented-sweep pipelining gear (0 = blocking host loop)"),
+    ("BENCH_POLL_EVERY", "call", "parallel.sweep",
+     "termination-poll stride of the pipelined sweep"),
+    ("JAX_COMPILATION_CACHE_DIR", "call", "aot.registry",
+     "persistent XLA cache location (jax-standard name)"),
+    ("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "call",
+     "scripts (cache warmers)", "jax-standard cache threshold"),
+    ("JAX_PLATFORMS", "call", "scripts/sens_rank.py",
+     "jax-standard backend pin, set pre-import by probe scripts"),
+    # ---- bench.py ------------------------------------------------------
+    ("BENCH_B", "call", "bench.py", "ladder rung batch size(s)"),
+    ("BENCH_MODE", "call", "bench.py", "child-process stage selector"),
+    ("BENCH_METHOD", "call", "bench.py", "solver method for the rung"),
+    ("BENCH_LADDER", "call", "bench.py", "B-ladder list override"),
+    ("BENCH_CPU_LADDER", "call", "bench.py", "CPU-fallback ladder"),
+    ("BENCH_CPU_LIVE", "call", "bench.py", "live CPU baseline probe"),
+    ("BENCH_PIN_CPU", "call", "bench.py", "pin the CPU backend"),
+    ("BENCH_ECONOMY", "call", "bench.py", "setup-economy toggle"),
+    ("BENCH_JAC_WINDOW", "call", "bench.py", "Jacobian reuse window"),
+    ("BENCH_LINSOLVE", "call", "bench.py", "linear-solver selection"),
+    ("BENCH_NEWTON_TOL", "call", "bench.py", "Newton tolerance"),
+    ("BENCH_SEG_STEPS", "call", "bench.py", "steps per segment"),
+    ("BENCH_T_LO", "call", "bench.py", "temperature grid low end"),
+    ("BENCH_T_HI", "call", "bench.py", "temperature grid high end"),
+    ("BENCH_T1", "call", "bench.py", "integration horizon"),
+    ("BENCH_IGNITION", "call", "bench.py", "ignition preset toggle"),
+    ("BENCH_IGN_T_LO", "call", "bench.py", "ignition T0 grid low"),
+    ("BENCH_IGN_T_HI", "call", "bench.py", "ignition T0 grid high"),
+    ("BENCH_ADMISSION", "call", "bench.py", "resident lane count"),
+    ("BENCH_REFILL", "call", "bench.py", "admission refill stride"),
+    ("BENCH_RAGGED", "call", "bench.py", "ragged workload preset"),
+    ("BENCH_OBS", "call", "bench.py", "device counter block + report"),
+    ("BENCH_LIVE_PORT", "call", "bench.py", "live metrics port"),
+    ("BENCH_RUNG_TIMEOUT", "call", "bench.py", "per-rung timeout"),
+    ("BENCH_STALE_TOL", "call", "bench.py", "banked-rung staleness"),
+    ("BENCH_TRACE_DIR", "call", "bench.py", "device trace output dir"),
+    # ---- probe / driver scripts ---------------------------------------
+    ("CCP_ABORT_ON_TIMEOUT", "call", "scripts/coupled_compile_probe.py"),
+    ("CCP_B", "call", "scripts/coupled_compile_probe.py"),
+    ("CCP_CPU", "call", "scripts/coupled_compile_probe.py"),
+    ("CCP_OUT", "call", "scripts/coupled_compile_probe.py"),
+    ("CCP_STAGE", "call", "scripts/coupled_compile_probe.py"),
+    ("CCP_STAGES", "call", "scripts/coupled_compile_probe.py"),
+    ("CCP_TIMEOUT", "call", "scripts/coupled_compile_probe.py"),
+    ("CJB_B", "call", "scripts/coupled_jac_bisect.py"),
+    ("CJB_CPU", "call", "scripts/coupled_jac_bisect.py"),
+    ("CJB_OUT", "call", "scripts/coupled_jac_bisect.py"),
+    ("CJB_STAGE", "call", "scripts/coupled_jac_bisect.py"),
+    ("CJB_STAGES", "call", "scripts/coupled_jac_bisect.py"),
+    ("CJB_TIMEOUT", "call", "scripts/coupled_jac_bisect.py"),
+    ("CP_B", "call", "scripts/coupled_probe.py"),
+    ("CP_EFFORT", "call", "scripts/coupled_probe.py"),
+    ("CP_JAC", "call", "scripts/coupled_probe.py"),
+    ("CP_JW", "call", "scripts/coupled_probe.py"),
+    ("CP_OUT", "call", "scripts/coupled_probe.py"),
+    ("CP_T1", "call", "scripts/coupled_probe.py"),
+    ("CS_STEPS", "call", "scripts/chip_session.py"),
+    ("CW_INTERVAL", "call", "scripts/chip_watch.py"),
+    ("CW_MAX_S", "call", "scripts/chip_watch.py"),
+    ("CW_PROBE_TIMEOUT", "call", "scripts/chip_watch.py"),
+    ("IB_B", "call", "scripts/inv_budget.py"),
+    ("IB_CPU", "call", "scripts/inv_budget.py"),
+    ("IB_K", "call", "scripts/inv_budget.py"),
+    ("IB_OUT", "call", "scripts/inv_budget.py"),
+    ("KB_B", "call", "scripts/kernel_budget.py"),
+    ("NB_N", "call", "scripts/northstar_baseline.py"),
+    ("NB_OUT", "call", "scripts/northstar_baseline.py"),
+    ("NB_SOLVERS", "call", "scripts/northstar_baseline.py"),
+    ("NORTHSTAR_ADMISSION", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_CHUNK", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_CKPT", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_CPU", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_ENERGY", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_JW", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_METHOD", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_NPHI", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_NT", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_OUT", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_PIPELINE", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_POLL", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_SEG", "call", "scripts/northstar_sweep.py"),
+    ("NORTHSTAR_SORT", "call", "scripts/northstar_sweep.py"),
+    ("PERF_B", "call", "scripts/perf_probe.py"),
+    ("PERF_TIMEOUT", "call", "scripts/perf_probe.py"),
+    ("TC_ANALYZE", "call", "scripts/trace_capture.py"),
+    ("TC_B", "call", "scripts/trace_capture.py"),
+    ("TC_CPU", "call", "scripts/trace_capture.py"),
+    ("TC_JW", "call", "scripts/trace_capture.py"),
+    ("TC_OUT", "call", "scripts/trace_capture.py"),
+    ("TC_SEG", "call", "scripts/trace_capture.py"),
+    ("TC_SEGMENTS", "call", "scripts/trace_capture.py"),
+    ("TPU_SMOKE_K", "call", "scripts/tpu_smoke.py"),
+    ("TPU_SMOKE_OUT", "call", "scripts/tpu_smoke.py"),
+    ("TPU_SMOKE_TIMEOUT", "call", "scripts/tpu_smoke.py"),
+])

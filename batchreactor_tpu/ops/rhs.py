@@ -229,12 +229,18 @@ def make_udf_rhs(udf, molwt, species=None):
 # f64-uniform (the dtype walk is skipped under the f32 rate-exponential
 # formulation; the harness resolves that).
 # --------------------------------------------------------------------------
-from ..analysis.contracts import Pure, program_contract  # noqa: E402
+from ..analysis.contracts import Budget, Pure, program_contract  # noqa: E402
 
 
 @program_contract(
     "rhs-modes",
-    doc="four chemistry modes + analytic jacobians: pure, f64-uniform")
+    doc="four chemistry modes + analytic jacobians: pure, f64-uniform",
+    # first jaxpr-bearing obligation = the gas RHS (h2o2 fixture:
+    # ~1.0e4 flops / ~16 KiB at the 2026-08 costmodel walk; 2.5x band
+    # — the rate kernel is the throughput bound, a silent doubling is
+    # exactly what tier D exists to catch)
+    budget=Budget(flops_per_step=(4e3, 2.5e4), peak_bytes=64 * 1024,
+                  doc="h2o2 gas RHS; 2.5x band vs the 2026-08 walk"))
 def _contract_rhs_modes(h):
     for tag, rhs, jac, y0, cfg in h.modes:
         yield Pure(tag, h.jaxpr(rhs, 0.0, y0, cfg),

@@ -2173,7 +2173,8 @@ def ignition_delay(ts, ys, marker, mode="peak"):
 # layer, built-and-run admission machinery, and a built-and-run timeline
 # ring.
 # --------------------------------------------------------------------------
-from ..analysis.contracts import Identical, Pure, program_contract  # noqa: E402
+from ..analysis.contracts import (Budget, CostProbe, Identical,  # noqa: E402
+                                  Pure, program_contract)
 
 
 def _contract_seg_tools(h):
@@ -2222,7 +2223,11 @@ def _segment_baseline_str(h):
 
 @program_contract(
     "sweep-segment", labels=("sweep-segment",),
-    doc="pipelined segment program, plain and stats-instrumented: pure")
+    doc="pipelined segment program, plain and stats-instrumented: pure",
+    # 2-lane fixture segment (step program + park/budget control block:
+    # ~9.7e4 flops / ~52 KiB at the 2026-08 costmodel walk; 2x band)
+    budget=Budget(flops_per_step=(4.5e4, 2.2e5), peak_bytes=192 * 1024,
+                  doc="2-lane h2o2 fixture segment; 2x band"))
 def _contract_segment(h):
     # the device-resident park/budget/accumulate control block and the
     # on-device trajectory gather meet the same purity contract as the
@@ -2257,6 +2262,9 @@ def _contract_segment_bucket(h):
                                      8)
         jaxpr = h.jaxpr(run_seg(seg_fn, cfgp), carryx)
         bucket_jaxprs.setdefault(bucket, []).append((Bx, str(jaxpr)))
+    # the padded program itself, costed in tier D: the bucket ladder's
+    # per-rung footprint comes from THIS trace shape
+    yield CostProbe("segment-bucket-padded", jaxpr)
     for bucket, traced in bucket_jaxprs.items():
         if len(traced) > 1:
             (b_a, j_a), (b_b, j_b) = traced[0], traced[-1]
@@ -2287,13 +2295,15 @@ def _contract_segment_resilience(h):
     _inject.arm("hang_fetch:delay=0.01;nan_lane:lane=0")
     os.environ["BR_FETCH_DEADLINE_S"] = "5"
     try:
-        j_armed = str(h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry))
+        jaxpr_armed = h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry)
+        j_armed = str(jaxpr_armed)
     finally:
         _inject.disarm()
         if prev_deadline is None:
             os.environ.pop("BR_FETCH_DEADLINE_S", None)
         else:
             os.environ["BR_FETCH_DEADLINE_S"] = prev_deadline
+    yield CostProbe("segment-resilience-armed", jaxpr_armed)
     yield Identical(
         "resilience-noop-fork", "segment-resilience-noop",
         j_unarmed, j_armed,
@@ -2344,7 +2354,9 @@ def _contract_admission(h):
         poll_every=1, method="bdf")
     assert int(stream_res.status.sum()) == 4  # 4 lanes, all SUCCESS(=1)
     carry = _init_segment_carry(y0b, 0.0, "bdf", None, None, False, 8)
-    j_post = str(h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry))
+    jaxpr_post = h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry)
+    j_post = str(jaxpr_post)
+    yield CostProbe("segment-admission-post", jaxpr_post)
     yield Identical(
         "admission-noop-fork", "segment-admission-noop",
         j_base, j_post,

@@ -489,12 +489,36 @@ class SolverSession:
         from ..aot import warmup as aot_warmup
 
         t0 = time.perf_counter()
+        specs = self.warmup_specs()
+        if log is not None:
+            # static pre-flight: predicted resident HBM across the
+            # warmed program set (analysis/costmodel.py, stdlib
+            # estimator — ~3x band) so a mis-sized resident cap is
+            # visible in the startup log BEFORE the chip pays for it
+            from ..analysis.costmodel import estimate_rung
+
+            def fmt(b):
+                return (f"{b / 2**20:.1f} MiB" if b >= 2**20
+                        else f"{b / 1024:.0f} KiB")
+
+            hbm = 0
+            for s in specs:
+                n = int(np.asarray(s["y0"]).shape[-1])
+                est = estimate_rung(
+                    max(s.get("lanes") or (1,)), n,
+                    int(self.gm.n_reactions))
+                hbm += est["hbm_bytes"]
+                log(f"[warmup] rung={max(s.get('lanes') or (1,))} n={n} "
+                    f"predicted resident ~{fmt(est['hbm_bytes'])}")
+            log(f"[warmup] predicted resident HBM across "
+                f"{len(specs)} warmed program(s): ~{fmt(hbm)} "
+                f"(static cost model, ~3x band)")
         # startup lifecycle, main thread only: warmup completes before
         # the scheduler/HTTP front-ends start (scripts/serve.py
         # ordering); healthz_extra only reads the reference, and a
         # GIL-atomic list-reference store cannot tear
         self.warmed = aot_warmup(  # brlint: disable=unguarded-shared-mutation
-            self.warmup_specs(), cache_dir=cache_dir, log=log)
+            specs, cache_dir=cache_dir, log=log)
         if self.recorder is not None:
             self.recorder.counter("serve_warmup_s",
                                   time.perf_counter() - t0)

@@ -1000,12 +1000,24 @@ def solve(
 # whose mixed-precision Newton preconditioner converts by design
 # (solver/linalg.py).
 # --------------------------------------------------------------------------
-from ..analysis.contracts import Identical, Pure, program_contract  # noqa: E402
+from ..analysis.contracts import (Budget, Identical, Pure,  # noqa: E402
+                                  program_contract)
+
+# tier-D budget bands (analysis/budgets.py): authored against the
+# costmodel walk of the h2o2 fixture trace (one while trip ~ one step
+# attempt; 2026-08 baseline ~5.3e4 flops, ~39 KiB peak).  The bands are
+# deliberately ~2x loose — they catch structural regressions (a doubled
+# Jacobian build, an O(n^3) sneaking into the carry), not flop drift
+# across jax versions.
+_STEP_BUDGET = Budget(
+    flops_per_step=(2.5e4, 1.1e5), peak_bytes=128 * 1024,
+    doc="h2o2 fixture step attempt; 2x band vs the 2026-08 walk")
 
 
 @program_contract(
     "bdf-step",
-    doc="BDF step program, plain and stats-instrumented: pure")
+    doc="BDF step program, plain and stats-instrumented: pure",
+    budget=_STEP_BUDGET)
 def _contract_bdf_step(h):
     yield Pure("bdf-step", h.solver_jaxpr(solve))
     yield Pure("bdf-step-stats", h.solver_jaxpr(solve, stats=True))
@@ -1013,7 +1025,10 @@ def _contract_bdf_step(h):
 
 @program_contract(
     "bdf-step-economy",
-    doc="setup-economy carry: pure; structural no-op at jac_window=1")
+    doc="setup-economy carry: pure; structural no-op at jac_window=1",
+    budget=Budget(
+        flops_per_step=(2.5e4, 1.2e5), peak_bytes=160 * 1024,
+        doc="h2o2 fixture, jac_window=4 economy carry; 2x band"))
 def _contract_bdf_economy(h):
     # the carried factorization is data in the while-loop carry, never a
     # callback or an in-loop staging

@@ -204,12 +204,19 @@ def lu32p_solve(lu_piv, b):
 # would keep the parity tests green while the hand-written kernel never
 # runs.
 # --------------------------------------------------------------------------
-from ..analysis.contracts import Contains, Pure, program_contract  # noqa: E402
+from ..analysis.contracts import (Budget, Contains, Pure,  # noqa: E402
+                                  program_contract)
 
 
 @program_contract(
     "bdf-step-lu32p",
-    doc="Pallas blocked-LU step program: pure, kernel actually present")
+    doc="Pallas blocked-LU step program: pure, kernel actually present",
+    # the VMEM ceiling is the hard one: the kernel grids one whole
+    # padded matrix per program, so a state size that blows ~16 MiB of
+    # VMEM must fail HERE, statically, not on the chip
+    budget=Budget(flops_per_step=(2.5e4, 1.2e5), peak_bytes=128 * 1024,
+                  vmem_bytes=16 * 2 ** 20,
+                  doc="h2o2 fixture step; VMEM = v5e per-core budget"))
 def _contract_lu32p(h):
     from .bdf import solve   # in-builder: bdf imports linalg imports here
 

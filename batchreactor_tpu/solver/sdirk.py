@@ -536,12 +536,16 @@ def solve(
 # the BDF step (dtype checks off: the Newton preconditioner converts by
 # design).
 # --------------------------------------------------------------------------
-from ..analysis.contracts import Pure, program_contract  # noqa: E402
+from ..analysis.contracts import Budget, Pure, program_contract  # noqa: E402
 
 
 @program_contract(
     "sdirk-step",
-    doc="SDIRK step program, plain and stats-instrumented: pure")
+    doc="SDIRK step program, plain and stats-instrumented: pure",
+    # 5 stage Newton solves per attempt: ~1.7x the BDF step on the
+    # fixture (9.2e4 flops, ~37 KiB peak at the 2026-08 walk); 2x band
+    budget=Budget(flops_per_step=(4.5e4, 2.0e5), peak_bytes=128 * 1024,
+                  doc="h2o2 fixture step attempt; 2x band"))
 def _contract_sdirk_step(h):
     yield Pure("sdirk-step", h.solver_jaxpr(solve))
     yield Pure("sdirk-step-stats", h.solver_jaxpr(solve, stats=True))

@@ -435,6 +435,18 @@ def rung_main():
     bound_live_port = live_srv.port if live_srv is not None else None
     if live_srv is not None:
         live_srv.close()
+    # static cost-model prediction for THIS rung's shape (analysis/
+    # costmodel.py estimate_rung): predicted FLOPs+bytes per step and
+    # resident HBM next to the measured wall, so a BENCH round can
+    # compute model-vs-measured arithmetic intensity without retracing
+    from batchreactor_tpu.analysis.costmodel import estimate_rung
+    _est = estimate_rung(
+        B, len(sp), int(gm.n_reactions), method=method,
+        energy=bool(ignition), linsolve=linsolve_resolved,
+        jac_window=int(solver_kw.get("jac_window", 1)))
+    cost_model = {k: _est[k] for k in
+                  ("flops_per_step", "bytes_per_step", "hbm_bytes",
+                   "arithmetic_intensity")}
     print(json.dumps({
         "B": B, "method": method, "wall_s": round(wall, 3),
         # live metrics endpoint (null = off): the with/without pair at
@@ -457,6 +469,9 @@ def rung_main():
         "tau_spread": ([round(float(v), 12) for v in
                         np.nanpercentile(tau, [10, 50, 90])]
                        if ignition and np.isfinite(tau).any() else None),
+        # static jaxpr cost model's prediction for this rung shape
+        # (~3x band; the measured-vs-predicted ratio is the signal)
+        "cost_model": cost_model,
         "occupancy": occ,
         "admitted_lanes": ctr_delta.get("admitted_lanes", 0),
         "compactions": ctr_delta.get("compactions", 0),

@@ -5,8 +5,14 @@
   python scripts/brlint.py --jaxpr                      # tier-B jaxpr audit
   python scripts/brlint.py --tier C --json              # tier C: contracts
                                                         #   + concurrency
+  python scripts/brlint.py --tier D --json              # tier C + budgets
   python scripts/brlint.py --concurrency                # host-race lint only
   python scripts/brlint.py batchreactor_tpu/ --baseline brlint_baseline.json
+
+Exit-code contract (regression-tested in tests/test_analysis.py; the
+CI gates key off it): 0 = clean, 1 = findings, 2 = usage error — with
+``--json`` exactly as without, and a crashed lint exits nonzero via
+the uncaught exception rather than printing an empty findings list.
 
 The implementation lives in batchreactor_tpu/analysis/ (rule catalogue and
 suppression policy: docs/development.md).  Tier A and the concurrency lint

@@ -219,7 +219,10 @@ round-10/11 latency/throughput evidence)."""),
     ("Static analysis (brlint)", "batchreactor_tpu.analysis",
      ["lint_paths", "lint_file", "Baseline", "Finding", "all_rules",
       "program_contract", "run_contracts", "all_contracts",
-      "lint_concurrency_paths", "lint_concurrency_file"],
+      "lint_concurrency_paths", "lint_concurrency_file",
+      "Budget", "CostProbe", "check_budget", "Cost", "cost_jaxpr",
+      "contract_cost_table", "estimate_rung", "fits_hbm",
+      "lu32p_vmem_bytes"],
      """\
 The tiered lint gate (docs/development.md): tier A is the AST
 tracer-safety scan; tier C is (a) the **program-contract registry** —
@@ -231,8 +234,16 @@ fingerprint-completeness and counter-registry audits, and (b) the
 **host-concurrency lint** (`lint_concurrency_paths`) over the threaded
 serving stack: lock discipline, `*_locked` call-site checking, lock
 ordering, blocking-under-lock, and the PR-8 donation-aliasing rule.
-CLI: `scripts/brlint.py` (`--tier C`, `--contracts`,
-`--concurrency`)."""),
+Tier D is the **static cost/memory model** (`cost_jaxpr`: per-program
+FLOPs, bytes moved, peak live-buffer residency, Pallas VMEM from
+per-primitive jaxpr rules) with **budget contracts** — a
+`@program_contract` grows an optional `budget=Budget(...)` band
+evaluated by the same engine — and the stdlib closed-form
+`estimate_rung`/`fits_hbm` half that powers the `scripts/brcost.py`
+(B, S, R) HBM ladder and S³ sweeps with no jax at all.
+CLI: `scripts/brlint.py` (`--tier C`/`--tier D`, `--contracts`,
+`--budgets`, `--concurrency`) and `scripts/brcost.py` (`--table`,
+`--gate`, `--ladder`, `--s-ladder`)."""),
     ("Kinetics kernels", "batchreactor_tpu.ops.rhs",
      ["make_gas_rhs", "make_gas_jac", "make_surface_rhs",
       "make_surface_jac", "make_udf_rhs"]),

@@ -87,7 +87,17 @@ def list_manifest(cache_dir):
             tag += "  [PINNED]"
         shape = (f" s={e['s_bucket']} r={e['r_bucket']}"
                  if "s_bucket" in e else "")
-        print(f"  {key}: bucket={e['bucket']}{shape} "
+        # static cost-model columns (banked by aot.warmup from
+        # analysis/costmodel.py estimate_rung, ~3x band); "-" for
+        # entries warmed before the model landed — this listing must
+        # stay runnable with no jax, so never recompute here
+        est_hbm = e.get("est_hbm_bytes")
+        est = (f" pred_hbm="
+               + (f"{est_hbm / 2**20:.1f}MiB" if est_hbm >= 2**20
+                  else f"{est_hbm / 1024:.0f}KiB")
+               + f" pred_flops/step={e['est_flops_per_step']:.3g}"
+               if est_hbm is not None else " pred_hbm=- pred_flops/step=-")
+        print(f"  {key}: bucket={e['bucket']}{shape}{est} "
               f"warmups={e['warmups']} "
               f"compiles={e['compiles']} ({e['compile_s']:.1f}s) "
               f"hits={e['cache_hits']} misses={e['cache_misses']} "

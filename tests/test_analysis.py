@@ -903,3 +903,107 @@ class TestConcurrencyLint:
 
         assert sweep._BRLINT_DONATING_BUILDERS == {
             "_cached_vsolve_segmented_ctrl": (4,)}
+
+
+# --- env-var-unregistered: the ENV_KNOBS registry rule --------------------
+
+class TestEnvKnobRule:
+    """Every os.environ read must name a registered ENV_KNOBS knob with
+    an honest read-time class (docs/development.md tier-A catalogue)."""
+
+    def test_unregistered_literal_name_flags(self, tmp_path):
+        findings, _ = _lint_snippet(tmp_path, """
+            import os
+
+            def f():
+                return os.environ.get("BR_NO_SUCH_KNOB", "0")
+            """, select={"env-var-unregistered"})
+        assert [f.rule for f in findings] == ["env-var-unregistered"]
+        assert "BR_NO_SUCH_KNOB" in findings[0].message
+
+    def test_import_class_knob_read_in_function_flags(self, tmp_path):
+        # BR_JAC_BARRIER is registered read="import" (frozen at module
+        # import, ops/rhs.py); a per-call read makes the operator docs lie
+        findings, _ = _lint_snippet(tmp_path, """
+            import os
+
+            def f():
+                return os.getenv("BR_JAC_BARRIER")
+            """, select={"env-var-unregistered"})
+        assert [f.rule for f in findings] == ["env-var-unregistered"]
+        assert "import" in findings[0].message
+
+    def test_non_literal_name_flags(self, tmp_path):
+        findings, _ = _lint_snippet(tmp_path, """
+            import os
+
+            def f(name):
+                return os.environ.get(name)
+            """, select={"env-var-unregistered"})
+        assert [f.rule for f in findings] == ["env-var-unregistered"]
+
+    def test_registered_call_class_read_is_clean(self, tmp_path):
+        # BR_EXP32 is registered read="call"; membership tests and
+        # env WRITES are out of scope either way
+        findings, _ = _lint_snippet(tmp_path, """
+            import os
+
+            def f():
+                os.environ["ANY_NAME_AT_ALL"] = "1"
+                if "BR_EXP32" in os.environ:
+                    return os.environ.get("BR_EXP32")
+            """, select={"env-var-unregistered"})
+        assert findings == []
+
+    def test_registry_is_well_formed(self):
+        from batchreactor_tpu.envknobs import ENV_KNOBS
+
+        assert len(ENV_KNOBS) >= 50
+        for name, knob in ENV_KNOBS.items():
+            assert knob.name == name
+            assert knob.read in ("import", "call")
+            assert knob.owner
+        # the package knobs the rule's import-class check keys off
+        assert ENV_KNOBS["BR_JAC_BARRIER"].read == "import"
+        assert ENV_KNOBS["BR_EXP32"].read == "call"
+
+
+# --- brlint CLI: tier D surface and the exit-code contract ----------------
+
+def test_cli_list_rules_includes_budget_rules(capsys):
+    assert brlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("budget-flops", "budget-peak-bytes", "budget-vmem",
+                 "budget-unbound", "env-var-unregistered"):
+        assert rule in out, rule
+
+
+def test_cli_json_exit_code_contract_subprocess(tmp_path):
+    """The documented scripts/brlint.py exit-code contract, end to end
+    through the real shim: findings -> 1, clean -> 0, with --json the
+    same as without (the CI gates trust ONLY the exit code)."""
+    import subprocess
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def make_r(n):
+            def rhs(t, y, cfg):
+                return y + jnp.zeros(3)
+            return rhs
+        """))
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    script = str(REPO / "scripts" / "brlint.py")
+    r = subprocess.run([sys.executable, script, str(bad), "--json"],
+                       capture_output=True, text=True)
+    assert r.returncode == 1, r.stderr
+    assert json.loads(r.stdout)["findings"], "exit 1 must carry findings"
+    r = subprocess.run([sys.executable, script, str(clean), "--json"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["findings"] == []
+    r = subprocess.run([sys.executable, script, "--json"],
+                       capture_output=True, text=True)
+    assert r.returncode == 2, "no work must be a usage error, not clean"

@@ -1,0 +1,345 @@
+"""Tier D: the static jaxpr cost/memory model (analysis/costmodel.py),
+budget contracts (analysis/budgets.py + the contract engine), and the
+scripts/brcost.py gate/ladder surfaces.
+
+The golden tables pin the 2026-08 walk of the h2o2-fixture traces in
+WIDE bands (2x): the model's job is catching structural regressions (an
+accidental O(n^3) op, a dropped Pallas kernel, a residency doubling),
+not flop-exact bookkeeping across jax versions — the band rationale
+lives in docs/development.md "Known model error".
+"""
+
+import importlib.util
+import json
+import math
+import pathlib
+import sys
+
+import pytest
+
+from batchreactor_tpu.analysis import (Budget, Cost, CostProbe,
+                                       check_budget, contract_cost_table,
+                                       cost_jaxpr, estimate_rung, fits_hbm,
+                                       lu32p_vmem_bytes, run_contracts)
+from batchreactor_tpu.analysis.costmodel import (V5E_HBM_BYTES,
+                                                 VMEM_BUDGET_BYTES)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def _load_brcost():
+    """Import scripts/brcost.py as a module (it is a script, not a
+    package member) for the gate-function unit tests."""
+    spec = importlib.util.spec_from_file_location(
+        "brcost", str(REPO / "scripts" / "brcost.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def table():
+    """ONE full trace of every registered contract on the vendored
+    fixtures — shared across the golden/gate/stats tests (the build is
+    the expensive part; every assertion after it is arithmetic)."""
+    return contract_cost_table(fixtures_dir=str(FIXTURES))
+
+
+# --- golden cost tables ---------------------------------------------------
+
+# (key, flops_lo, flops_hi, peak_hi_bytes): 2x bands around the 2026-08
+# walk values (h2o2 fixture, S=9) for every contracted program family
+_GOLDEN = [
+    ("bdf-step",                              2.6e4, 1.1e5, 80_000),
+    ("bdf-step-economy",                      2.6e4, 1.1e5, 110_000),
+    ("bdf-step-lu32p",                        2.7e4, 1.1e5, 81_000),
+    ("sdirk-step",                            4.5e4, 1.9e5, 76_000),
+    ("rhs-modes/gas-rhs",                     4.9e3, 2.0e4, 33_000),
+    ("rhs-modes/gas-jac",                     1.0e4, 4.3e4, 39_000),
+    ("rhs-modes/surf-rhs",                    7.0e2, 3.1e3, 9_000),
+    ("rhs-modes/coupled-rhs",                 5.7e3, 2.4e4, 39_000),
+    ("rhs-modes/udf-rhs",                     8.0e1, 4.0e2, 1_000),
+    ("energy-eqns/energy-bdf-step",           3.6e4, 1.5e5, 82_000),
+    ("mech-padding/gas-rhs-padded",           7.0e3, 2.9e4, 49_000),
+    ("sens-forward-step",                     6.9e4, 2.8e5, 96_000),
+    ("sens-adjoint-grad",                     1.0e7, 4.1e7, 250_000),
+    ("sweep-segment/segment-pipelined-step",  4.8e4, 2.0e5, 106_000),
+    ("sweep-segment-bucket/segment-bucket-padded",
+                                              9.2e4, 3.8e5, 155_000),
+    ("sweep-compact/sweep-compact-admit",     1.4e2, 6.0e2, 17_000),
+]
+
+
+def test_every_contract_is_costed(table):
+    """All 13 registered contracts produce at least one table row —
+    the Identical-only sweep contracts via their explicit CostProbe."""
+    from batchreactor_tpu.analysis.contracts import _REGISTRY
+
+    covered = {k.split("/")[0] for k in table}
+    missing = set(_REGISTRY) - covered
+    assert not missing, f"contracts with no cost row: {sorted(missing)}"
+    assert len(table) >= 25
+
+
+def test_golden_cost_bands(table):
+    errs = []
+    for key, lo, hi, peak_hi in _GOLDEN:
+        c = table.get(key)
+        if c is None:
+            errs.append(f"{key}: missing from table ({sorted(table)})")
+            continue
+        if not (lo <= c.flops <= hi):
+            errs.append(f"{key}: flops {c.flops} outside [{lo}, {hi}]")
+        if not (0 < c.peak_bytes <= peak_hi):
+            errs.append(f"{key}: peak {c.peak_bytes} outside (0, {peak_hi}]")
+    assert not errs, "\n".join(errs)
+
+
+def test_structural_orderings(table):
+    """The orderings the physics dictates, jax-version independent:
+    a Jacobian costs more than its RHS, a solver step more than either,
+    SDIRK's 5 stages more than BDF's 1, adjoint more than forward."""
+    t = {k: v.flops for k, v in table.items()}
+    assert t["rhs-modes/gas-jac"] > t["rhs-modes/gas-rhs"]
+    assert t["bdf-step"] > t["rhs-modes/gas-jac"]
+    assert t["sdirk-step"] > t["bdf-step"]
+    assert t["sens-adjoint-grad"] > t["sens-forward-step"] > t["bdf-step"]
+    # loop structure: step programs carry while loops, RHS programs none
+    assert table["bdf-step"].n_while > 0
+    assert table["rhs-modes/gas-rhs"].n_while == 0
+
+
+def test_stats_identity(table):
+    """cost(stats=True) == cost(stats=False) + counter-block delta:
+    the stats fork adds a small positive tally cost and nothing else —
+    the static twin of the obs zero-overhead-when-off contract."""
+    for plain, stats in [("bdf-step", "bdf-step/bdf-step-stats"),
+                         ("sdirk-step", "sdirk-step/sdirk-step-stats"),
+                         ("sweep-segment/segment-pipelined-step",
+                          "sweep-segment/segment-pipelined-step-stats")]:
+        delta = table[stats].flops - table[plain].flops
+        assert delta >= 0, f"{stats} cheaper than {plain}?"
+        assert delta <= 0.02 * table[plain].flops, \
+            f"{stats} counter block costs {delta} flops (> 2%)"
+        assert table[stats].transcendentals == table[plain].transcendentals
+
+
+# --- the lu32p VMEM contract ----------------------------------------------
+
+def test_lu32p_vmem_fit_both_ways(table):
+    """The traced fixture kernel's VMEM footprint matches the closed
+    form and fits; a mechanism too large for VMEM is caught BEFORE a
+    chip session (the n=1500 no-fit direction)."""
+    c = table["bdf-step-lu32p"]
+    assert c.n_pallas >= 1, "lu32p program lost its pallas_call"
+    assert c.vmem_bytes == lu32p_vmem_bytes(9)
+    assert c.vmem_bytes < VMEM_BUDGET_BYTES
+    assert lu32p_vmem_bytes(1500) > VMEM_BUDGET_BYTES
+    # non-Pallas programs must not report phantom VMEM
+    assert table["bdf-step"].vmem_bytes == 0
+
+
+def test_lu32p_vmem_budget_contract_evaluates(table):
+    """The armed vmem_bytes budget on bdf-step-lu32p passes on the
+    fixture, and a seeded too-small ceiling fails loudly."""
+    c = table["bdf-step-lu32p"]
+    ok = check_budget("x", "m", Budget(vmem_bytes=VMEM_BUDGET_BYTES), c)
+    assert ok == []
+    bad = check_budget("x", "m", Budget(vmem_bytes=c.vmem_bytes - 1), c)
+    assert [f.rule for f in bad] == ["budget-vmem"]
+
+
+# --- budget contracts through the real engine -----------------------------
+
+def test_budgeted_contracts_pass_on_fixtures():
+    findings = run_contracts(fixtures_dir=str(FIXTURES),
+                             select={"bdf-step", "rhs-modes"},
+                             budgets=True)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_over_budget_contract_fails_loudly():
+    """A contract whose program blows its armed budget produces
+    budget-flops/budget-peak-bytes findings (never a silent pass), and
+    a budget with no jaxpr-bearing obligation is itself a finding."""
+    from batchreactor_tpu.analysis.contracts import (_REGISTRY, Pure,
+                                                     program_contract)
+
+    @program_contract("tmp-over-budget",
+                      budget=Budget(flops_per_step=(1.0, 2.0),
+                                    peak_bytes=1))
+    def _tmp(h):
+        yield Pure("tmp", h.jaxpr(h.rhs, 0.0, h.y0, h.cfg))
+
+    @program_contract("tmp-unbound", budget=Budget(flops_per_step=(1, 2)))
+    def _tmp2(h):
+        return
+        yield
+
+    try:
+        findings = run_contracts(
+            fixtures_dir=str(FIXTURES),
+            select={"tmp-over-budget", "tmp-unbound"}, budgets=True)
+        rules = sorted(f.rule for f in findings)
+        assert "budget-flops" in rules
+        assert "budget-peak-bytes" in rules
+        assert "budget-unbound" in rules
+        # findings name the program and the measured-vs-budget numbers
+        flops_f = [f for f in findings if f.rule == "budget-flops"][0]
+        assert "tmp-over-budget" in flops_f.message
+    finally:
+        _REGISTRY.pop("tmp-over-budget", None)
+        _REGISTRY.pop("tmp-unbound", None)
+
+
+def test_budgets_off_by_default():
+    """Without budgets=True the same selection reports nothing — tier C
+    consumers see no cost findings."""
+    from batchreactor_tpu.analysis.contracts import (_REGISTRY, Pure,
+                                                     program_contract)
+
+    @program_contract("tmp-over-budget2",
+                      budget=Budget(flops_per_step=(1.0, 2.0)))
+    def _tmp(h):
+        yield Pure("tmp", h.jaxpr(h.rhs, 0.0, h.y0, h.cfg))
+
+    try:
+        findings = run_contracts(fixtures_dir=str(FIXTURES),
+                                 select={"tmp-over-budget2"})
+        assert [f for f in findings if f.rule.startswith("budget")] == []
+    finally:
+        _REGISTRY.pop("tmp-over-budget2", None)
+
+
+# --- the stdlib estimator: calibration, S^3, HBM fit ----------------------
+
+def test_estimator_calibrated_against_walker(table):
+    """estimate_rung's closed form lands within the documented ~3x band
+    of the real jaxpr walk on the fixture shape (B=1, S=9, R=29,
+    jac_window=1) — the number the HBM ladder and warm_cache columns
+    are built from."""
+    est = estimate_rung(1, 9, 29, method="bdf", itemsize=8)
+    measured = table["bdf-step"].flops
+    ratio = est["flops_per_lane_step"] / measured
+    assert 1 / 3 < ratio < 3, (est["flops_per_lane_step"], measured)
+    est5 = estimate_rung(1, 9, 29, method="sdirk")
+    ratio5 = est5["flops_per_lane_step"] / table["sdirk-step"].flops
+    assert 1 / 3 < ratio5 < 3
+
+
+def test_s_ladder_shows_cubic_wall():
+    """Doubling S multiplies the per-lane step cost by -> 8x once LU
+    dominates: the dense-Newton S^3 curve (ROADMAP 4) the brcost
+    --s-ladder report renders."""
+    f = {S: estimate_rung(256, S)["flops_per_lane_step"]
+         for S in (256, 512, 1024)}
+    assert 6.0 < f[512] / f[256] < 8.5
+    assert 6.5 < f[1024] / f[512] < 8.5
+    # log-log slope over the asymptotic leg
+    slope = (math.log(f[1024]) - math.log(f[512])) / math.log(2)
+    assert 2.7 < slope < 3.1
+    # and at small S the jac/rhs terms still matter: the ratio is NOT 8
+    small = estimate_rung(256, 8)["flops_per_lane_step"]
+    assert estimate_rung(256, 16)["flops_per_lane_step"] / small < 6.0
+
+
+def test_hbm_ladder_fit_both_ways():
+    """B=512 x gri30 fits a v5e; B=2M x a 200-species mechanism does
+    not — and the fit flips exactly at the headroom product."""
+    small = estimate_rung(512, 53, 325)
+    assert fits_hbm(small)
+    huge = estimate_rung(2_000_000, 200, 1000)
+    assert not fits_hbm(huge)
+    assert huge["hbm_bytes"] > 0.8 * V5E_HBM_BYTES
+    edge = dict(small, hbm_bytes=int(0.8 * V5E_HBM_BYTES) + 1)
+    assert not fits_hbm(edge)
+    assert fits_hbm(edge, headroom=1.0)
+
+
+def test_estimator_shape_flags():
+    est = estimate_rung(8, 10)
+    assert est["r_assumed"] and est["R"] == 40
+    est = estimate_rung(8, 10, 29, energy=True)
+    assert not est["r_assumed"] and est["n"] == 11
+    assert estimate_rung(8, 10, linsolve="lu32p")["vmem_bytes"] == \
+        lu32p_vmem_bytes(10)
+    assert estimate_rung(8, 10, linsolve="lu")["vmem_bytes"] == 0
+    # jac_window amortizes the jac+lu term and ONLY that term
+    jw1 = estimate_rung(8, 40, jac_window=1)["flops_per_lane_step"]
+    jw8 = estimate_rung(8, 40, jac_window=8)["flops_per_lane_step"]
+    assert jw8 < jw1
+
+
+# --- the brcost gate ------------------------------------------------------
+
+class TestCostGate:
+    def test_banked_baseline_passes(self, table):
+        """The committed CI baseline accepts the current table — the
+        cost-gate job is green at head."""
+        brcost = _load_brcost()
+        with open(FIXTURES / "cost_gate_baseline.json") as f:
+            baseline = json.load(f)
+        assert baseline["schema"] == brcost.GATE_SCHEMA
+        failures, lines = brcost.run_gate(baseline, table)
+        assert failures == [], "\n".join(failures)
+        assert len(lines) >= 50
+
+    def test_regression_and_missing_program_fail(self, table):
+        brcost = _load_brcost()
+        baseline = brcost.make_baseline(table, "test")
+        ok, _ = brcost.run_gate(baseline, table)
+        assert ok == []
+        # a silent 3x flop regression trips the band
+        shrunk = json.loads(json.dumps(baseline))
+        shrunk["programs"]["bdf-step"]["flops"]["max"] = 1.0
+        failures, _ = brcost.run_gate(shrunk, table)
+        assert any("bdf-step flops" in f for f in failures)
+        # a banked program vanishing from the registry fails loudly
+        t2 = dict(table)
+        del t2["bdf-step"]
+        failures, _ = brcost.run_gate(baseline, t2)
+        assert any("disappeared" in f for f in failures)
+
+    def test_gate_rejects_unknown_schema_and_metric(self, table):
+        brcost = _load_brcost()
+        with pytest.raises(ValueError, match="schema"):
+            brcost.run_gate({"schema": "bogus-v9", "programs": {}}, table)
+        with pytest.raises(ValueError, match="unknown cost metric"):
+            brcost.run_gate(
+                {"schema": brcost.GATE_SCHEMA,
+                 "programs": {"bdf-step": {"walls": {"max": 1}}}}, table)
+
+    def test_ladder_modes_need_no_jax(self):
+        """--ladder/--s-ladder run as a subprocess with jax imports
+        poisoned — the pre-chip go/no-go must work on a host with a
+        broken accelerator stack."""
+        import subprocess
+
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, runpy\n"
+             "sys.modules['jax'] = None\n"
+             "sys.argv = ['brcost', '--ladder', '--s-ladder', '--json']\n"
+             f"runpy.run_path({str(REPO / 'scripts' / 'brcost.py')!r}, "
+             f"run_name='__main__')"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert out["ladder"] and out["s_ladder"]["rows"]
+        assert 2.5 < out["s_ladder"]["loglog_slope"] < 3.1
+
+
+# --- Cost dataclass arithmetic --------------------------------------------
+
+def test_cost_add_scaled():
+    a = Cost(flops=10, transcendentals=1, bytes_moved=100, peak_bytes=50)
+    b = Cost(flops=3, transcendentals=2, bytes_moved=30, peak_bytes=80,
+             n_while=1)
+    a.add_scaled(b, 4)
+    assert a.flops == 22 and a.transcendentals == 9
+    assert a.bytes_moved == 220
+    assert a.peak_bytes == 80            # peaks max, never sum
+    assert a.n_while == 1                # structure, not trip-scaled
+    d = a.as_dict()
+    assert set(d) >= {"flops", "bytes_moved", "peak_bytes", "vmem_bytes"}
