@@ -82,8 +82,10 @@ from .core import FileContext, Finding, iter_python_files
 #: relative to the package root
 DEFAULT_MODULES = (
     "serving",
+    "fleet",
     os.path.join("obs", "live.py"),
     os.path.join("resilience", "watchdog.py"),
+    os.path.join("resilience", "heartbeat.py"),
     os.path.join("parallel", "sweep.py"),
 )
 

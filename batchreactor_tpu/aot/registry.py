@@ -320,7 +320,7 @@ def _resolve_spec(spec):
 
 
 def warmup(specs, *, cache_dir=None, configure=True, log=None,
-           manifest_tag=None):
+           manifest_tag=None, merge=False):
     """Pre-compile the canonical bucket programs for the given sweep
     specs; returns a list of :class:`WarmupResult` (one per program).
 
@@ -472,6 +472,14 @@ def warmup(specs, *, cache_dir=None, configure=True, log=None,
                 e["est_flops_per_step"] = float(est["flops_per_step"])
     if man is not None:
         _save_manifest(cache_dir, man, manifest_tag)
+        if merge and manifest_tag is not None:
+            # fold the part into the main manifest right here (the
+            # serving-fleet shape: N daemons warm one shared cache dir
+            # concurrently, each under its member tag — folding through
+            # merge_manifests is crash-atomic, where concurrent
+            # load+save of the ONE main manifest would silently drop
+            # the loser's counters)
+            merge_manifests(cache_dir, [manifest_tag])
     return results
 
 

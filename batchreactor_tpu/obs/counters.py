@@ -129,6 +129,18 @@ SERVE_KEYS = ("serve_requests", "serve_lanes", "serve_answered",
 #: the registry — ``obs.diff`` maps a missing key to 0 (the FAULT_KEYS
 #: convention).
 AOT_KEYS = ("aot_evictions", "mech_admitted", "mech_evicted")
+#: fleet-router counters (fleet/ — docs/serving.md "Fleet"): Recorder
+#: counters incremented by the router's routing loop (requests routed,
+#: transport/draining failovers, upstream error passthroughs, the
+#: no-routable-member refusal), the upload replication fan-out, and
+#: the membership refresh (ring joins/age-outs).  Host-side by
+#: construction — the router is jax-free.  Absent from a run that
+#: never routed — ``obs.diff`` maps a missing key to 0 (the FAULT_KEYS
+#: convention).
+FLEET_KEYS = ("route_requests", "route_failovers",
+              "route_upstream_errors", "route_no_members",
+              "fleet_uploads", "fleet_replications",
+              "fleet_members_joined", "fleet_members_left")
 #: request-latency HISTOGRAM families (obs/trace.py + serving/ —
 #: docs/observability.md "Histograms"): Recorder histograms
 #: (``Recorder.observe``) over the FIXED log-spaced bucket ladder
@@ -140,6 +152,13 @@ AOT_KEYS = ("aot_evictions", "mech_admitted", "mech_evicted")
 #: (obs/export.py).  A missing histogram family diffs as EMPTY (count
 #: 0), the missing->0 convention lifted to distributions.
 HIST_KEYS = ("serve_stage_seconds",)
+#: router-side latency HISTOGRAM family (fleet/router.py): wall time
+#: from request receipt to the member's answer over the same fixed
+#: ladder, labeled ``{path="direct"|"failover"}`` — the failover split
+#: is the fleet bench's evidence that re-routing costs what it claims
+#: (``serve_bench.py --router``).  Missing family diffs as EMPTY, the
+#: HIST_KEYS convention.
+ROUTE_HIST_KEYS = ("route_seconds",)
 
 
 #: THE counter-family registry (brlint tier-C counter-registry audit,
@@ -186,6 +205,10 @@ FAMILIES = {
     "serve-stage-hist": {"keys": HIST_KEYS, "kind": "host",
                          "semantics": "histogram",
                          "missing_zero": True},
+    "fleet": {"keys": FLEET_KEYS, "kind": "host",
+              "semantics": "additive", "missing_zero": True},
+    "route-hist": {"keys": ROUTE_HIST_KEYS, "kind": "host",
+                   "semantics": "histogram", "missing_zero": True},
 }
 
 

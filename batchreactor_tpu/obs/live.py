@@ -315,9 +315,18 @@ def _fleet_dir(ckpt_dir):
     return d
 
 
+def _pid_id(process_id):
+    # usually an OS pid, but in-process fleets (serve_bench --router: N
+    # members under ONE pid) pass string ids for distinct snapshot files
+    try:
+        return int(process_id)
+    except (TypeError, ValueError):
+        return str(process_id)
+
+
 def snapshot_path(ckpt_dir, process_id):
     return os.path.join(_fleet_dir(ckpt_dir),
-                        f"p{int(process_id)}.metrics.json")
+                        f"p{_pid_id(process_id)}.metrics.json")
 
 
 def write_fleet_snapshot(ckpt_dir, process_id, registry):
@@ -335,7 +344,7 @@ def write_fleet_snapshot(ckpt_dir, process_id, registry):
         hists = {name: [{"le": le, **ser} for ser in series]
                  for name, series
                  in registry.recorder.hist_snapshot().items()}
-    snap = {"pid": int(process_id), "time": time.time(),
+    snap = {"pid": _pid_id(process_id), "time": time.time(),
             "counters": counters, "gauges": gauges,
             "histograms": hists}
     path = snapshot_path(ckpt_dir, process_id)

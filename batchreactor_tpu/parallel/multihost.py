@@ -32,7 +32,6 @@ lanes from its local copy).
 
 import json
 import os
-import threading
 import time
 
 import jax
@@ -40,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..resilience.heartbeat import Heartbeat as _HeartbeatBase
+from ..resilience.heartbeat import file_age as heartbeat_file_age
 from .sweep import ensemble_solve, pad_batch
 
 
@@ -137,34 +138,23 @@ def _heartbeat_path(ckpt_dir, process_id):
     return os.path.join(_hosts_dir(ckpt_dir), f"p{int(process_id)}.hb")
 
 
-class _Heartbeat(threading.Thread):
+class _Heartbeat(_HeartbeatBase):
     """Daemon touching this process's heartbeat file every
-    ``interval_s`` — the liveness signal :func:`host_liveness` reads."""
+    ``interval_s`` — the liveness signal :func:`host_liveness` reads.
+    The implementation is the shared :class:`resilience.heartbeat.
+    Heartbeat` (the serving fleet's membership beats through the same
+    class); only the thread name is elastic-tier-specific."""
 
     def __init__(self, path, interval_s):
-        super().__init__(daemon=True, name="br-elastic-heartbeat")
-        self.path = path
-        self.interval_s = interval_s
-        self._stop = threading.Event()
-
-    def run(self):
-        while not self._stop.is_set():
-            try:
-                with open(self.path, "w") as f:
-                    f.write(str(time.time()))
-            except OSError:
-                pass   # a missed beat reads as slow, not dead-forever
-            self._stop.wait(self.interval_s)
-
-    def stop(self):
-        self._stop.set()
+        super().__init__(path, interval_s, name="br-elastic-heartbeat")
 
 
 def host_liveness(ckpt_dir, dead_after_s):
     """Per-process liveness from the heartbeat files:
     ``{process_id: (age_s, alive)}`` — ``alive`` is heartbeat age <=
-    ``dead_after_s``.  The survivor-side view the reassignment decision
-    (and the operator) reads."""
+    ``dead_after_s`` (``resilience.heartbeat`` semantics: a missed
+    beat reads as slow, not dead-forever).  The survivor-side view the
+    reassignment decision (and the operator) reads."""
     out = {}
     d = _hosts_dir(ckpt_dir)
     now = time.time()
@@ -172,9 +162,8 @@ def host_liveness(ckpt_dir, dead_after_s):
         if not (name.startswith("p") and name.endswith(".hb")):
             continue
         pid = int(name[1:-3])
-        try:
-            age = now - os.path.getmtime(os.path.join(d, name))
-        except OSError:
+        age = heartbeat_file_age(os.path.join(d, name), now=now)
+        if age is None:
             continue
         out[pid] = (age, age <= dead_after_s)
     return out

@@ -32,6 +32,11 @@ capability, threaded through the sweep/checkpoint/multihost stack
 * **guarded subprocesses** (:mod:`.guard`) — THE SIGTERM-with-grace
   wrapper (``run_guarded``) the PERF.md postmortems demanded, now one
   implementation shared by ``bench.py`` and every probe script.
+* **heartbeat liveness** (:mod:`.heartbeat`) — the file-mtime
+  heartbeat convention (one daemon thread touching a file, readers
+  calling its age against ``dead_after_s``) shared by the elastic
+  multihost sweep's chunk reassignment and the serving fleet's
+  membership ring (``fleet/membership.py``).
 
 This module (and everything it imports at module scope) is importable
 WITHOUT jax: ``bench.py``'s parent orchestrator deliberately never
@@ -47,6 +52,7 @@ class as the stats/economy no-op guarantees).
 
 from . import inject, quarantine  # noqa: F401  (submodule re-exports)
 from .guard import GuardedResult, run_guarded
+from .heartbeat import Heartbeat, file_age, is_alive
 from .policy import (QuarantinePolicy, RETRYABLE, RetryPolicy,
                      fallback_kwargs, normalize_quarantine, normalize_retry)
 from .quarantine import PROVENANCE_NAMES, native_oracle
@@ -75,6 +81,9 @@ __all__ = [
     "mark_suspect",
     "suspect_devices",
     "clear_suspects",
+    "Heartbeat",
+    "file_age",
+    "is_alive",
     "inject",
     "quarantine",
 ]

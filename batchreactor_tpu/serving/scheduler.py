@@ -335,6 +335,8 @@ class Scheduler:
         cap = getattr(self.session, "bucket_cap", None)
         coalesce = float(getattr(self.session.spec, "coalesce_s", 0.0)
                          or 0.0)
+        adaptive = bool(getattr(self.session.spec, "coalesce_adaptive",
+                                False))
         with self._cond:
             if coalesce > 0:
                 # batching window (SessionSpec.coalesce_s): give
@@ -346,10 +348,20 @@ class Scheduler:
                     return sum(w.request.n_lanes
                                for w in self._queues.get(key, ()))
 
-                deadline = time.monotonic() + coalesce
+                start = time.monotonic()
                 while (_key_lanes() < (cap or 1)
                        and not self._draining):
-                    left = deadline - time.monotonic()
+                    window = coalesce
+                    if adaptive:
+                        # ROADMAP 2d (SessionSpec.coalesce_adaptive):
+                        # the window the queue has EARNED — fill
+                        # fraction x coalesce_s, re-evaluated on every
+                        # wakeup.  Mostly-free resident slots mean the
+                        # batch was never coming: seed now, let
+                        # latecomers ride the live feed
+                        window = coalesce * (_key_lanes()
+                                             / float(cap or 1))
+                    left = start + window - time.monotonic()
                     if left <= 0:
                         break
                     self._cond.wait(left)

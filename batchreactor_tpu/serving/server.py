@@ -101,13 +101,20 @@ class ServingServer:
     daemon (``scripts/serve.py``)."""
 
     def __init__(self, session, scheduler, port=0, host="127.0.0.1",
-                 request_timeout=None, store=None):
+                 request_timeout=None, store=None, membership=None):
         self.session = session
         self.scheduler = scheduler
         #: multi-mechanism store (docs/serving.md): routes per-request
         #: ``mech`` keys and accepts ``POST /mechanism`` uploads; None
         #: keeps the single-mechanism daemon byte-compatible
         self.store = store
+        #: fleet membership (:class:`~..fleet.MemberRegistration`) —
+        #: when set, ``close()`` runs the drain handshake: the draining
+        #: flag goes up FIRST so the router stops sending new work (and
+        #: fails over in-flight retries) while this daemon finishes what
+        #: it already accepted, and the member deregisters LAST, after
+        #: the final request has answered
+        self.membership = membership
         self.request_timeout = float(
             session.spec.request_timeout_s if request_timeout is None
             else request_timeout)
@@ -205,6 +212,11 @@ class ServingServer:
                         "draining": bool(self.scheduler._draining)}
         if self.store is not None:
             h["serving"]["store"] = self.store.healthz()
+        if self.membership is not None:
+            h["serving"]["fleet"] = {
+                "member": self.membership.name,
+                "fleet_dir": self.membership.fleet_dir,
+            }
         return h
 
     # ---- lifecycle --------------------------------------------------------
@@ -239,7 +251,10 @@ class ServingServer:
 
     def close(self, drain_timeout=None):
         """Drain the scheduler (every accepted request answers), then
-        stop the HTTP thread."""
+        stop the HTTP thread.  Fleet mode adds the drain handshake
+        around that: mark draining first, deregister last."""
+        if self.membership is not None:
+            self.membership.mark_draining()
         if self.store is not None:
             self.store.drain(drain_timeout)
         self.scheduler.drain(drain_timeout)
@@ -248,6 +263,8 @@ class ServingServer:
             self._server.server_close()
             self._thread.join()
             self._server = self._thread = None
+        if self.membership is not None:
+            self.membership.deregister()
 
     def __enter__(self):
         return self.start()

@@ -55,7 +55,8 @@ _SOLVER_KEYS = ("method", "rtol", "atol", "jac_window", "linsolve",
                 "reaction_buckets", "energy_modes")
 _SERVE_KEYS = ("resident", "refill", "buckets", "poll_every",
                "max_queue_lanes", "idle_timeout_s", "request_timeout_s",
-               "max_lanes_per_request", "coalesce_s", "max_mechanisms",
+               "max_lanes_per_request", "coalesce_s",
+               "coalesce_adaptive", "max_mechanisms",
                "slow_request_s")
 
 
@@ -112,6 +113,17 @@ class SessionSpec:
     #: servers' max-batch-delay knob; 0 = dispatch immediately).  Lanes
     #: arriving after the seed still join through the live feed.
     coalesce_s: float = 0.0
+    #: adaptive batching window (ROADMAP 2d): scale the effective
+    #: coalesce window by the queue's fill fraction — an epoch whose
+    #: pack key has most of the resident program's slots FREE seeds
+    #: almost immediately (window ~ ``coalesce_s * queued/cap``, so an
+    #: unsaturated trace stops paying max-batch-delay for batches that
+    #: were never coming), while a nearly-full queue still waits up to
+    #: the full window for the last slots.  Latecomers ride the live
+    #: feed either way.  Off (False) keeps the fixed window — the
+    #: bit-exactness e2e tests pin a full fixed window so every
+    #: concurrent request provably joins one seed.
+    coalesce_adaptive: bool = False
     #: multi-mechanism store capacity (SessionStore): resident sessions
     #: beyond this LRU-evict (their manifest entries unpin; the
     #: ``mech_evicted``/``aot_evictions`` counters record it)
@@ -480,12 +492,17 @@ class SolverSession:
             energy_atol_scale(k, y0.shape[1], atol))
         return y0, cfg
 
-    def warmup(self, cache_dir=None, log=None):
+    def warmup(self, cache_dir=None, log=None, manifest_tag=None):
         """Pre-bake the session's program set (:mod:`~batchreactor_tpu.
         aot` — persistent cache + manifest + in-process dispatch cache).
         Returns the per-program :class:`aot.WarmupResult` list; after a
         warm pass a serving stream compiles nothing
-        (:meth:`compile_summary`)."""
+        (:meth:`compile_summary`).
+
+        ``manifest_tag`` names a per-member part manifest (fleet mode:
+        N daemons warming one shared ``cache_dir`` concurrently) that is
+        folded into the main manifest via the crash-atomic
+        ``aot.merge_manifests`` path instead of racing on it."""
         from ..aot import warmup as aot_warmup
 
         t0 = time.perf_counter()
@@ -518,7 +535,8 @@ class SolverSession:
         # ordering); healthz_extra only reads the reference, and a
         # GIL-atomic list-reference store cannot tear
         self.warmed = aot_warmup(  # brlint: disable=unguarded-shared-mutation
-            specs, cache_dir=cache_dir, log=log)
+            specs, cache_dir=cache_dir, log=log, manifest_tag=manifest_tag,
+            merge=manifest_tag is not None)
         if self.recorder is not None:
             self.recorder.counter("serve_warmup_s",
                                   time.perf_counter() - t0)

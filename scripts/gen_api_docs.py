@@ -216,6 +216,30 @@ rung under `mech_operands`.  Entry points: `scripts/serve.py`
 (HTTP + stdin-JSONL, `--store`/`--add-mech`) and
 `scripts/serve_bench.py` (seeded Poisson load, `--mechs` — the
 round-10/11 latency/throughput evidence)."""),
+    ("Fleet (replicated serving)", "batchreactor_tpu.fleet",
+     ["HashRing", "canonical_key", "request_key", "DEFAULT_VNODES",
+      "MemberRegistration", "MemberInfo", "read_members", "member_paths",
+      "DEFAULT_HEARTBEAT_S", "DEFAULT_DEAD_AFTER_S",
+      "UploadJournal", "replicate_upload", "FleetRouter"],
+     """\
+The replicated serving tier (docs/serving.md "Fleet"): N `serving/`
+daemons behind a thin, jax-free HTTP router that consistent-hashes
+each request by (mechanism fingerprint, pack key) so every member's
+warmed AOT programs and resident streaming epochs stay hot.
+Membership is elastic over a shared fleet dir via the
+`resilience.heartbeat` mtime convention (register / beat /
+drain-handshake / age-out); a member's death re-routes its arcs to
+the survivors with honest retry provenance (`router.failover`,
+`router.tried` — answered exactly once, never silently dropped).
+`POST /mechanism` uploads replicate fleet-wide (journal-first,
+idempotent by fingerprint, replayed to late joiners) and the router's
+`GET /metrics` serves the merged per-host fleet families plus the
+`route`/`failover`/`membership` counters and the `route_seconds`
+direct|failover histogram split.  Entry points:
+`scripts/serve_fleet.py` (N daemons + router under one supervisor),
+`scripts/serve.py --fleet-dir` (one member), and
+`scripts/serve_bench.py --router N` (per-host cond/s +
+failover-latency split)."""),
     ("Static analysis (brlint)", "batchreactor_tpu.analysis",
      ["lint_paths", "lint_file", "Baseline", "Finding", "all_rules",
       "program_contract", "run_contracts", "all_contracts",

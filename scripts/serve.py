@@ -75,7 +75,24 @@ def main(argv=None):
                     metavar="ID=MECH:THERM",
                     help="pre-admit extra mechanisms into the store "
                          "(implies --store); repeatable")
+    ap.add_argument("--fleet-dir",
+                    help="join the replicated serving tier (docs/"
+                         "serving.md \"Fleet\"): register in this "
+                         "shared fleet dir, heartbeat + metrics "
+                         "snapshot while alive, drain-handshake on "
+                         "teardown; warmup folds a per-member part "
+                         "manifest into the shared --cache-dir")
+    ap.add_argument("--member-name",
+                    help="fleet member name (default m<pid>); only "
+                         "meaningful with --fleet-dir")
     args = ap.parse_args(argv)
+    if args.member_name and not args.fleet_dir:
+        ap.error("--member-name needs --fleet-dir")
+    if args.fleet_dir and args.jsonl:
+        ap.error("--fleet-dir is HTTP-mode only (the router forwards "
+                 "over HTTP)")
+    member_name = (args.member_name or f"m{os.getpid()}"
+                   if args.fleet_dir else None)
 
     # the cache dir must be pinned BEFORE jax compiles anything
     from batchreactor_tpu import aot
@@ -90,8 +107,12 @@ def main(argv=None):
 
     session = SolverSession.from_spec(args.spec)
     if not args.no_warmup:
+        # fleet members warm one shared cache dir concurrently: each
+        # writes a per-member part manifest and folds it crash-atomically
+        # (aot.merge_manifests) instead of racing on the main manifest
         session.warmup(cache_dir=args.cache_dir,
-                       log=lambda m: print(m, file=sys.stderr))
+                       log=lambda m: print(m, file=sys.stderr),
+                       manifest_tag=member_name)
     scheduler = Scheduler(session)
     store = None
     if args.store or args.add_mech:
@@ -151,10 +172,24 @@ def main(argv=None):
             return 0
         with ServingServer(session, scheduler, port=args.port,
                            host=args.host, store=store) as srv:
+            if args.fleet_dir:
+                # register only once the port is bound and the stream
+                # is live — the router must never route to a member
+                # that cannot answer; ServingServer.close runs the
+                # drain handshake (mark_draining -> drain ->
+                # deregister) on teardown
+                from batchreactor_tpu.fleet import MemberRegistration
+
+                srv.membership = MemberRegistration(
+                    args.fleet_dir, member_name, srv.url,
+                    pid=os.getpid(), registry=session.registry)
+                srv.membership.register()
             print(json.dumps({"serving": {
                 "url": srv.url, "port": srv.port, "pid": os.getpid(),
                 "fingerprint": session.fingerprint,
                 "bucket_cap": session.bucket_cap,
+                "fleet": (None if not args.fleet_dir else
+                          {"dir": args.fleet_dir, "member": member_name}),
                 "store": (None if store is None else
                           [m["ids"] for m in store.mechanisms()]),
                 "warmed": (None if session.warmed is None else

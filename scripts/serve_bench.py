@@ -74,6 +74,22 @@ def main(argv=None):
                          "automatically")
     ap.add_argument("--t1", type=float, default=5e-5,
                     help="integration horizon per request [s]")
+    ap.add_argument("--t1-choices",
+                    help="comma list of t1 horizons drawn per request "
+                         "from the seed's rng (fleet benches: t1 is part "
+                         "of the routing key, so a spread of horizons "
+                         "spreads load across the hash ring; a single "
+                         "t1 legitimately pins every request to ONE "
+                         "member — that is affinity working)")
+    ap.add_argument("--router", type=int, default=0, metavar="N",
+                    help="fleet mode: stand up N in-process member "
+                         "daemons + the consistent-hash router "
+                         "(fleet.FleetRouter) and bench THROUGH the "
+                         "router; the summary gains per-host cond/s "
+                         "and the direct-vs-failover latency split")
+    ap.add_argument("--fleet-dir",
+                    help="fleet membership dir for --router (default: "
+                         "a fresh temp dir)")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--cache-dir",
                     default=os.environ.get("JAX_COMPILATION_CACHE_DIR"))
@@ -111,6 +127,13 @@ def main(argv=None):
         ap.error("--obs-out reads the in-process session's recorder; "
                  "use --spec (an external daemon writes its own via "
                  "scripts/serve.py --obs-out)")
+    if args.router:
+        if args.url:
+            ap.error("--router stands up its own fleet; to bench an "
+                     "external fleet, point --url at its router")
+        if args.obs_out or args.mechs:
+            ap.error("--router does not combine with --obs-out/--mechs "
+                     "(one session's recorder / store vs N hosts)")
 
     from batchreactor_tpu.serving.client import (SolveClient,
                                                  poisson_trace,
@@ -132,13 +155,20 @@ def main(argv=None):
     #: the routing choices the seeded rng draws from — None is the
     #: daemon's default mechanism; uploads join before the trace fires
     mech_choices = [None] + [m[0] for m in mech_specs]
+    t1_choices = ([float(v) for v in args.t1_choices.split(",")]
+                  if args.t1_choices else [args.t1])
 
     def make_request(i, rng):
         k = rng.choice(lane_choices)
+        t1 = args.t1
+        if len(t1_choices) > 1:
+            # draw only with a real spread: an unconditional draw would
+            # consume rng state and change every seeded baseline trace
+            t1 = rng.choice(t1_choices)
         req = {"id": f"bench-{args.seed}-{i}",
                "T": [round(rng.uniform(args.T_lo, args.T_hi), 3)
                      for _ in range(k)],
-               "X": comp, "t1": args.t1}
+               "X": comp, "t1": t1}
         if not args.no_trace:
             # no rng draw: the seeded schedule/conditions stay
             # identical to the round-10 baselines with traces on or off
@@ -156,8 +186,44 @@ def main(argv=None):
                           make_request)
 
     session = server = store = None
+    fleet_hosts, fleet_router = [], None
     if args.url:
         url = args.url
+    elif args.router:
+        # fleet mode: N member daemons in-process (real localhost HTTP
+        # each), registered into one fleet dir, benched THROUGH the
+        # consistent-hash router — requests spread across hosts only as
+        # far as their routing keys spread (--t1-choices)
+        import tempfile
+
+        from batchreactor_tpu import aot
+
+        if args.cache_dir:
+            aot.configure_cache(args.cache_dir)
+        from batchreactor_tpu.fleet import FleetRouter, MemberRegistration
+        from batchreactor_tpu.serving.scheduler import Scheduler
+        from batchreactor_tpu.serving.server import ServingServer
+        from batchreactor_tpu.serving.session import SolverSession
+
+        fleet_dir = args.fleet_dir or tempfile.mkdtemp(
+            prefix="br-fleet-bench-")
+        for i in range(args.router):
+            name = f"m{i + 1}"
+            s = SolverSession.from_spec(args.spec)
+            if not args.no_warmup:
+                s.warmup(cache_dir=args.cache_dir,
+                         log=lambda m: print(m, file=sys.stderr),
+                         manifest_tag=name)
+            s.__enter__()
+            srv = ServingServer(s, Scheduler(s)).start()
+            srv.membership = MemberRegistration(
+                fleet_dir, name, srv.url, registry=s.registry,
+                pid=f"{os.getpid()}-{name}").register()
+            fleet_hosts.append((name, s, srv))
+            print(f"[serve-bench] fleet member {name} @ {srv.url}",
+                  file=sys.stderr)
+        fleet_router = FleetRouter(fleet_dir).start()
+        url = fleet_router.url
     else:
         from batchreactor_tpu import aot
 
@@ -266,6 +332,61 @@ def main(argv=None):
             print(f"[serve-bench] ATTRIBUTION violations (first 8): "
                   f"{tsum['attribution']['violations']}",
                   file=sys.stderr)
+
+    if fleet_router is not None:
+        # the fleet evidence: where each answer came from (response
+        # provenance from the router's "router" block), per-host
+        # cond/s, and the direct-vs-failover latency split
+        per_host = {}
+        direct, failover = [], []
+        for rec in records:
+            if not rec:
+                continue
+            rinfo = (rec["response"] or {}).get("router") or {}
+            host = rinfo.get("host", "?")
+            d = per_host.setdefault(host, {"requests": 0, "answered": 0,
+                                           "lanes": 0, "failovers": 0})
+            d["requests"] += 1
+            if rec["ok"]:
+                d["answered"] += 1
+                d["lanes"] += len((rec["response"] or {}).get("t", []))
+            if rinfo.get("failover"):
+                d["failovers"] += 1
+                failover.append(rec["latency_s"])
+            else:
+                direct.append(rec["latency_s"])
+        for d in per_host.values():
+            d["cond_per_s"] = (round(d["lanes"] / wall, 3)
+                               if wall > 0 else None)
+
+        def _lat(vals):
+            if not vals:
+                return None
+            vals = sorted(vals)
+
+            def _pct(p):
+                k = min(len(vals) - 1, max(0, round(p * (len(vals) - 1))))
+                return round(vals[int(k)] * 1e3, 1)
+
+            return {"n": len(vals), "p50_ms": _pct(0.5),
+                    "p95_ms": _pct(0.95), "max_ms": _pct(1.0)}
+
+        summary["fleet"] = {
+            "hosts": args.router,
+            "per_host": per_host,
+            "latency_direct": _lat(direct),
+            "latency_failover": _lat(failover)}
+        # per-host compile evidence: the warm-serving contract holds on
+        # every member, not just in aggregate
+        summary["per_host_compiles"] = {}
+        for name, s, srv in fleet_hosts:
+            srv.close()   # drain handshake: mark_draining -> deregister
+            summary["per_host_compiles"][name] = s.program_compiles()
+        summary["program_compiles"] = sum(
+            sum(d.values()) for d in summary["per_host_compiles"].values())
+        fleet_router.close()
+        for _name, s, _srv in fleet_hosts:
+            s.__exit__(None, None, None)
 
     if server is not None:
         if store is not None:

@@ -1,0 +1,635 @@
+"""fleet/ — the replicated serving tier (docs/serving.md "Fleet").
+
+Four tiers, matching the subsystem's layering:
+
+* **ring properties** — restart determinism (same member set => same
+  routes, across fresh ring builds), BOUNDED CHURN (removing a member
+  moves only its own keys, each to its old failover target; adding one
+  moves keys only onto the joiner), pack-key affinity (one routing key
+  => one member, distinct keys spread);
+* **membership** — register/read round-trip over a shared fleet dir,
+  heartbeat age-out, the drain handshake flag, re-registration clearing
+  a stale flag;
+* **router over fake members** — canned stdlib HTTP daemons (no jax, no
+  solver) pin the forwarding semantics: key affinity, transport-failure
+  failover with suspect demotion, ``draining`` failover, honest
+  pass-through of ``invalid``/``overloaded`` (NOT retried), upload
+  replication + journal replay to late joiners, and the 503 when the
+  fleet is empty or exhausted;
+* **end-to-end over real HTTP** on the vendored h2o2 fixture: two real
+  member daemons behind a real router answer BIT-EXACT vs the direct
+  ``batch_reactor_sweep`` — including after one member dies mid-fleet
+  (HTTP torn down abruptly): the re-routed request carries
+  ``router.failover`` provenance, matches the dead member's answer
+  bit-for-bit (deterministic solves are what make exactly-once cheap),
+  and the survivor serves it at zero armed compiles.
+"""
+
+import http.server
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from batchreactor_tpu.fleet import (DEFAULT_VNODES,  # noqa: E402
+                                    FleetRouter, HashRing,
+                                    MemberRegistration, UploadJournal,
+                                    member_paths, read_members,
+                                    request_key)
+from batchreactor_tpu.serving import schema  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# ring properties
+# --------------------------------------------------------------------------
+def _keys(n):
+    # realistic routing keys: mechanism id x t1 spread (the serve_bench
+    # --t1-choices shape), not opaque strings
+    return [(f"mech{i % 3}", 1e-5 * (1 + i), None, None, None)
+            for i in range(n)]
+
+
+class TestHashRing:
+    def test_restart_determinism(self):
+        """Same member set => identical routes from two independently
+        built rings (sha256, not python's per-process-salted hash) —
+        the on-disk AOT caches outlive a router, so a restarted router
+        must send each key back to the member already holding it warm."""
+        members = [f"m{i}" for i in range(5)]
+        a = HashRing(members)
+        b = HashRing(reversed(members))     # order must not matter
+        for key in _keys(300):
+            assert a.route(key) == b.route(key)
+            assert a.preference(key) == b.preference(key)
+
+    def test_bounded_churn_on_removal(self):
+        """Removing one member moves ONLY the keys it owned, and each
+        moves to its old failover target (preference[1]) — a death
+        re-assigns arcs, it does not reshuffle the fleet."""
+        ring = HashRing([f"m{i}" for i in range(5)])
+        gone = "m2"
+        small = ring.with_members(set(ring.members()) - {gone})
+        moved = 0
+        for key in _keys(400):
+            before = ring.preference(key)
+            after = small.route(key)
+            if before[0] == gone:
+                moved += 1
+                assert after == before[1]
+            else:
+                assert after == before[0]
+        assert moved > 0    # the sample actually exercised the arcs
+
+    def test_bounded_churn_on_join(self):
+        """Adding a member moves keys only ONTO the joiner — nobody
+        else's warm state is disturbed."""
+        ring = HashRing(["m0", "m1", "m2"])
+        grown = ring.with_members(list(ring.members()) + ["m3"])
+        joined = 0
+        for key in _keys(400):
+            before, after = ring.route(key), grown.route(key)
+            if after != before:
+                joined += 1
+                assert after == "m3"
+        assert 0 < joined < 400     # some keys moved, most stayed
+
+    def test_pack_key_affinity_and_spread(self):
+        """One routing key always lands on one member; a realistic
+        key spread (3 mechanisms x many horizons) reaches EVERY member
+        of a small fleet (64 vnodes keep arcs even enough)."""
+        ring = HashRing(["m0", "m1", "m2", "m3"])
+        hit = set()
+        for key in _keys(60):
+            owner = ring.route(key)
+            assert all(ring.route(key) == owner for _ in range(3))
+            hit.add(owner)
+        assert hit == set(ring.members())
+        shares = ring.arc_share(samples=2048)
+        assert all(0.05 < v < 0.60 for v in shares.values()), shares
+
+    def test_preference_is_distinct_and_complete(self):
+        ring = HashRing(["a", "b", "c"])
+        for key in _keys(50):
+            prefs = ring.preference(key)
+            assert sorted(prefs) == ["a", "b", "c"]
+            assert prefs[0] == ring.route(key)
+        assert ring.preference(_keys(1)[0], n=2) == ring.preference(
+            _keys(1)[0])[:2]
+
+    def test_empty_and_vnodes(self):
+        assert HashRing(()).route(("k",)) is None
+        assert HashRing(()).preference(("k",)) == []
+        assert HashRing(["m"], vnodes=4).vnodes == 4
+        assert HashRing(["m"]).vnodes == DEFAULT_VNODES
+
+    def test_request_key_peek(self):
+        assert request_key({"t1": 1e-4, "mech": "gri"}) == (
+            "gri", 1e-4, None, None, None)
+        assert request_key("not a dict") == ("invalid",)
+
+
+# --------------------------------------------------------------------------
+# membership
+# --------------------------------------------------------------------------
+class TestMembership:
+    def test_register_read_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        reg = MemberRegistration(d, "m1", "http://127.0.0.1:1234",
+                                 pid=4242, heartbeat_s=0.05)
+        with reg:
+            members = read_members(d, dead_after_s=5.0)
+            assert [m["name"] for m in members] == ["m1"]
+            m = members[0]
+            assert m["url"] == "http://127.0.0.1:1234"
+            assert m["pid"] == 4242
+            assert m["alive"] and not m["draining"] and m.routable
+        # context exit = drain handshake + deregister
+        assert read_members(d, dead_after_s=5.0) == []
+
+    def test_heartbeat_age_out(self, tmp_path):
+        d = str(tmp_path)
+        reg = MemberRegistration(d, "m1", "u", heartbeat_s=0.02)
+        reg.register()
+        assert read_members(d, dead_after_s=2.0)[0].routable
+        reg._hb.stop()      # the daemon wedged/died: beats stop
+        time.sleep(0.25)
+        m = read_members(d, dead_after_s=0.1)[0]
+        assert not m["alive"] and not m.routable
+        assert m["age_s"] >= 0.1
+        reg.deregister()
+
+    def test_drain_flag_and_reregistration(self, tmp_path):
+        d = str(tmp_path)
+        reg = MemberRegistration(d, "m1", "u", heartbeat_s=0.05)
+        reg.register()
+        reg.mark_draining()
+        m = read_members(d, dead_after_s=5.0)[0]
+        assert m["draining"] and m["alive"] and not m.routable
+        reg.deregister()
+        # the drain flag outlives deregistration on purpose (metrics
+        # snapshots do too); a RE-registration must clear it
+        assert os.path.exists(member_paths(d, "m1")[2])
+        reg2 = MemberRegistration(d, "m1", "u2", heartbeat_s=0.05)
+        reg2.register()
+        assert read_members(d, dead_after_s=5.0)[0].routable
+        reg2.deregister()
+
+    def test_torn_registration_skipped(self, tmp_path):
+        d = str(tmp_path)
+        os.makedirs(os.path.join(d, "members"), exist_ok=True)
+        with open(os.path.join(d, "members", "bad.json"), "w") as f:
+            f.write("{not json")
+        assert read_members(d) == []
+
+
+class TestUploadJournal:
+    def test_latest_per_id_in_first_accepted_order(self):
+        j = UploadJournal()
+        j.record({"id": "a", "mech": "1", "therm": "t", "warm": True})
+        j.record({"id": "b", "mech": "2", "therm": "t", "warm": True})
+        j.record({"id": "a", "mech": "3", "therm": "t", "warm": True})
+        assert j.ids() == ["a", "b"]
+        assert [u["mech"] for u in j.replay()] == ["3", "2"]
+
+
+# --------------------------------------------------------------------------
+# router over fake members (no jax, no solver — semantics only)
+# --------------------------------------------------------------------------
+class FakeMember:
+    """A canned member daemon: real stdlib HTTP + real membership, no
+    solver.  ``/solve`` answers ok (recording the request id) unless
+    scripted with ``error=(status, code)``; ``/mechanism`` records the
+    upload and answers an admission receipt.  ``kill_http()`` tears the
+    server down ABRUPTLY while the heartbeat keeps beating — the
+    pre-age-out death window the failover path exists for."""
+
+    def __init__(self, fleet_dir, name, error=None, heartbeat_s=0.05):
+        self.name = name
+        self.error = error
+        self.solved = []
+        self.uploads = []
+        outer = self
+
+        class _H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                obj = json.loads(self.rfile.read(n).decode())
+                if self.path == "/mechanism":
+                    outer.uploads.append(obj["id"])
+                    status, body = 200, schema.ok_response(
+                        obj["id"], {"fingerprint": f"fp-{obj['mech']}"})
+                elif outer.error is not None:
+                    status, code = outer.error
+                    body = schema.error_response(obj.get("id"), code,
+                                                 "canned")
+                else:
+                    outer.solved.append(obj.get("id"))
+                    status, body = 200, schema.ok_response(
+                        obj.get("id"), {"served_by": outer.name})
+                payload = (json.dumps(body) + "\n").encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *_a):
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), _H)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{self._server.server_address[1]}"
+        self.membership = MemberRegistration(
+            fleet_dir, name, self.url, pid=f"fake-{name}",
+            heartbeat_s=heartbeat_s).register()
+
+    def kill_http(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._thread.join()
+            self._server = None
+
+    def close(self):
+        self.kill_http()
+        self.membership.deregister()
+
+
+@pytest.fixture()
+def fleet_dir(tmp_path):
+    return str(tmp_path / "fleet")
+
+
+def _router(fleet_dir, **kw):
+    # refresh_s=0: tests mutate membership and expect the next call to
+    # see it (the TTL is a production knob, not a semantics one)
+    kw.setdefault("refresh_s", 0.0)
+    kw.setdefault("dead_after_s", 30.0)
+    kw.setdefault("request_timeout", 5.0)
+    return FleetRouter(fleet_dir, **kw)
+
+
+def _solve_req(i=0, t1=1e-4):
+    return {"id": f"r{i}", "T": [1200.0], "X": {"H2": 1.0}, "t1": t1}
+
+
+class TestRouterSemantics:
+    def test_key_affinity_across_members(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        b = FakeMember(fleet_dir, "b")
+        try:
+            router = _router(fleet_dir)
+            # one key -> one member, every time
+            for i in range(6):
+                status, resp = router.solve(_solve_req(i, t1=1e-4))
+                assert status == 200 and resp["status"] == "ok"
+                assert not resp["router"]["failover"]
+            hosts = {resp["router"]["host"]}
+            assert len(a.solved or b.solved) == 6
+            # a t1 spread reaches both members (the serve_bench
+            # --t1-choices rationale)
+            for i in range(40):
+                _s, r = router.solve(_solve_req(100 + i, t1=1e-6 * (i + 1)))
+                hosts.add(r["router"]["host"])
+            assert hosts == {"a", "b"}
+        finally:
+            a.close()
+            b.close()
+
+    def test_failover_on_transport_death(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        b = FakeMember(fleet_dir, "b")
+        try:
+            router = _router(fleet_dir)
+            _s, first = router.solve(_solve_req(0))
+            primary = first["router"]["host"]
+            dead, survivor = ((a, b) if primary == "a" else (b, a))
+            # abrupt death: HTTP gone, heartbeat still fresh (the
+            # pre-age-out window) — the router must fail over, answer
+            # exactly once, and say so in the provenance
+            dead.kill_http()
+            status, resp = router.solve(_solve_req(1))
+            assert status == 200 and resp["status"] == "ok"
+            assert resp["served_by"] == survivor.name
+            assert resp["router"] == {"host": survivor.name,
+                                      "attempts": 2, "failover": True,
+                                      "tried": [dead.name]}
+            # the dead member is now suspect: the next forward skips it
+            status, resp = router.solve(_solve_req(2))
+            assert status == 200
+            assert resp["router"]["failover"] is False
+            assert resp["router"]["host"] == survivor.name
+            counters = router.recorder.snapshot()[2]
+            assert counters["route_failovers"] == 1
+            assert counters["route_requests"] == 3
+            assert router.healthz()["router"]["suspects"] == [dead.name]
+        finally:
+            a.close()
+            b.close()
+
+    def test_draining_response_fails_over(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a", error=(503, "draining"))
+        b = FakeMember(fleet_dir, "b", error=(503, "draining"))
+        try:
+            router = _router(fleet_dir)
+            _s, first = router.solve(_solve_req(0))
+            assert first["status"] == "error"    # both draining: honest 503
+            primary = ((first.get("error") or {}).get("message"))
+            assert "failed" in primary
+            # revive one: the drain-window race resolves to the survivor
+            b.error = None
+            status, resp = router.solve(_solve_req(1))
+            assert status == 200 and resp["served_by"] == "b"
+            if resp["router"]["host"] != resp.get("served_by"):
+                pytest.fail(f"provenance mismatch: {resp['router']}")
+        finally:
+            a.close()
+            b.close()
+
+    def test_honest_errors_pass_through_without_retry(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a", error=(503, "overloaded"))
+        b = FakeMember(fleet_dir, "b", error=(503, "overloaded"))
+        try:
+            router = _router(fleet_dir)
+            status, resp = router.solve(_solve_req(0))
+            # overloaded is the member's honest backpressure — retrying
+            # it elsewhere would double-serve a request the client will
+            # retry itself; it passes through with attempt count 1
+            assert status == 503
+            assert resp["error"]["code"] == "overloaded"
+            assert resp["router"]["attempts"] == 1
+            assert not resp["router"]["failover"]
+            assert a.solved == b.solved == []
+            counters = router.recorder.snapshot()[2]
+            assert counters["route_upstream_errors"] == 1
+            assert "route_failovers" not in counters
+        finally:
+            a.close()
+            b.close()
+
+    def test_empty_fleet_503(self, fleet_dir):
+        router = _router(fleet_dir)
+        status, resp = router.solve(_solve_req(0))
+        assert status == 503
+        assert resp["error"]["code"] == "internal"
+        assert "no routable fleet members" in resp["error"]["message"]
+        counters = router.recorder.snapshot()[2]
+        assert counters["route_no_members"] == 1
+
+    def test_upload_replicates_to_all_members(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        b = FakeMember(fleet_dir, "b")
+        try:
+            router = _router(fleet_dir)
+            up = {"id": "gri", "mech": "MECHTEXT", "therm": "THERMTEXT"}
+            status, resp = router.upload(dict(up))
+            assert status == 200 and resp["status"] == "ok"
+            assert resp["replicated"] == ["a", "b"]
+            assert resp["failed"] == []
+            assert resp["fingerprint"] == "fp-MECHTEXT"
+            assert a.uploads == b.uploads == ["gri"]
+        finally:
+            a.close()
+            b.close()
+
+    def test_upload_partial_failure_is_loud(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        b = FakeMember(fleet_dir, "b")
+        try:
+            router = _router(fleet_dir)
+            b.kill_http()
+            status, resp = router.upload(
+                {"id": "gri", "mech": "M", "therm": "T"})
+            assert status == 500
+            assert resp["error"]["code"] == "internal"
+            assert resp["replication"]["replicated"] == ["a"]
+            assert resp["replication"]["failed"] == ["b"]
+        finally:
+            a.close()
+            b.close()
+
+    def test_late_joiner_absorbs_journal_before_routing(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        try:
+            router = _router(fleet_dir)
+            router.upload({"id": "gri", "mech": "M", "therm": "T"})
+            router.upload({"id": "gri", "mech": "M2", "therm": "T"})
+            router.upload({"id": "ni", "mech": "N", "therm": "T"})
+            assert a.uploads == ["gri", "gri", "ni"]
+            b = FakeMember(fleet_dir, "b")
+            try:
+                # the next view must replay the CURRENT set (latest per
+                # id) to b before it can own an arc
+                assert "b" in router.healthz()["router"]["routable"]
+                assert b.uploads == ["gri", "ni"]
+                assert router.healthz()["router"]["uploads"] == [
+                    "gri", "ni"]
+            finally:
+                b.close()
+        finally:
+            a.close()
+
+    def test_invalid_upload_and_empty_fleet_upload(self, fleet_dir):
+        router = _router(fleet_dir)
+        status, resp = router.upload({"id": "x"})     # no mech/therm
+        assert status == 400 and resp["error"]["code"] == "invalid"
+        status, resp = router.upload(
+            {"id": "x", "mech": "M", "therm": "T"})
+        assert status == 503 and resp["error"]["code"] == "internal"
+
+    def test_metrics_and_healthz_surfaces(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        try:
+            router = _router(fleet_dir)
+            router.solve(_solve_req(0))
+            text = router.metrics_text()
+            # the obs/counters.py FAMILIES enrollment: router counters
+            # and the route_seconds histogram are first-class families
+            assert "route_requests" in text
+            assert "route_seconds" in text
+            h = router.healthz()
+            assert h["ok"] is True
+            assert h["router"]["routable"] == ["a"]
+            assert abs(sum(h["router"]["arc_share"].values()) - 1.0) < 0.01
+            # membership gauges published on the view refresh
+            assert "fleet_members_routable" in text
+        finally:
+            a.close()
+
+    def test_member_death_ages_out_of_ring(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a", heartbeat_s=0.02)
+        b = FakeMember(fleet_dir, "b", heartbeat_s=0.02)
+        try:
+            router = _router(fleet_dir, dead_after_s=0.15)
+            assert sorted(router.healthz()["router"]["routable"]) == [
+                "a", "b"]
+            a.membership._hb.stop()     # a stops beating (wedged/dead)
+            time.sleep(0.4)
+            h = router.healthz()
+            assert h["router"]["routable"] == ["b"]
+            # arcs reassigned: every key now routes to b, no failover
+            for i in range(4):
+                status, resp = router.solve(_solve_req(i, t1=1e-6 * (i + 1)))
+                assert status == 200
+                assert resp["router"]["host"] == "b"
+                assert not resp["router"]["failover"]
+            counters = router.recorder.snapshot()[2]
+            assert counters["fleet_members_joined"] == 2
+            assert counters["fleet_members_left"] == 1
+        finally:
+            a.close()
+            b.close()
+
+
+# --------------------------------------------------------------------------
+# end-to-end: two real daemons + router over real HTTP, h2o2 fixture
+# --------------------------------------------------------------------------
+_COMP = {"H2": 0.3, "O2": 0.15, "N2": 0.55}
+
+
+def _fleet_spec(lib_dir):
+    # the test_serving.py bit-exactness recipe: single-rung ladder [8]
+    # + a coalesce window wide enough that every concurrent request
+    # joins the seed — both members AND the direct sweep run ONE
+    # program shape, so answers are bit-identical across hosts
+    return {"mechanism": {"mech": f"{lib_dir}/h2o2.dat",
+                          "therm": f"{lib_dir}/therm.dat"},
+            "solver": {"segment_steps": 8, "stats": True},
+            "serve": {"resident": 8, "refill": 1, "buckets": [8],
+                      "poll_every": 1, "max_queue_lanes": 64,
+                      "idle_timeout_s": 0.3, "coalesce_s": 2.0}}
+
+
+@pytest.fixture(scope="module")
+def live_fleet(lib_dir, tmp_path_factory):
+    from batchreactor_tpu.serving.scheduler import Scheduler
+    from batchreactor_tpu.serving.server import ServingServer
+    from batchreactor_tpu.serving.session import SolverSession
+
+    fdir = str(tmp_path_factory.mktemp("fleet"))
+    hosts = {}
+    for name in ("m1", "m2"):
+        session = SolverSession.from_spec(_fleet_spec(lib_dir))
+        session.warmup()
+        session.__enter__()
+        srv = ServingServer(session, Scheduler(session)).start()
+        srv.membership = MemberRegistration(
+            fdir, name, srv.url, pid=f"e2e-{name}",
+            registry=session.registry, heartbeat_s=0.1).register()
+        hosts[name] = (session, srv)
+    # dead_after_s=60: an abruptly killed member STAYS in the ring for
+    # the whole test — wave 2 must exercise the failover path, not the
+    # age-out path
+    router = FleetRouter(fdir, dead_after_s=60.0, refresh_s=0.0,
+                         request_timeout=120.0).start()
+    yield router, hosts
+    router.close()
+    for name, (session, srv) in hosts.items():
+        try:
+            srv.close(drain_timeout=10.0)
+        except Exception:       # noqa: BLE001 — the killed member's
+            pass                # HTTP is already gone
+        try:
+            srv.membership.deregister()
+        except Exception:       # noqa: BLE001
+            pass
+        session.__exit__(None, None, None)
+
+
+class TestFleetEndToEnd:
+    def test_bit_exact_through_router_and_after_member_death(
+            self, live_fleet):
+        """Acceptance: the same 8-lane request through the router is
+        bit-exact vs the direct sweep — before AND after its serving
+        member dies abruptly (the survivor's deterministic solve IS the
+        answer, delivered exactly once with failover provenance)."""
+        import batchreactor_tpu as br
+        from batchreactor_tpu.serving.client import SolveClient
+
+        router, hosts = live_fleet
+        client = SolveClient(router.url, timeout=120.0)
+        N, t1 = 8, 5e-5
+        Ts = [1150.0 + 37.0 * i for i in range(N)]
+        req = {"T": Ts, "X": _COMP, "t1": t1}
+
+        # ---- wave 1: routed direct ----------------------------------
+        resp1 = client.solve({"id": "w1", **req})
+        assert resp1["status"] == "ok"
+        assert resp1["provenance"] == ["success"] * N
+        assert resp1["router"]["failover"] is False
+        assert resp1["router"]["attempts"] == 1
+        served_by = resp1["router"]["host"]
+        assert served_by in hosts
+
+        # ---- the reference: one direct sweep, same conditions --------
+        session = hosts[served_by][0]
+        out = br.batch_reactor_sweep(
+            _COMP, np.asarray(Ts), 1e5, t1,
+            chem=br.Chemistry(gaschem=True), thermo_obj=session.thermo,
+            md=session.gm, segment_steps=8, admission=8, refill=1,
+            buckets=(8,), poll_every=1)
+        np.testing.assert_array_equal(resp1["t"], np.asarray(out["t"]))
+        for sp in session.species:
+            np.testing.assert_array_equal(
+                resp1["x"][sp], np.asarray(out["x"][sp]), err_msg=sp)
+
+        # ---- kill the serving member ABRUPTLY ------------------------
+        # (HTTP torn down, heartbeat still beating: the pre-age-out
+        # window; no drain handshake — this is the crash path)
+        dead_srv = hosts[served_by][1]
+        dead_srv._server.shutdown()
+        dead_srv._server.server_close()
+        dead_srv._thread.join()
+        dead_srv._server = dead_srv._thread = None
+        (survivor_name,) = [n for n in hosts if n != served_by]
+
+        # ---- wave 2: same key re-routes, bit-exact, exactly once -----
+        resp2 = client.solve({"id": "w2", **req})
+        assert resp2["status"] == "ok"
+        assert resp2["provenance"] == ["success"] * N
+        assert resp2["router"]["failover"] is True
+        assert resp2["router"]["attempts"] == 2
+        assert resp2["router"]["tried"] == [served_by]
+        assert resp2["router"]["host"] == survivor_name
+        np.testing.assert_array_equal(resp2["t"], resp1["t"])
+        for sp in session.species:
+            np.testing.assert_array_equal(
+                resp2["x"][sp], resp1["x"][sp], err_msg=sp)
+
+        # ---- the survivor served it WARM -----------------------------
+        survivor = hosts[survivor_name][0]
+        prog = survivor.program_compiles()
+        assert all(v == 0 for v in prog.values()), prog
+
+        # ---- router provenance counters ------------------------------
+        counters = router.recorder.snapshot()[2]
+        assert counters["route_failovers"] >= 1
+        assert counters["route_requests"] >= 2
+
+    def test_fleet_metrics_merge_members(self, live_fleet):
+        """The router /metrics carries the PR-9 fleet merge: both
+        members' heartbeat snapshots appear (per-host + merged), plus
+        the router's own route_* families."""
+        import urllib.request
+
+        router, _hosts = live_fleet
+        time.sleep(0.3)     # >= one heartbeat: snapshots on disk
+        with urllib.request.urlopen(router.url + "/metrics",
+                                    timeout=10.0) as r:
+            text = r.read().decode()
+        assert "route_requests" in text
+        assert "fleet" in text
+        # per-host sections for both registered pids
+        assert "e2e-m1" in text or "m1" in text
+        h = router.healthz()
+        assert h["router"]["fleet_dir"]

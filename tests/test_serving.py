@@ -379,6 +379,57 @@ class TestSchedulerInvariants:
                    for e in events)
 
 
+class TestAdaptiveCoalesce:
+    """ROADMAP 2d: ``SessionSpec.coalesce_adaptive`` scales the batch
+    window by the queue's fill fraction — an unsaturated stream stops
+    paying the full fixed window for a batch that was never coming."""
+
+    def _p50_coalesce_wait(self, adaptive, n=3):
+        sess = FakeSession(coalesce_s=0.6, coalesce_adaptive=adaptive)
+        sched = Scheduler(sess).start()
+        for i in range(n):
+            # unsaturated: one 1-lane request at a time against
+            # bucket_cap=4, each fully resolved — and its epoch's idle
+            # feed window (idle_timeout_s=0.05) fully CLOSED — before
+            # the next fires, so every request pays the seed window
+            # rather than riding the previous epoch's live feed
+            sched.submit(_request(f"u{i}", [1000.0 + i])).result(10.0)
+            time.sleep(0.2)
+        sched.drain(5.0)
+        waits = sorted(
+            e["attrs"]["stages"]["coalesced"]
+            for e in sess.recorder.snapshot()[1]
+            if e["name"] == "request_trace")
+        assert len(waits) == n
+        return waits[n // 2]
+
+    def test_unsaturated_p50_submitted_to_coalesced_drops(self):
+        """The fixed window holds every lone request for ~coalesce_s;
+        the adaptive window releases it at ~coalesce_s x 1/cap (fill
+        fraction 1/4 here) — p50 submitted->coalesced drops by more
+        than half, with CI-loose margins."""
+        fixed = self._p50_coalesce_wait(adaptive=False)
+        adaptive = self._p50_coalesce_wait(adaptive=True)
+        assert fixed >= 0.5, fixed          # ~0.6 windowed
+        assert adaptive <= 0.35, adaptive   # ~0.15 earned
+        assert adaptive < fixed / 2
+
+    def test_saturated_burst_still_seeds_full(self):
+        """A queue that already fills the resident program seeds
+        immediately under BOTH policies (the window only ever waits on
+        unearned capacity), in one epoch."""
+        for adaptive in (False, True):
+            sess = FakeSession(coalesce_s=0.6,
+                               coalesce_adaptive=adaptive)
+            sched = Scheduler(sess).start()
+            t0 = time.monotonic()
+            sched.submit(_request("burst", [1000.0, 1100.0, 1200.0,
+                                            1300.0])).result(10.0)
+            assert time.monotonic() - t0 < 0.4
+            sched.drain(5.0)
+            assert len(sess.streams) == 1
+
+
 # --------------------------------------------------------------------------
 # end-to-end: real session, real HTTP, vendored h2o2 fixture
 # --------------------------------------------------------------------------
