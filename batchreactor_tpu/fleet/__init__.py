@@ -30,7 +30,8 @@ routing/telemetry plane down with it.  Entry points:
 """
 
 from .membership import (DEFAULT_DEAD_AFTER_S, DEFAULT_HEARTBEAT_S,
-                         MemberInfo, MemberRegistration, member_paths,
+                         MemberInfo, MemberRegistration,
+                         member_obs_path, member_paths, obs_dir,
                          read_members)
 from .replication import UploadJournal, replicate_upload
 from .ring import DEFAULT_VNODES, HashRing, canonical_key, request_key
@@ -44,6 +45,8 @@ __all__ = [
     "MemberRegistration",
     "MemberInfo",
     "member_paths",
+    "member_obs_path",
+    "obs_dir",
     "read_members",
     "DEFAULT_HEARTBEAT_S",
     "DEFAULT_DEAD_AFTER_S",

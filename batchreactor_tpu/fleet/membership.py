@@ -64,6 +64,23 @@ def member_paths(fleet_dir, name):
     return base + ".json", base + ".hb", base + ".draining"
 
 
+def obs_dir(fleet_dir):
+    """``<fleet_dir>/obs`` — where the fleet's per-host trace streams
+    land (``scripts/serve_fleet.py --obs-dir`` default; the layout
+    ``obs.stitch.load_fleet`` reads: ``router.jsonl`` + one
+    ``<member>.jsonl`` per member).  Created on first ask."""
+    d = os.path.join(str(fleet_dir), "obs")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def member_obs_path(fleet_dir, name):
+    """``<fleet_dir>/obs/<name>.jsonl`` — one host's trace stream; the
+    file STEM is the host name ``obs.stitch`` joins the router's hop
+    ledger against, so it must match the registration name."""
+    return os.path.join(obs_dir(fleet_dir), _safe(name) + ".jsonl")
+
+
 class MemberInfo(dict):
     """One member's router-side view (a dict for JSON-friendliness):
     ``name``, ``url``, ``pid``, ``age_s`` (heartbeat age), ``alive``

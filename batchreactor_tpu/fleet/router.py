@@ -29,8 +29,19 @@ wedged, the same contract as ``scripts/obs_fleet.py``.
   ``fleet_dir`` merge appended (``obs.live``: per-host counters/gauges,
   counters summed, gauges max-reduced, histograms slot-wise — the PR-9
   machinery verbatim, fed by each member's heartbeat snapshots) plus
-  the router's own ``route_*``/``fleet_*`` counters and the
-  ``route_seconds`` histogram (``obs/counters.py`` FAMILIES).
+  the router's own ``route_*``/``fleet_*`` counters, the
+  ``route_seconds`` histogram (``obs/counters.py`` FAMILIES), and the
+  SLO monitor's ``br_slo_*`` burn-rate gauges (``obs/slo.py``).
+
+**Distributed tracing** (docs/observability.md "Fleet tracing"): every
+``/solve`` carries a ``trace_ctx`` envelope downstream — inherited
+from the client when present, minted here when absent — and every
+terminal outcome (success, upstream error, invalid envelope, no
+members) emits ONE ``request_trace`` recorder event with the hop
+ledger (member, hop number, send/recv wall bracket, outcome) that
+``obs.stitch`` joins with the members' stage waterfalls into
+fleet-wide traces; a failover chain is one trace with honest hop
+provenance.  The same outcomes feed the continuous SLO monitor.
 * ``GET /healthz`` — membership census (alive, draining, aged-out),
   ring arc shares, journal ids.
 
@@ -46,9 +57,12 @@ import http.server
 import json
 import threading
 import time
+import uuid
 
 from ..obs.live import LiveRegistry
 from ..obs.recorder import Recorder
+from ..obs.slo import SloMonitor
+from ..obs.trace import TRACE_VERSION
 from ..serving import schema
 from .membership import DEFAULT_DEAD_AFTER_S, read_members
 from .replication import UploadJournal, post_json, replicate_upload
@@ -136,6 +150,10 @@ class FleetRouter:
         self.registry = LiveRegistry(
             recorder=self.recorder, fleet_dir=self.fleet_dir,
             meta={"entry": "fleet-router"})
+        #: the continuous SLO monitor (obs/slo.py — docs/observability
+        #: .md "SLO monitor"): every terminal solve() outcome feeds it,
+        #: and its br_slo_* gauges append to /metrics (metrics_text)
+        self.slo = SloMonitor(recorder=self.recorder)
         self._lock = threading.Lock()
         from .ring import DEFAULT_VNODES
 
@@ -221,33 +239,102 @@ class FleetRouter:
         return healthy + demoted
 
     # ---- request plumbing (shared by HTTP and tests) ----------------------
+    def _trace_event(self, rid, tid, parent, base_hop, minted, wall0,
+                     total_s, hops, tried, host=None, code=None):
+        """The router's terminal ``request_trace`` event — ONE per
+        ``solve()`` outcome, success or rejection, so error-rate SLOs
+        count what the response alone would hide (ISSUE-18 satellite).
+        Carries the hop ledger (send/recv wall bracket per attempt)
+        ``obs.stitch`` joins member waterfalls into, and feeds the
+        same outcome to the SLO monitor."""
+        attrs = {"request": rid, "v": TRACE_VERSION, "span": "route",
+                 "minted": minted, "hop": base_hop,
+                 "wall_start": round(wall0, 6),
+                 "total_s": round(total_s, 6),
+                 "failover": bool(tried), "tried": list(tried),
+                 "hops": hops}
+        if tid is not None:
+            attrs["trace"] = tid
+            attrs["parent_span"] = parent
+        if host is not None:
+            attrs["host"] = host
+        failed = code is not None
+        if failed:
+            attrs["code"] = code
+            attrs["failed"] = True
+        self.recorder.event("request_trace", **attrs)
+        self.slo.record(total_s, ok=not failed,
+                        failover=bool(tried), at=wall0 + total_s)
+
     def solve(self, obj):
         """One request object -> ``(http_status, response_object)``,
-        forwarded to the key's member with failover (module doc)."""
+        forwarded to the key's member with failover (module doc).
+
+        Distributed tracing (docs/observability.md "Fleet tracing"):
+        an inherited ``trace_ctx`` is validated (a malformed envelope
+        is an ``invalid`` rejection — counted, not silent), MINTED
+        when absent, and forwarded on EVERY hop with the hop count
+        advanced — so a member's stage marks join one fleet-wide
+        trace whether the client traced or not.  The RESPONSE is
+        untouched by tracing: the ``router`` section stays exactly
+        ``{host, attempts, failover, tried}`` and ctx-less requests
+        are byte-identical to the pre-tracing wire format."""
         rec = self.recorder
         rec.counter("route_requests")
         rid = obj.get("id") if isinstance(obj, dict) else None
         t0 = time.perf_counter()
+        wall0 = time.time()
+        try:
+            ctx = schema.validate_trace_ctx(
+                obj.get("trace_ctx") if isinstance(obj, dict)
+                else None, rid)
+        except ValueError as e:
+            self._trace_event(rid, None, None, 0, False, wall0,
+                              time.perf_counter() - t0, [], [],
+                              code="invalid")
+            return 400, schema.error_response(rid, "invalid", e)
+        if ctx is None:
+            tid, parent, base_hop = f"r-{uuid.uuid4().hex[:16]}", None, 0
+            minted = True
+        else:
+            tid, parent, base_hop = ctx
+            minted = False
         ring, members = self._view()
         candidates = self._candidates(ring, members, request_key(obj))
         if not candidates:
             rec.counter("route_no_members")
+            self._trace_event(rid, tid, parent, base_hop, minted,
+                              wall0, time.perf_counter() - t0, [], [],
+                              code="internal")
             return 503, schema.error_response(
                 rid, "internal",
                 f"no routable fleet members (fleet dir "
                 f"{self.fleet_dir}; registered: "
                 f"{[m['name'] for m in self._census_snapshot()]})")
         tried = []
+        hops = []
         last = "unreachable"
         for member in candidates:
+            hop_n = base_hop + len(tried) + 1
+            if isinstance(obj, dict):
+                fobj = dict(obj)
+                fobj["trace_ctx"] = schema.trace_ctx_payload(
+                    tid, span=f"route:{hop_n}", hop=hop_n)
+            else:
+                fobj = obj
+            hop = {"member": member["name"], "hop": hop_n,
+                   "send_wall": round(time.time(), 6)}
             try:
-                status, resp = post_json(member["url"], "/solve", obj,
+                status, resp = post_json(member["url"], "/solve", fobj,
                                          self.request_timeout)
             except OSError as e:
                 # the member is gone (or wedged past the deadline):
                 # demote it and re-route — the solve is deterministic,
                 # so the survivor's answer is THE answer, delivered
                 # exactly once
+                hop.update(recv_wall=round(time.time(), 6),
+                           outcome="transport")
+                hops.append(hop)
                 tried.append(member["name"])
                 last = f"{member['name']}: {type(e).__name__}: {e}"
                 self._mark_suspect(member["name"])
@@ -255,16 +342,21 @@ class FleetRouter:
                 rec.event("fault", kind="route_failover",
                           member=member["name"], error=str(e))
                 continue
+            hop["recv_wall"] = round(time.time(), 6)
             code = ((resp.get("error") or {}).get("code")
                     if isinstance(resp, dict) else None)
             if code == "draining":
                 # the drain handshake's race window: the member flagged
                 # itself between our membership read and the forward —
                 # its arc is already reassigning, follow it
+                hop["outcome"] = "draining"
+                hops.append(hop)
                 tried.append(member["name"])
                 last = f"{member['name']}: draining"
                 rec.counter("route_failovers")
                 continue
+            hop["outcome"] = "ok" if code is None else code
+            hops.append(hop)
             if code is not None:
                 rec.counter("route_upstream_errors")
             if isinstance(resp, dict):
@@ -272,10 +364,17 @@ class FleetRouter:
                                   "attempts": len(tried) + 1,
                                   "failover": bool(tried),
                                   "tried": tried}
-            rec.observe("route_seconds", time.perf_counter() - t0,
+            dt = time.perf_counter() - t0
+            rec.observe("route_seconds", dt,
                         path="failover" if tried else "direct")
+            self._trace_event(rid, tid, parent, base_hop, minted,
+                              wall0, dt, hops, tried,
+                              host=member["name"], code=code)
             return status, resp
         rec.counter("route_no_members")
+        self._trace_event(rid, tid, parent, base_hop, minted, wall0,
+                          time.perf_counter() - t0, hops, tried,
+                          code="internal")
         return 503, schema.error_response(
             rid, "internal",
             f"all {len(candidates)} fleet member(s) failed "
@@ -334,8 +433,13 @@ class FleetRouter:
     def metrics_text(self):
         """The ``/metrics`` exposition: router counters + histograms +
         the fleet-dir merge (``LiveRegistry.prometheus`` with
-        ``fleet_dir`` set appends the per-host + merged section)."""
-        return self.registry.prometheus()
+        ``fleet_dir`` set appends the per-host + merged section) plus
+        the SLO monitor's ``br_slo_*`` gauges (obs/slo.py)."""
+        base = self.registry.prometheus()
+        slo = self.slo.prometheus()
+        if slo and base and not base.endswith("\n"):
+            base += "\n"
+        return base + slo
 
     def healthz(self):
         ring, members = self._view()

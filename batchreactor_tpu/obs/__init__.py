@@ -29,6 +29,14 @@ for every question the ad-hoc fragments it supersedes answered separately:
   aggregated into the fixed-bucket ``serve_stage_seconds`` histograms
   (:func:`Recorder.observe` / :mod:`.counters` ``HIST_KEYS``) a
   mid-flight ``/metrics`` scrape shows moving.
+* **where did the latency go ACROSS the fleet** — :mod:`.stitch` joins
+  the router's hop ledger with each member's stage waterfall into one
+  clock-skew-corrected fleet-wide trace per request (docs/
+  observability.md "Fleet tracing"), and :mod:`.slo` evaluates
+  declarative objectives (latency / error-rate / failover-rate) over
+  the request stream with multi-window burn-rate alerts
+  (``slo_alert`` events, ``br_slo_*`` gauges on the router
+  ``/metrics``, ``scripts/obs_slo.py --gate`` in CI).
 * **machine-readable exports** — :mod:`.export` writes the assembled
   report (:func:`~.report.build_report`) as JSON-Lines or a
   Prometheus-style text exposition; ``scripts/obs_report.py`` renders and
@@ -44,11 +52,17 @@ from .retrace import CompileWatch
 from .report import build_report, render, diff, stats_totals
 from .export import (to_jsonl, from_jsonl, to_prometheus, write_jsonl,
                      read_jsonl)
-from . import live, timeline, trace  # noqa: F401  (submodule re-exports)
+from . import live, slo, stitch, timeline, trace  # noqa: F401
 from .live import (FlightRecorder, LiveRegistry, MetricsServer,
                    arm_flight, armed_flight, disarm_flight, flight_dump,
                    resolve_live_metrics)
 from .trace import RequestTrace, STAGES, TRACE_VERSION
+from .slo import (DEFAULT_OBJECTIVES, Objective, SloMonitor,
+                  evaluate_traces)
+# the stitch FUNCTION re-exports under an alias so the submodule name
+# stays importable (`obs.stitch.stitch` is the canonical spelling)
+from .stitch import load_fleet, merge_reports, render_fleet
+from .stitch import stitch as stitch_traces
 
 __all__ = [
     "Recorder",
@@ -69,6 +83,16 @@ __all__ = [
     "RequestTrace",
     "STAGES",
     "TRACE_VERSION",
+    "slo",
+    "stitch",
+    "Objective",
+    "SloMonitor",
+    "DEFAULT_OBJECTIVES",
+    "evaluate_traces",
+    "load_fleet",
+    "merge_reports",
+    "render_fleet",
+    "stitch_traces",
     "LiveRegistry",
     "MetricsServer",
     "FlightRecorder",

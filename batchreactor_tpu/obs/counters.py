@@ -159,6 +159,21 @@ HIST_KEYS = ("serve_stage_seconds",)
 #: (``serve_bench.py --router``).  Missing family diffs as EMPTY, the
 #: HIST_KEYS convention.
 ROUTE_HIST_KEYS = ("route_seconds",)
+#: coalesce-window HISTOGRAM family (serving/scheduler.py — ROADMAP 2d
+#: telemetry): the batching window each epoch's seed CLOSED at,
+#: labeled ``{mode="fixed"|"adaptive"}``, so the adaptive lever's
+#: chosen-window distribution sits next to the stage waterfalls it
+#: shapes.  Missing family diffs as EMPTY, the HIST_KEYS convention.
+COALESCE_HIST_KEYS = ("coalesce_window_s",)
+#: SLO-monitor counters (obs/slo.py — docs/observability.md "SLO
+#: monitor"): Recorder counters incremented on burn-rate alert STATE
+#: TRANSITIONS (firing/resolved both count — the alert churn rate is
+#: itself an operational signal).  The continuous per-objective values
+#: render as ``br_slo_*`` gauges on the router ``/metrics``
+#: (SloMonitor.prometheus), not as counters.  Absent from a run with
+#: no monitor — ``obs.diff`` maps a missing key to 0 (the FAULT_KEYS
+#: convention).
+SLO_KEYS = ("slo_alerts",)
 
 
 #: THE counter-family registry (brlint tier-C counter-registry audit,
@@ -209,6 +224,10 @@ FAMILIES = {
               "semantics": "additive", "missing_zero": True},
     "route-hist": {"keys": ROUTE_HIST_KEYS, "kind": "host",
                    "semantics": "histogram", "missing_zero": True},
+    "coalesce-hist": {"keys": COALESCE_HIST_KEYS, "kind": "host",
+                      "semantics": "histogram", "missing_zero": True},
+    "slo": {"keys": SLO_KEYS, "kind": "host",
+            "semantics": "additive", "missing_zero": True},
 }
 
 

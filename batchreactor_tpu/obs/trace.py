@@ -77,7 +77,7 @@ class RequestTrace:
     ``submitted``."""
 
     __slots__ = ("request_id", "pack_key", "lanes", "wall_start",
-                 "marks")
+                 "marks", "trace_id", "parent_span", "hop")
 
     def __init__(self, request_id, pack_key=None, lanes=1):
         self.request_id = str(request_id)
@@ -85,6 +85,29 @@ class RequestTrace:
         self.lanes = int(lanes)
         self.wall_start = time.time()
         self.marks = {"submitted": time.perf_counter()}
+        # distributed-trace identity (docs/observability.md "Fleet
+        # tracing"): unset until adopt() — a ctx-less request exports
+        # exactly the pre-fleet attribute set (byte-identity contract)
+        self.trace_id = None
+        self.parent_span = None
+        self.hop = 0
+
+    def adopt(self, trace_id, parent_span=None, hop=0):
+        """Adopt an inherited trace context (``serving/schema.py``
+        ``trace_ctx``): this request's stage marks become child spans
+        of the fleet-wide trace ``trace_id`` under ``parent_span``
+        (the forwarding router's span), ``hop`` forwards deep.  Loud
+        on an empty id — a silently dropped identity would orphan the
+        member's half of a stitched waterfall."""
+        if not trace_id:
+            raise ValueError(
+                f"trace adoption needs a non-empty trace id; got "
+                f"{trace_id!r}")
+        self.trace_id = str(trace_id)
+        self.parent_span = (None if parent_span is None
+                            else str(parent_span))
+        self.hop = int(hop)
+        return self
 
     def mark(self, stage, at=None):
         """Record ``stage`` at ``time.perf_counter()`` (or ``at``).
@@ -147,9 +170,17 @@ class RequestTrace:
         """The ``request_trace`` recorder-event attributes (the JSONL
         export): the payload plus identity — request id, pack key, and
         the wall-clock submit instant (events carry their own emit
-        time; this one is the request's)."""
-        return {"request": self.request_id,
-                "pack": (None if self.pack_key is None
-                         else list(self.pack_key)),
-                "wall_start": round(self.wall_start, 6),
-                **self.to_payload()}
+        time; this one is the request's).  An adopted trace context
+        adds the fleet identity (``trace``/``parent_span``/``hop`` —
+        the ``obs.stitch`` join keys); ctx-less traces export exactly
+        the pre-fleet attribute set (byte-identity contract)."""
+        attrs = {"request": self.request_id,
+                 "pack": (None if self.pack_key is None
+                          else list(self.pack_key)),
+                 "wall_start": round(self.wall_start, 6),
+                 **self.to_payload()}
+        if self.trace_id is not None:
+            attrs["trace"] = self.trace_id
+            attrs["parent_span"] = self.parent_span
+            attrs["hop"] = self.hop
+        return attrs

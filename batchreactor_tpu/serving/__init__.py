@@ -31,12 +31,15 @@ program set).  Import is lazy jax-wise: :mod:`.schema`,
 and the scheduler tests never pay a device.
 """
 
-from .schema import (SCHEMA_VERSION, Request, error_response,  # noqa: F401
-                     ok_response, validate_request, validate_upload)
+from .schema import (SCHEMA_VERSION, TRACE_CTX_VERSION,  # noqa: F401
+                     Request, error_response, ok_response,
+                     trace_ctx_payload, validate_request,
+                     validate_trace_ctx, validate_upload)
 from .scheduler import (Draining, Overloaded, RequestResult,  # noqa: F401
                         Scheduler, SchedulerReject)
 from .client import (ServeError, SolveClient, poisson_trace,  # noqa: F401
-                     trace_summary)
+                     stitched_attribution, trace_summary,
+                     with_trace_ctx)
 
 __all__ = [
     "SCHEMA_VERSION", "Request", "validate_request", "validate_upload",
@@ -46,6 +49,8 @@ __all__ = [
     "SessionStore", "UnknownMechanism",
     "load_spec", "ServingServer", "serve_jsonl", "SolveClient",
     "ServeError", "poisson_trace", "trace_summary",
+    "TRACE_CTX_VERSION", "validate_trace_ctx", "trace_ctx_payload",
+    "with_trace_ctx", "stitched_attribution",
 ]
 
 _LAZY = {"SolverSession": "session", "SessionSpec": "session",

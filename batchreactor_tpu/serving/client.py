@@ -116,6 +116,22 @@ class SolveClient:
         return resp
 
 
+def with_trace_ctx(request, trace_id=None, span="client"):
+    """Attach a distributed-trace envelope (``schema.trace_ctx_payload``
+    — docs/observability.md "Fleet tracing") to a copy of ``request``.
+    The default trace id derives from the request id (``t-<id>``) —
+    DETERMINISTIC, no rng draw, so a seeded :func:`poisson_trace`
+    schedule stays byte-identical with tracing on, and the bench can
+    re-derive each record's trace id to join client latency against
+    the stitched fleet waterfall."""
+    from .schema import trace_ctx_payload
+
+    req = dict(request)
+    tid = (f"t-{req.get('id')}" if trace_id is None else trace_id)
+    req["trace_ctx"] = trace_ctx_payload(tid, span=span)
+    return req
+
+
 def poisson_trace(n_requests, rate_hz, seed, make_request):
     """The seeded open-loop trace: ``[(send_at_s, request), ...]`` with
     exponential inter-arrival gaps at ``rate_hz`` mean arrivals/s.
@@ -247,3 +263,39 @@ def trace_summary(records, attribution_tol_ms=2000.0):
             "ok": not violations,
             "violations": violations[:8]},
     }
+
+
+def stitched_attribution(records, stitched, attribution_tol_ms=2000.0):
+    """The :func:`trace_summary` attribution check EXTENDED ACROSS THE
+    ROUTER HOP (docs/observability.md "Fleet tracing"): client
+    ``latency_s`` vs the stitched trace's end-to-end ``total_s``
+    (``obs.stitch`` — the router's wall, which brackets every hop).
+    Records join their trace by the :func:`with_trace_ctx` derivation
+    ``t-<id>``.  Same gap rule as the single-host check: the client
+    must cover the stitched wall (>= -5 ms clock slack) and exceed it
+    by at most ``attribution_tol_ms``.  Returns ``None`` when nothing
+    joined — the caller treats that as "tracing was off", not a
+    pass."""
+    by_trace = {}
+    for t in stitched:
+        if t.get("trace") is not None and t.get("total_s") is not None:
+            by_trace.setdefault(t["trace"], t)
+    gaps_ms, violations = [], []
+    for r in records:
+        if not r or not r["ok"]:
+            continue
+        t = by_trace.get(f"t-{r['id']}")
+        if t is None:
+            continue
+        g = 1e3 * (r["latency_s"] - float(t["total_s"]))
+        gaps_ms.append(g)
+        if g < -5.0 or g > attribution_tol_ms:
+            violations.append({"id": r["id"], "gap_ms": round(g, 3)})
+    if not gaps_ms:
+        return None
+    return {"n": len(gaps_ms),
+            "max_gap_ms": round(max(gaps_ms), 3),
+            "p50_gap_ms": round(_percentile(sorted(gaps_ms), 0.50), 3),
+            "tol_ms": attribution_tol_ms,
+            "ok": not violations,
+            "violations": violations[:8]}

@@ -138,6 +138,14 @@ class _Work:
         self.remaining = k
         self.trace = RequestTrace(request.id,
                                   pack_key=request.pack_key(), lanes=k)
+        # inherited distributed-trace context (schema.Request
+        # trace_ctx — docs/observability.md "Fleet tracing"): adopt
+        # the fleet identity so this daemon's stage marks export as
+        # child spans of ONE cross-host trace; getattr-gated so
+        # pre-ctx request stubs (tests) keep working
+        ctx = getattr(request, "trace_ctx", None)
+        if ctx is not None:
+            self.trace.adopt(*ctx)
         self.stall_s = 0.0
         self.seq = seq
 
@@ -349,6 +357,7 @@ class Scheduler:
                                for w in self._queues.get(key, ()))
 
                 start = time.monotonic()
+                window = coalesce
                 while (_key_lanes() < (cap or 1)
                        and not self._draining):
                     window = coalesce
@@ -365,6 +374,20 @@ class Scheduler:
                     if left <= 0:
                         break
                     self._cond.wait(left)
+                # the adaptive lever's telemetry (docs/observability.md
+                # "Request tracing"): the window this epoch CLOSED at —
+                # a gauge for the live scrape and a histogram so the
+                # chosen-window distribution sits next to the stage
+                # waterfalls it shapes (obs/counters.py
+                # COALESCE_HIST_KEYS)
+                if rec is not None:
+                    rec.observe("coalesce_window_s", window,
+                                mode=("adaptive" if adaptive
+                                      else "fixed"))
+                reg = getattr(self.session, "registry", None)
+                if reg is not None:
+                    reg.publish("coalesce", gauges={
+                        "coalesce_window_s": round(window, 6)})
             seed = self._pop_work_locked(
                 key, cap if cap else self.max_queue_lanes)
             if not seed:    # drained away while coalescing

@@ -120,6 +120,28 @@ scheduler, exported in responses behind the `trace=` request key and
 as `request_trace` recorder events (`scripts/obs_trace.py` renders
 the waterfalls; `scripts/obs_gate.py` band-checks the derived
 `serve_stage_seconds` histograms against a banked baseline)."""),
+    ("Fleet tracing", "batchreactor_tpu.obs.stitch",
+     ["load_fleet", "stitch", "merge_reports", "select_traces",
+      "render_fleet"],
+     """\
+Cross-host trace stitching (docs/observability.md "Fleet tracing"):
+the router's terminal `request_trace` events carry a per-attempt hop
+ledger (member tried, hop number, send/recv wall bracket, outcome)
+and each member's carry the inherited `trace_ctx` identity, so one
+routed request — failover chain included — stitches into ONE
+clock-skew-corrected fleet waterfall (`scripts/obs_trace.py --fleet`
+renders them; `merge_reports` folds the fleet's counters and
+histograms into one `scripts/obs_gate.py`-checkable report)."""),
+    ("SLO monitor", "batchreactor_tpu.obs.slo",
+     ["Objective", "SloMonitor", "evaluate_traces"],
+     """\
+Continuous SLO monitoring (docs/observability.md "SLO monitor"):
+declarative objectives over the routed request stream (`latency` /
+`error` / `failover` budgets), sliding windows, and multi-window
+burn-rate alerting — alert transitions land as `slo_alert` recorder
+events, the continuous state rides the router `/metrics` as
+`br_slo_*` gauges, and `scripts/obs_slo.py --gate` re-checks stitched
+fleet traces against a banked `br-slo-gate-v1` baseline in CI."""),
     ("Histograms", "batchreactor_tpu.obs.counters",
      ["hist_new", "hist_observe", "hist_merge", "hist_quantile",
       "hist_mean"],

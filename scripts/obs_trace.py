@@ -17,10 +17,21 @@ record:
   # only requests past 250 ms, machine-readable
   python scripts/obs_trace.py /tmp/serve_obs.jsonl --threshold-ms 250 --json
 
+  # FLEET mode: stitch router + member streams into cross-host
+  # waterfalls (docs/observability.md "Fleet tracing")
+  python scripts/obs_trace.py --fleet /tmp/fleet/obs --slowest 10
+
 Stages (docs/observability.md "Request tracing"):
 ``submitted -> coalesced`` queue wait + coalesce window,
 ``-> admitted`` epoch hand-off, ``-> first_harvest`` resident solve,
 ``-> stalled`` (injected fault only), ``-> resolved`` harvest tail.
+
+``--fleet DIR`` reads the ``serve_fleet.py --obs-dir`` layout
+(``router.jsonl`` + one ``<member>.jsonl`` per member), joins each
+router hop ledger with its member's stage waterfall
+(``obs.stitch`` — clock-skew corrected by the router's send/recv
+bracket), and renders per-hop + per-stage attribution with failover
+chains flagged; ``--json`` emits the stitched trace records.
 """
 
 import argparse
@@ -98,8 +109,13 @@ def render_waterfalls(traces, slowest=None, threshold_ms=None):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("report", help="obs report JSONL with "
-                                   "request_trace events")
+    ap.add_argument("report", nargs="?",
+                    help="obs report JSONL with request_trace events "
+                         "(single-host mode)")
+    ap.add_argument("--fleet", metavar="DIR",
+                    help="fleet obs dir (serve_fleet.py --obs-dir "
+                         "layout): stitch router + member streams "
+                         "into cross-host waterfalls")
     ap.add_argument("--slowest", type=int, metavar="N",
                     help="render only the N slowest requests, "
                          "slowest first")
@@ -110,17 +126,38 @@ def main(argv=None):
                          "instead of the rendering")
     ap.add_argument("--out", help="also write the rendering here")
     args = ap.parse_args(argv)
+    if (args.report is None) == (args.fleet is None):
+        ap.error("exactly one of REPORT or --fleet DIR is required")
 
     from batchreactor_tpu import obs
 
-    traces = load_traces(obs.read_jsonl(args.report))
-    if args.json:
-        for t in select_traces(traces, slowest=args.slowest,
-                               threshold_ms=args.threshold_ms):
-            print(json.dumps(t, sort_keys=True))
-        return 0
-    text = render_waterfalls(traces, slowest=args.slowest,
-                             threshold_ms=args.threshold_ms)
+    if args.fleet:
+        from batchreactor_tpu.obs import stitch as fleet_stitch
+
+        stitched = fleet_stitch.stitch(fleet_stitch.load_fleet(
+            args.fleet))
+        if args.json:
+            for t in fleet_stitch.select_traces(
+                    stitched, slowest=(args.slowest
+                                       if args.slowest is not None
+                                       else len(stitched)),
+                    threshold_ms=args.threshold_ms):
+                print(json.dumps(t, sort_keys=True))
+            return 0
+        text = fleet_stitch.render_fleet(
+            stitched, slowest=(args.slowest
+                               if args.slowest is not None
+                               else len(stitched)),
+            threshold_ms=args.threshold_ms)
+    else:
+        traces = load_traces(obs.read_jsonl(args.report))
+        if args.json:
+            for t in select_traces(traces, slowest=args.slowest,
+                                   threshold_ms=args.threshold_ms):
+                print(json.dumps(t, sort_keys=True))
+            return 0
+        text = render_waterfalls(traces, slowest=args.slowest,
+                                 threshold_ms=args.threshold_ms)
     print(text)
     if args.out:
         with open(args.out, "w") as f:

@@ -34,6 +34,15 @@ stage-attribution bugs (the seeded ``slow_request`` injection makes
 the stalled stage deterministic).  ``--obs-out`` banks the in-process
 session's obs report JSONL (histograms + request_trace events), the
 ``scripts/obs_gate.py`` / ``obs_trace.py`` input.
+
+Fleet mode (``--router N``) additionally attaches a deterministic
+``trace_ctx`` envelope per request (trace id ``t-<request id>``),
+stitches the members' and the router's trace streams in-process after
+the run (``obs.stitch``), and extends the attribution check ACROSS the
+router hop: client latency must cover each request's stitched
+end-to-end wall.  ``--obs-out`` then banks the MERGED fleet report
+(router ``route_seconds`` beside every member's
+``serve_stage_seconds``).
 """
 
 import argparse
@@ -119,7 +128,11 @@ def main(argv=None):
                     help="write the in-process session's obs report "
                          "JSONL here after the trace (histograms + "
                          "request_trace events; the obs_gate.py / "
-                         "obs_trace.py input — needs --spec)")
+                         "obs_trace.py input — needs --spec).  With "
+                         "--router, writes the MERGED fleet report "
+                         "(router route_seconds + every member's "
+                         "serve_stage_seconds, obs.stitch."
+                         "merge_reports)")
     args = ap.parse_args(argv)
     if not args.url and not args.spec:
         ap.error("--spec (in-process daemon) or --url (external) needed")
@@ -131,14 +144,17 @@ def main(argv=None):
         if args.url:
             ap.error("--router stands up its own fleet; to bench an "
                      "external fleet, point --url at its router")
-        if args.obs_out or args.mechs:
-            ap.error("--router does not combine with --obs-out/--mechs "
-                     "(one session's recorder / store vs N hosts)")
+        if args.mechs:
+            ap.error("--router does not combine with --mechs "
+                     "(one session store vs N hosts)")
 
     from batchreactor_tpu.serving.client import (SolveClient,
                                                  poisson_trace,
-                                                 run_trace, summarize,
-                                                 trace_summary)
+                                                 run_trace,
+                                                 stitched_attribution,
+                                                 summarize,
+                                                 trace_summary,
+                                                 with_trace_ctx)
 
     comp = {}
     for part in args.comp.split(","):
@@ -173,6 +189,11 @@ def main(argv=None):
             # no rng draw: the seeded schedule/conditions stay
             # identical to the round-10 baselines with traces on or off
             req["trace"] = True
+            # the distributed-trace envelope is deterministic too
+            # (trace id t-<request id> — with_trace_ctx), so the bench
+            # can join each client record against its stitched fleet
+            # trace without responses carrying ids
+            req = with_trace_ctx(req)
         if len(mech_choices) > 1:
             # draw only in multi-mechanism mode: an unconditional draw
             # would consume rng state and silently change every seeded
@@ -385,6 +406,41 @@ def main(argv=None):
         summary["program_compiles"] = sum(
             sum(d.values()) for d in summary["per_host_compiles"].values())
         fleet_router.close()
+
+        # the stitched cross-host story (docs/observability.md "Fleet
+        # tracing"): every member's trace stream + the router's hop
+        # ledger joined in-process — the PR-15 attribution check
+        # EXTENDED across the router hop (client latency must cover
+        # the stitched end-to-end wall)
+        from batchreactor_tpu.obs import build_report
+        from batchreactor_tpu.obs.stitch import merge_reports
+        from batchreactor_tpu.obs.stitch import stitch as stitch_fleet
+
+        fleet_reports = [(name, s.obs_report())
+                         for name, s, _srv in fleet_hosts]
+        fleet_reports.append(("router", build_report(
+            recorder=fleet_router.recorder,
+            meta={"entry": "fleet-router", "bench_seed": args.seed,
+                  "bench_rate_hz": args.rate})))
+        stitched = stitch_fleet(fleet_reports)
+        if not args.no_trace:
+            sattr = stitched_attribution(
+                records, stitched,
+                attribution_tol_ms=args.attribution_tol_ms)
+            if sattr is not None:
+                summary["fleet"]["stitched_attribution"] = sattr
+                attribution_ok = attribution_ok and sattr["ok"]
+                if not sattr["ok"]:
+                    print(f"[serve-bench] STITCHED attribution "
+                          f"violations (first 8): "
+                          f"{sattr['violations']}", file=sys.stderr)
+        if args.obs_out:
+            from batchreactor_tpu.obs import write_jsonl
+
+            write_jsonl(args.obs_out, merge_reports(fleet_reports))
+            print(f"[serve-bench] merged fleet obs report -> "
+                  f"{args.obs_out}", file=sys.stderr)
+
         for _name, s, _srv in fleet_hosts:
             s.__exit__(None, None, None)
 

@@ -75,6 +75,11 @@ def spawn_member(args, name):
     cmd = [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
            "--spec", args.spec, "--fleet-dir", args.fleet_dir,
            "--member-name", name, "--flight-dir", args.flight_dir]
+    if args.obs_dir:
+        # one trace stream per host, file stem = member name — the
+        # obs.stitch join convention (fleet.member_obs_path layout)
+        cmd += ["--obs-out", os.path.join(args.obs_dir,
+                                          f"{name}.jsonl")]
     if args.cache_dir:
         cmd += ["--cache-dir", args.cache_dir]
     if args.no_warmup:
@@ -140,6 +145,13 @@ def main(argv=None):
                     help="forwarded to every member (implies --store)")
     ap.add_argument("--flight-dir", default=".",
                     help="members' flight_*.jsonl postmortem directory")
+    ap.add_argument("--obs-dir", nargs="?", const="auto", default=None,
+                    metavar="DIR",
+                    help="write per-host trace streams here at drain "
+                         "(router.jsonl + one <member>.jsonl each — "
+                         "the obs.stitch / obs_trace.py --fleet "
+                         "layout); bare --obs-dir means "
+                         "<fleet_dir>/obs")
     ap.add_argument("--dead-after-s", type=float, default=None,
                     help="heartbeat age past which a member is dead "
                          "(default fleet.DEFAULT_DEAD_AFTER_S)")
@@ -155,6 +167,12 @@ def main(argv=None):
     dead_after_s = (DEFAULT_DEAD_AFTER_S if args.dead_after_s is None
                     else args.dead_after_s)
     os.makedirs(args.fleet_dir, exist_ok=True)
+    if args.obs_dir == "auto":
+        from batchreactor_tpu.fleet import obs_dir as _fleet_obs_dir
+
+        args.obs_dir = _fleet_obs_dir(args.fleet_dir)
+    elif args.obs_dir:
+        os.makedirs(args.obs_dir, exist_ok=True)
 
     procs = {}
     for i in range(args.members):
@@ -207,6 +225,21 @@ def main(argv=None):
                 print(f"[serve_fleet] member {name} drain timed out; "
                       f"killing", file=sys.stderr)
                 proc.kill()
+        if args.obs_dir:
+            # the router's half of the stitched story: its hop ledgers
+            # + route_seconds histograms, written AFTER the members so
+            # every member's stream is already on disk (obs.stitch
+            # reads the whole directory; jax-free — obs.report is
+            # numpy/stdlib)
+            from batchreactor_tpu.obs import build_report, write_jsonl
+
+            path = os.path.join(args.obs_dir, "router.jsonl")
+            write_jsonl(path, build_report(
+                recorder=router.recorder,
+                meta={"entry": "fleet-router",
+                      "fleet_dir": args.fleet_dir}))
+            print(f"[serve_fleet] router obs report -> {path}",
+                  file=sys.stderr)
     return 0
 
 

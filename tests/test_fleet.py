@@ -214,6 +214,7 @@ class FakeMember:
         self.name = name
         self.error = error
         self.solved = []
+        self.requests = []      # full /solve bodies, as received
         self.uploads = []
         outer = self
 
@@ -226,10 +227,12 @@ class FakeMember:
                     status, body = 200, schema.ok_response(
                         obj["id"], {"fingerprint": f"fp-{obj['mech']}"})
                 elif outer.error is not None:
+                    outer.requests.append(obj)
                     status, code = outer.error
                     body = schema.error_response(obj.get("id"), code,
                                                  "canned")
                 else:
+                    outer.requests.append(obj)
                     outer.solved.append(obj.get("id"))
                     status, body = 200, schema.ok_response(
                         obj.get("id"), {"served_by": outer.name})
@@ -491,6 +494,194 @@ class TestRouterSemantics:
             b.close()
 
 
+class TestRouterTracing:
+    """Distributed tracing through the router (docs/observability.md
+    "Fleet tracing"): context minting/forwarding, the hop ledger, the
+    terminal events error-rate SLOs count, and the ctx-less
+    byte-identity contract the acceptance pins."""
+
+    def _trace_events(self, router):
+        _s, events, _c = router.recorder.snapshot()
+        return [e["attrs"] for e in events
+                if e["name"] == "request_trace"]
+
+    def test_ctxless_request_minted_and_response_byte_identical(
+            self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        try:
+            router = _router(fleet_dir)
+            status, resp = router.solve(_solve_req(0))
+            assert status == 200
+            # byte-identity: the RESPONSE carries no trace ids and the
+            # router section is EXACTLY the pre-tracing dict
+            assert resp["router"] == {"host": "a", "attempts": 1,
+                                      "failover": False, "tried": []}
+            assert set(resp) == {"v", "id", "status", "served_by",
+                                 "router"}
+            # ...but the member received a minted context, hop 1
+            fwd = a.requests[0]["trace_ctx"]
+            assert fwd["trace"].startswith("r-")
+            assert fwd["span"] == "route:1" and fwd["hop"] == 1
+            (ev,) = self._trace_events(router)
+            assert ev["minted"] is True
+            assert ev["trace"] == fwd["trace"]
+            assert ev["host"] == "a" and "code" not in ev
+            assert [h["outcome"] for h in ev["hops"]] == ["ok"]
+            hop = ev["hops"][0]
+            assert hop["member"] == "a" and hop["hop"] == 1
+            assert hop["send_wall"] <= hop["recv_wall"]
+        finally:
+            a.close()
+
+    def test_inherited_ctx_forwarded_with_hop_advance(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        try:
+            router = _router(fleet_dir)
+            obj = _solve_req(0)
+            obj["trace_ctx"] = schema.trace_ctx_payload(
+                "t-cli", span="client", hop=3)
+            status, _resp = router.solve(obj)
+            assert status == 200
+            fwd = a.requests[0]["trace_ctx"]
+            assert fwd == {"v": schema.TRACE_CTX_VERSION,
+                           "trace": "t-cli", "span": "route:4",
+                           "hop": 4}
+            (ev,) = self._trace_events(router)
+            assert ev["minted"] is False
+            assert ev["trace"] == "t-cli"
+            assert ev["parent_span"] == "client" and ev["hop"] == 3
+        finally:
+            a.close()
+
+    def test_invalid_ctx_rejected_and_counted(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        try:
+            router = _router(fleet_dir)
+            obj = _solve_req(0)
+            obj["trace_ctx"] = {"trace": "t", "bogus": 1}
+            status, resp = router.solve(obj)
+            assert status == 400
+            assert resp["error"]["code"] == "invalid"
+            assert a.requests == []     # rejected before any forward
+            (ev,) = self._trace_events(router)
+            assert ev["failed"] is True and ev["code"] == "invalid"
+            assert ev["hops"] == []
+            # the rejection is an SLO sample: error-rate counts it
+            res = router.slo.evaluate()
+            assert res["error_rate"]["bad"] == 1
+        finally:
+            a.close()
+
+    def test_error_responses_emit_terminal_trace_events(self,
+                                                        fleet_dir):
+        """ISSUE-18 satellite: every router error path — upstream
+        rejection, empty fleet — lands ONE terminal ``request_trace``
+        with its rejection code, so error-rate SLOs see what the
+        response alone would hide."""
+        a = FakeMember(fleet_dir, "a", error=(503, "overloaded"))
+        try:
+            router = _router(fleet_dir)
+            status, _resp = router.solve(_solve_req(0))
+            assert status == 503
+            (ev,) = self._trace_events(router)
+            assert ev["failed"] is True and ev["code"] == "overloaded"
+            assert ev["host"] == "a"
+            assert [h["outcome"] for h in ev["hops"]] == ["overloaded"]
+            a.close()
+            router._view(force=True)
+            status, _resp = router.solve(_solve_req(1))
+            assert status == 503
+            evs = self._trace_events(router)
+            assert evs[-1]["code"] == "internal"
+            assert evs[-1]["hops"] == []
+            res = router.slo.evaluate()
+            assert res["error_rate"]["bad"] == 2
+        finally:
+            a.close()
+
+    def test_failover_hop_ledger_is_one_trace(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        b = FakeMember(fleet_dir, "b")
+        try:
+            router = _router(fleet_dir)
+            _s, first = router.solve(_solve_req(0))
+            dead, survivor = ((a, b) if first["router"]["host"] == "a"
+                              else (b, a))
+            dead.kill_http()
+            status, resp = router.solve(_solve_req(1))
+            assert status == 200
+            ev = self._trace_events(router)[-1]
+            assert ev["failover"] is True
+            assert ev["tried"] == resp["router"]["tried"] == [dead.name]
+            assert [(h["member"], h["hop"], h["outcome"])
+                    for h in ev["hops"]] == [
+                (dead.name, 1, "transport"), (survivor.name, 2, "ok")]
+            # both hops under ONE trace id, which the survivor received
+            assert survivor.requests[-1]["trace_ctx"]["trace"] \
+                == ev["trace"]
+            assert survivor.requests[-1]["trace_ctx"]["span"] \
+                == "route:2"
+            res = router.slo.evaluate()
+            assert res["failover_rate"]["bad"] == 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_metrics_text_carries_slo_gauges(self, fleet_dir):
+        a = FakeMember(fleet_dir, "a")
+        try:
+            router = _router(fleet_dir)
+            router.solve(_solve_req(0))
+            text = router.metrics_text()
+            assert "# TYPE br_slo_burn_rate gauge" in text
+            assert 'br_slo_requests{window="slow"} 1' in text
+            assert 'br_slo_alert{objective="error_rate"} 0' in text
+            # the base exposition is intact alongside
+            assert "route_requests" in text
+        finally:
+            a.close()
+
+
+class TestFleetSnapshotMergeLateJoiner:
+    def test_member_snapshot_without_histograms_merges_as_empty(
+            self, fleet_dir):
+        """ISSUE-18 satellite: a member snapshot missing the
+        ``histograms`` key entirely (a late joiner that has not
+        observed yet, or a pre-histogram writer) merges as EMPTY
+        through the router's /metrics fleet exposition — never a
+        KeyError, never a fabricated series."""
+        from batchreactor_tpu.obs.live import (LiveRegistry,
+                                               write_fleet_snapshot)
+        from batchreactor_tpu.obs.recorder import Recorder
+
+        a = FakeMember(fleet_dir, "a")
+        try:
+            router = _router(fleet_dir)
+            rec = Recorder()
+            rec.counter("serve_answered", 2)
+            for d in (0.01, 0.04):
+                rec.observe("serve_stage_seconds", d, stage="total")
+            write_fleet_snapshot(fleet_dir, 1,
+                                 LiveRegistry(recorder=rec))
+            # the late joiner: counters only, no "histograms" key
+            hosts = os.path.join(fleet_dir, "hosts")
+            os.makedirs(hosts, exist_ok=True)
+            with open(os.path.join(hosts, "p2.metrics.json"),
+                      "w") as f:
+                json.dump({"pid": 2, "time": time.time(),
+                           "counters": {"serve_answered": 1},
+                           "gauges": {}}, f)
+            text = router.metrics_text()
+            # merged family = exactly the ONE host's observations
+            assert ('br_fleet_serve_stage_seconds_count'
+                    '{stage="total"} 2') in text
+            # both hosts' counters still merged
+            assert 'host="p1",name="serve_answered"' in text
+            assert 'host="p2",name="serve_answered"' in text
+        finally:
+            a.close()
+
+
 # --------------------------------------------------------------------------
 # end-to-end: two real daemons + router over real HTTP, h2o2 fixture
 # --------------------------------------------------------------------------
@@ -615,6 +806,73 @@ class TestFleetEndToEnd:
         counters = router.recorder.snapshot()[2]
         assert counters["route_failovers"] >= 1
         assert counters["route_requests"] >= 2
+
+    def test_failover_chain_stitches_into_one_trace(self, live_fleet):
+        """Acceptance: a traced request whose serving member is dead
+        (abrupt HTTP teardown, heartbeat still fresh) stitches into ONE
+        fleet-wide trace — the router's span, the dead member's
+        ledger-only attempt, and the survivor's full stage waterfall —
+        with hop provenance matching the response's ``router.tried``."""
+        from batchreactor_tpu.obs import build_report
+        from batchreactor_tpu.obs.stitch import stitch
+        from batchreactor_tpu.serving.client import (SolveClient,
+                                                     with_trace_ctx)
+
+        router, hosts = live_fleet
+        client = SolveClient(router.url, timeout=120.0)
+        req = {"T": [1150.0 + 37.0 * i for i in range(8)],
+               "X": _COMP, "t1": 5e-5}
+
+        # the key's owner must be DEAD when the traced request lands;
+        # the earlier test already killed it — kill it here if this
+        # test runs alone
+        probe = client.solve({"id": "wt-probe", **req})
+        dead_name = next((n for n, (_s, srv) in hosts.items()
+                          if srv._server is None), None)
+        if dead_name is None:
+            dead_name = probe["router"]["host"]
+            srv = hosts[dead_name][1]
+            srv._server.shutdown()
+            srv._server.server_close()
+            srv._thread.join()
+            srv._server = srv._thread = None
+        (survivor,) = [n for n in hosts if n != dead_name]
+        # clear the suspect demotion so the dead owner is tried FIRST
+        # again — the failover must happen INSIDE this trace
+        with router._lock:
+            router._suspects.clear()
+
+        resp = client.solve(with_trace_ctx({"id": "wt", **req}))
+        assert resp["status"] == "ok"
+        assert resp["router"]["failover"] is True
+        assert resp["router"]["tried"] == [dead_name]
+        # tracing never leaks into the response
+        assert "trace" not in resp and "trace_ctx" not in resp
+
+        reports = [(name, sess.obs_report())
+                   for name, (sess, _srv) in hosts.items()]
+        reports.append(("router",
+                        build_report(recorder=router.recorder)))
+        stitched = stitch(reports)
+        (t,) = [t for t in stitched if t["request"] == "wt"]
+        assert t["trace"] == "t-wt"     # with_trace_ctx derivation
+        assert t["minted"] is False
+        assert t["failover"] is True
+        assert t["tried"] == resp["router"]["tried"]
+        assert t["host"] == survivor
+        assert [(h["member"], h["outcome"]) for h in t["hops"]] == [
+            (dead_name, "transport"), (survivor, "ok")]
+        dead_hop, ok_hop = t["hops"]
+        assert "member_trace" not in dead_hop   # ledger-only attempt
+        mt = ok_hop["member_trace"]
+        assert mt["parent_span"] == "route:2"
+        assert set(mt["stages"]) >= {"submitted", "admitted",
+                                     "resolved"}
+        assert "skew_s" in ok_hop and "wall_start_corrected" in ok_hop
+        # the member's solve fits inside the router's wall bracket
+        assert abs(ok_hop["skew_s"]) < 5.0
+        assert mt["total_s"] <= (ok_hop["recv_wall"]
+                                 - ok_hop["send_wall"]) + 1e-3
 
     def test_fleet_metrics_merge_members(self, live_fleet):
         """The router /metrics carries the PR-9 fleet merge: both

@@ -520,3 +520,617 @@ class TestObsTraceCLI:
                 capsys.readouterr().out.splitlines()]
         # only req-3 (48ms) and req-4 (60ms) pass the 40ms threshold
         assert {r["request"] for r in recs} == {"req-3", "req-4"}
+
+
+# --------------------------------------------------------------------------
+# trace context: the propagated fleet identity (docs/observability.md
+# "Fleet tracing")
+# --------------------------------------------------------------------------
+class TestTraceCtx:
+    def test_payload_validate_round_trip(self):
+        ctx = schema.trace_ctx_payload("t-run-1", span="route:2", hop=2)
+        assert ctx == {"v": schema.TRACE_CTX_VERSION, "trace": "t-run-1",
+                       "span": "route:2", "hop": 2}
+        assert schema.validate_trace_ctx(ctx) == ("t-run-1", "route:2", 2)
+        # root context: span omitted, hop 0 omitted from the wire form
+        root = schema.trace_ctx_payload("t-root")
+        assert root == {"v": schema.TRACE_CTX_VERSION, "trace": "t-root"}
+        assert schema.validate_trace_ctx(root) == ("t-root", None, 0)
+        assert schema.validate_trace_ctx(None) is None
+
+    @pytest.mark.parametrize("ctx,match", [
+        ("t-1", "must be a JSON object"),
+        ({"trace": "t", "spam": 1}, "unknown trace_ctx key"),
+        ({"v": 2, "trace": "t"}, "unsupported trace_ctx version"),
+        ({"v": 1}, "non-empty"),
+        ({"trace": ""}, "non-empty"),
+        ({"trace": "t", "span": ""}, "parent-span-id"),
+        ({"trace": "t", "span": 7}, "parent-span-id"),
+        ({"trace": "t", "hop": -1}, "integer >= 0"),
+        ({"trace": "t", "hop": True}, "integer >= 0"),
+        ({"trace": "t", "hop": 1.5}, "integer >= 0"),
+    ])
+    def test_loud_validation(self, ctx, match):
+        with pytest.raises(ValueError, match=match):
+            schema.validate_trace_ctx(ctx, "r1")
+
+    def test_request_field_and_pack_key_exclusion(self):
+        """``trace_ctx`` rides the request as the normalized tuple and
+        NEVER enters the pack key — trace identity must not split a
+        batch."""
+        plain = schema.validate_request(_req())
+        assert plain.trace_ctx is None
+        traced = schema.validate_request(_req(
+            trace_ctx=schema.trace_ctx_payload("t-9", span="client")))
+        assert traced.trace_ctx == ("t-9", "client", 0)
+        assert plain.pack_key() == traced.pack_key()
+
+    def test_adopt_and_attrs_byte_identity(self):
+        """``to_attrs`` adds the fleet identity ONLY after adoption —
+        a ctx-less trace exports exactly the pre-fleet attribute set
+        (the byte-identity regression the acceptance pins)."""
+        bare = RequestTrace("r1").to_attrs()
+        assert not {"trace", "parent_span", "hop"} & set(bare)
+        tr = RequestTrace("r1")
+        assert tr.adopt("t-77", parent_span="route:3", hop=3) is tr
+        attrs = tr.to_attrs()
+        assert attrs["trace"] == "t-77"
+        assert attrs["parent_span"] == "route:3"
+        assert attrs["hop"] == 3
+        assert set(attrs) - set(bare) == {"trace", "parent_span", "hop"}
+        with pytest.raises(ValueError, match="non-empty trace id"):
+            RequestTrace("r1").adopt("")
+
+    def test_scheduler_adopts_inherited_ctx(self):
+        """A request carrying ``trace_ctx`` resolves with its member
+        ``request_trace`` event tagged with the inherited identity; a
+        ctx-less sibling's event stays untagged."""
+        sess = FakeSession()
+        sched = Scheduler(sess).start()
+        futs = [
+            sched.submit(schema.validate_request(_req(
+                id="traced", T=[1000.0],
+                trace_ctx=schema.trace_ctx_payload(
+                    "t-fleet", span="route:1", hop=1)))),
+            sched.submit(schema.validate_request(_req(
+                id="plain", T=[1100.0])))]
+        for f in futs:
+            f.result(10.0)
+        sched.drain(5.0)
+        _s, events, _c = sess.recorder.snapshot()
+        by_id = {e["attrs"]["request"]: e["attrs"] for e in events
+                 if e["name"] == "request_trace"}
+        assert by_id["traced"]["trace"] == "t-fleet"
+        assert by_id["traced"]["parent_span"] == "route:1"
+        assert by_id["traced"]["hop"] == 1
+        assert not {"trace", "parent_span", "hop"} & set(by_id["plain"])
+
+
+class TestCoalesceTelemetry:
+    def test_window_histogram_and_mode_label(self):
+        """ISSUE-18 satellite: a coalescing scheduler records the
+        window each epoch closed at as the ``coalesce_window_s``
+        histogram, labeled by lever mode."""
+        sess = FakeSession(coalesce_s=0.01)
+        sched = Scheduler(sess).start()
+        sched.submit(_request("a", [1000.0])).result(10.0)
+        sched.drain(5.0)
+        fam = sess.recorder.hist_snapshot()["coalesce_window_s"]
+        assert [ser["labels"] for ser in fam] == [{"mode": "fixed"}]
+        assert fam[0]["count"] >= 1
+        assert fam[0]["sum"] <= 0.011 * fam[0]["count"]
+
+    def test_adaptive_mode_label_and_family_registered(self):
+        sess = FakeSession(coalesce_s=0.01, coalesce_adaptive=True)
+        sched = Scheduler(sess).start()
+        sched.submit(_request("a", [1000.0])).result(10.0)
+        sched.drain(5.0)
+        fam = sess.recorder.hist_snapshot()["coalesce_window_s"]
+        assert [ser["labels"] for ser in fam] == [{"mode": "adaptive"}]
+        # FAMILIES enrollment (the brlint tier-C audit contract)
+        fams = [meta for meta in C.FAMILIES.values()
+                if tuple(meta["keys"]) == C.COALESCE_HIST_KEYS]
+        assert len(fams) == 1
+        assert fams[0]["semantics"] == "histogram"
+        assert fams[0]["missing_zero"]
+
+    def test_no_window_no_family(self):
+        """``coalesce_s=0`` (the default) records nothing — the
+        telemetry must not invent a distribution for a disabled
+        lever."""
+        sess = FakeSession()
+        sched = Scheduler(sess).start()
+        sched.submit(_request("a", [1000.0])).result(10.0)
+        sched.drain(5.0)
+        assert "coalesce_window_s" not in sess.recorder.hist_snapshot()
+
+
+# --------------------------------------------------------------------------
+# the SLO monitor (obs/slo.py — docs/observability.md "SLO monitor")
+# --------------------------------------------------------------------------
+class TestSloObjectives:
+    def test_defaults_cover_the_vocabulary(self):
+        from batchreactor_tpu.obs import slo
+
+        assert [o.kind for o in slo.DEFAULT_OBJECTIVES] == [
+            "latency", "error", "failover"]
+
+    @pytest.mark.parametrize("kw,match", [
+        (dict(name="", kind="error", budget=0.1), "non-empty"),
+        (dict(name="x", kind="uptime", budget=0.1), "unknown kind"),
+        (dict(name="x", kind="error", budget=0.0), "fraction in"),
+        (dict(name="x", kind="error", budget=1.0), "fraction in"),
+        (dict(name="x", kind="latency", budget=0.1), "threshold_s > 0"),
+        (dict(name="x", kind="error", budget=0.1, threshold_s=1.0),
+         "only applies to latency"),
+    ])
+    def test_loud_validation(self, kw, match):
+        from batchreactor_tpu.obs.slo import Objective
+
+        with pytest.raises(ValueError, match=match):
+            Objective(**kw)
+
+    def test_bad_semantics(self):
+        from batchreactor_tpu.obs.slo import Objective
+
+        lat = Objective("l", "latency", 0.05, threshold_s=1.0)
+        err = Objective("e", "error", 0.01)
+        fo = Objective("f", "failover", 0.05)
+        # a failed request is the ERROR objective's problem, not the
+        # latency one's (its latency is a rejection's, not a solve's)
+        assert lat.bad(2.0, ok=True, failover=False)
+        assert not lat.bad(2.0, ok=False, failover=False)
+        assert not lat.bad(0.5, ok=True, failover=False)
+        assert err.bad(0.1, ok=False, failover=False)
+        assert not err.bad(9.9, ok=True, failover=True)
+        assert fo.bad(0.1, ok=True, failover=True)
+        assert not fo.bad(0.1, ok=True, failover=False)
+
+
+class TestSloMonitor:
+    def _monitor(self, rec=None, **kw):
+        from batchreactor_tpu.obs.slo import SloMonitor
+
+        kw.setdefault("window_s", 300.0)
+        kw.setdefault("fast_window_s", 30.0)
+        return SloMonitor(recorder=rec, **kw)
+
+    def test_multi_window_burn_and_transition_events(self):
+        """The SRE-workbook shape: the alert fires only when BOTH
+        windows burn past the threshold, and each state TRANSITION is
+        one ``slo_alert`` event + one ``slo_alerts`` count."""
+        rec = Recorder()
+        mon = self._monitor(rec)
+        t0 = 1_000_000.0
+        # 20 good-but-slow samples: latency_p95 burn = 1.0/0.05 = 20
+        for i in range(20):
+            mon.record(3.5, ok=True, at=t0 + i)
+        res = mon.evaluate(now=t0 + 20)
+        lat = res["latency_p95"]
+        assert lat["requests"] == 20 and lat["bad"] == 20
+        assert lat["burn"] == pytest.approx(20.0)
+        assert lat["fast"]["burn"] == pytest.approx(20.0)
+        assert lat["alerting"] is True
+        assert res["error_rate"]["alerting"] is False
+        # the bleeding stops: fast window clears first, alert resolves
+        for i in range(40):
+            mon.record(0.01, ok=True, at=t0 + 60 + i)
+        res2 = mon.evaluate(now=t0 + 60 + 40)
+        assert res2["latency_p95"]["fast"]["bad"] == 0
+        assert res2["latency_p95"]["alerting"] is False
+        _s, events, counters = rec.snapshot()
+        alerts = [e["attrs"] for e in events if e["name"] == "slo_alert"]
+        assert [(a["objective"], a["state"]) for a in alerts] == [
+            ("latency_p95", "firing"), ("latency_p95", "resolved")]
+        assert counters["slo_alerts"] == 2
+        # FAMILIES enrollment (the brlint tier-C audit contract)
+        fams = [meta for meta in C.FAMILIES.values()
+                if tuple(meta["keys"]) == C.SLO_KEYS]
+        assert len(fams) == 1 and fams[0]["missing_zero"]
+
+    def test_one_spike_does_not_page(self):
+        """A burst confined to the fast window must not alert while the
+        slow window's burn stays under the threshold."""
+        mon = self._monitor()
+        t0 = 2_000_000.0
+        for i in range(300):
+            mon.record(0.01, ok=True, at=t0 + i * 0.9)
+        mon.record(0.01, ok=False, at=t0 + 271.0)
+        res = mon.evaluate(now=t0 + 272.0)
+        err = res["error_rate"]
+        assert err["fast"]["burn"] >= 2.0      # the spike, fast window
+        assert err["burn"] < 2.0               # diluted, slow window
+        assert err["alerting"] is False
+
+    def test_window_trim_and_empty_windows(self):
+        mon = self._monitor()
+        t0 = 3_000_000.0
+        mon.record(0.1, ok=False, at=t0)
+        res = mon.evaluate(now=t0 + 301.0)     # aged out of the window
+        assert all(r["requests"] == 0 and not r["alerting"]
+                   for r in res.values())
+
+    def test_prometheus_gauges(self):
+        mon = self._monitor()
+        t0 = 4_000_000.0
+        for i in range(10):
+            mon.record(0.01, ok=(i != 0), failover=(i == 1), at=t0 + i)
+        prom = mon.prometheus(now=t0 + 10)
+        assert '# TYPE br_slo_requests gauge' in prom
+        assert 'br_slo_requests{window="slow"} 10' in prom
+        assert ('br_slo_bad_fraction{objective="error_rate",'
+                'window="slow"} 0.1') in prom
+        assert ('br_slo_burn_rate{objective="failover_rate",'
+                'window="slow"} 2' in prom)
+        assert 'br_slo_alert{objective="latency_p95"} 0' in prom
+
+    def test_constructor_loudness(self):
+        from batchreactor_tpu.obs.slo import Objective, SloMonitor
+
+        with pytest.raises(ValueError, match="at least one"):
+            SloMonitor(objectives=())
+        with pytest.raises(ValueError, match="duplicate objective"):
+            SloMonitor(objectives=(Objective("x", "error", 0.1),
+                                   Objective("x", "failover", 0.1)))
+        with pytest.raises(ValueError, match="must sit inside"):
+            SloMonitor(fast_window_s=400.0)
+        with pytest.raises(ValueError, match="burn_alert"):
+            SloMonitor(burn_alert=0.0)
+
+    def test_evaluate_traces_offline(self):
+        from batchreactor_tpu.obs.slo import Objective, evaluate_traces
+
+        traces = ([{"total_s": 0.1, "failover": False}] * 8
+                  + [{"total_s": 9.0, "failover": True}]
+                  + [{"total_s": 0.2, "failed": True,
+                      "code": "internal"}]
+                  + [{"total_s": None}])    # unmeasured: skipped
+        res = evaluate_traces(traces, (
+            Objective("lat", "latency", 0.5, threshold_s=2.5),
+            Objective("err", "error", 0.05),
+            Objective("fo", "failover", 0.05)))
+        assert res["lat"]["requests"] == 10
+        assert res["lat"]["bad"] == 1 and res["lat"]["ok"]
+        assert res["err"]["bad"] == 1 and not res["err"]["ok"]
+        assert res["fo"]["bad_fraction"] == pytest.approx(0.1)
+        assert not res["fo"]["ok"]
+
+
+# --------------------------------------------------------------------------
+# cross-host stitching (obs/stitch.py — docs/observability.md
+# "Fleet tracing")
+# --------------------------------------------------------------------------
+def _fleet_reports(skew_s=0.0):
+    """A synthetic two-member fleet run: request ``fo`` fails over from
+    m1 (transport death) to m2; request ``ok`` routes direct to m1;
+    ``lone`` hit m2 without a router.  ``skew_s`` shifts the members'
+    wall clocks to exercise the correction."""
+    t0 = 1_700_000_000.0
+    router = Recorder()
+    router.counter("route_requests", 2)
+    router.counter("route_failovers", 1)
+    router.observe("route_seconds", 0.3, path="failover")
+    router.observe("route_seconds", 0.05, path="direct")
+    router.event("request_trace", request="fo", v=1, span="route",
+                 trace="t-fo", parent_span="client", minted=False,
+                 hop=0, wall_start=t0, total_s=0.3, failover=True,
+                 tried=["m1"], host="m2", hops=[
+                     {"member": "m1", "hop": 1, "send_wall": t0,
+                      "recv_wall": t0 + 0.05, "outcome": "transport"},
+                     {"member": "m2", "hop": 2,
+                      "send_wall": t0 + 0.06,
+                      "recv_wall": t0 + 0.3, "outcome": "ok"}])
+    router.event("request_trace", request="ok", v=1, span="route",
+                 trace="r-deadbeef", minted=True, hop=0,
+                 wall_start=t0 + 1.0, total_s=0.05, failover=False,
+                 tried=[], host="m1", hops=[
+                     {"member": "m1", "hop": 1, "send_wall": t0 + 1.0,
+                      "recv_wall": t0 + 1.05, "outcome": "ok"}])
+
+    def member(name, rid, tid, hop, wall, total, parent):
+        rec = Recorder()
+        rec.counter("serve_answered", 1)
+        tr = RequestTrace(rid, lanes=1)
+        tr.adopt(tid, parent_span=parent, hop=hop)
+        t_sub = tr.at("submitted")
+        tr.mark("coalesced", at=t_sub + 0.01)
+        tr.mark("admitted", at=t_sub + 0.02)
+        tr.mark("first_harvest", at=t_sub + total - 0.01)
+        tr.mark("resolved", at=t_sub + total)
+        for stage, dur in tr.segments().items():
+            rec.observe("serve_stage_seconds", dur, stage=stage)
+        attrs = tr.to_attrs()
+        attrs["wall_start"] = round(wall, 6)   # scripted clock
+        attrs["total_s"] = round(total, 6)
+        rec.event("request_trace", **attrs)
+        return rec
+
+    # m2 solved "fo" inside the second bracket: 0.2s of member work in
+    # a 0.24s bracket -> 0.02s slack per leg
+    m2 = member("m2", "fo", "t-fo", 2, t0 + 0.08 + skew_s, 0.2,
+                "route:2")
+    # the same m2 stream also carries the router-less "lone" request
+    lone = RequestTrace("lone", lanes=1)
+    lone.mark("resolved", at=lone.at("submitted") + 0.4)
+    lone_attrs = lone.to_attrs()
+    lone_attrs["wall_start"] = round(t0 + 2.0, 6)   # scripted clock
+    m2.event("request_trace", **lone_attrs)
+    m1 = member("m1", "ok", "r-deadbeef", 1, t0 + 1.01 + skew_s, 0.03,
+                "route:1")
+    return [("m1", build_report(recorder=m1)),
+            ("m2", build_report(recorder=m2)),
+            ("router", build_report(recorder=router,
+                                    meta={"entry": "fleet-router"}))]
+
+
+class TestStitch:
+    def test_failover_is_one_trace_with_dead_hop(self):
+        from batchreactor_tpu.obs import stitch
+
+        traces = stitch.stitch(_fleet_reports())
+        by_req = {t["request"]: t for t in traces}
+        fo = by_req["fo"]
+        assert fo["trace"] == "t-fo" and fo["router"] == "router"
+        assert fo["failover"] and fo["tried"] == ["m1"]
+        assert fo["host"] == "m2" and not fo["minted"]
+        assert [h["member"] for h in fo["hops"]] == ["m1", "m2"]
+        dead, alive = fo["hops"]
+        # the SIGKILLed attempt is PART of the trace: ledger only
+        assert dead["outcome"] == "transport"
+        assert "member_trace" not in dead
+        # the survivor's waterfall joined, child of the router's span
+        mt = alive["member_trace"]
+        assert mt["parent_span"] == "route:2"
+        assert mt["stages"]["resolved"] == pytest.approx(0.2)
+        ok = by_req["ok"]
+        assert ok["minted"] and ok["trace"] == "r-deadbeef"
+        assert ok["hops"][0]["member_trace"]["parent_span"] == "route:1"
+
+    @pytest.mark.parametrize("skew", [0.0, -7.5, 42.0])
+    def test_clock_skew_correction(self, skew):
+        """The member's wall start re-bases onto the router's send/recv
+        bracket (slack split evenly), and ``skew_s`` reports how far
+        the member's clock sat from that — invariant to the actual
+        skew."""
+        from batchreactor_tpu.obs import stitch
+
+        traces = stitch.stitch(_fleet_reports(skew_s=skew))
+        alive = next(t for t in traces
+                     if t["request"] == "fo")["hops"][1]
+        # bracket 0.24s, member total 0.2s -> corrected = send + 0.02
+        t0 = 1_700_000_000.0
+        assert alive["wall_start_corrected"] == pytest.approx(
+            t0 + 0.06 + 0.02, abs=1e-6)
+        assert alive["skew_s"] == pytest.approx(skew + 0.0, abs=1e-3)
+
+    def test_routerless_member_trace_is_single_hop(self):
+        from batchreactor_tpu.obs import stitch
+
+        traces = stitch.stitch(_fleet_reports())
+        lone = next(t for t in traces if t["request"] == "lone")
+        assert lone["router"] is None and lone["trace"] is None
+        assert [h["member"] for h in lone["hops"]] == ["m2"]
+        assert lone["hops"][0]["outcome"] == "ok"
+        assert lone["hops"][0]["member_trace"]["stages"]["resolved"] \
+            == pytest.approx(0.4)
+
+    def test_load_fleet_round_trip_and_loudness(self, tmp_path):
+        from batchreactor_tpu.obs import stitch, write_jsonl
+
+        for host, rep in _fleet_reports():
+            write_jsonl(str(tmp_path / f"{host}.jsonl"), rep)
+        loaded = stitch.load_fleet(str(tmp_path))
+        assert [h for h, _ in loaded] == ["m1", "m2", "router"]
+        assert stitch.stitch(loaded) == stitch.stitch(_fleet_reports())
+        with pytest.raises(ValueError, match="unreadable"):
+            stitch.load_fleet(str(tmp_path / "missing"))
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(ValueError, match="no .*trace streams"):
+            stitch.load_fleet(str(tmp_path / "empty"))
+
+    def test_merge_reports_is_gateable(self):
+        """The fleet merge is ONE br-obs-v1 report: counters summed,
+        histogram families slot-merged — and obs_gate.py can band it
+        like any single-host report."""
+        from batchreactor_tpu.obs import stitch
+
+        merged = stitch.merge_reports(_fleet_reports())
+        assert merged["meta"]["hosts"] == ["m1", "m2", "router"]
+        assert merged["counters"]["serve_answered"] == 2
+        assert merged["counters"]["route_failovers"] == 1
+        routes = merged["histograms"]["route_seconds"]
+        assert {ser["labels"]["path"] for ser in routes} == {
+            "direct", "failover"}
+        stages = {ser["labels"]["stage"]: ser for ser in
+                  merged["histograms"]["serve_stage_seconds"]}
+        assert stages["resolved"]["count"] == 2     # m1 + m2 merged
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        from obs_gate import run_gate
+
+        failures, _lines = run_gate({
+            "counters": {"route_failovers": {"equals": 1}},
+            "histograms": {"route_seconds": {
+                "path=failover": {"count": {"equals": 1}}}},
+        }, merged)
+        assert failures == []
+
+    def test_render_fleet_flags_and_bars(self):
+        from batchreactor_tpu.obs import stitch
+
+        text = stitch.render_fleet(stitch.stitch(_fleet_reports()))
+        assert "fleet traces: 3 stitched" in text
+        assert "FAILOVER tried=['m1']" in text
+        assert "[transport]" in text and "[ok]" in text
+        assert "skew=" in text and "bracket=" in text
+        assert "resolved" in text and "minted" in text
+
+
+class TestStitchedAttribution:
+    """ISSUE-18 satellite: ``serve_bench.py --router`` asserts the
+    client-side latency against the stitched end-to-end duration —
+    the join is ``t-<request id>``, never a response field."""
+
+    def _records(self):
+        return [{"id": f"b{i}", "ok": True, "latency_s": 0.1 + 0.01 * i,
+                 "send_at": float(i), "code": None, "response": {}}
+                for i in range(3)]
+
+    def _stitched(self, gap_s=0.005):
+        return [{"trace": f"t-b{i}", "request": f"b{i}",
+                 "total_s": 0.1 + 0.01 * i - gap_s}
+                for i in range(3)]
+
+    def test_joins_and_passes_within_tolerance(self):
+        from batchreactor_tpu.serving.client import stitched_attribution
+
+        s = stitched_attribution(self._records(), self._stitched(),
+                                 attribution_tol_ms=50.0)
+        assert s["n"] == 3 and s["ok"] and not s["violations"]
+        assert s["max_gap_ms"] == pytest.approx(5.0)
+
+    def test_violation_on_gap_and_impossible_server_time(self):
+        from batchreactor_tpu.serving.client import stitched_attribution
+
+        stitched = self._stitched()
+        stitched[0]["total_s"] = 5.0       # server > client: impossible
+        stitched[1]["total_s"] = 0.001     # huge unattributed gap
+        s = stitched_attribution(self._records(), stitched,
+                                 attribution_tol_ms=50.0)
+        assert not s["ok"]
+        assert {v["id"] for v in s["violations"]} == {"b0", "b1"}
+
+    def test_none_when_nothing_joins(self):
+        from batchreactor_tpu.serving.client import stitched_attribution
+
+        assert stitched_attribution(self._records(), [],
+                                    attribution_tol_ms=50.0) is None
+
+
+# --------------------------------------------------------------------------
+# the SLO gate CLI (scripts/obs_slo.py)
+# --------------------------------------------------------------------------
+class TestObsSloCLI:
+    BASELINE = {
+        "schema": "br-slo-gate-v1",
+        "objectives": {
+            "latency_p95": {"kind": "latency", "budget": 0.05,
+                            "threshold_s": 2.5,
+                            "bad_fraction": {"max": 0.05}},
+            "error_rate": {"kind": "error", "budget": 0.01,
+                           "bad": {"max": 0}},
+            "failover_rate": {"kind": "failover", "budget": 0.6,
+                              "bad_fraction": {"max": 0.6}}},
+        "requests": {"min": 2},
+    }
+
+    def _fleet_dir(self, tmp_path):
+        from batchreactor_tpu.obs import write_jsonl
+
+        d = tmp_path / "obs"
+        d.mkdir()
+        for host, rep in _fleet_reports():
+            write_jsonl(str(d / f"{host}.jsonl"), rep)
+        return str(d)
+
+    def _run(self, tmp_path, baseline, argv_extra=()):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import obs_slo
+
+        base_path = tmp_path / "slo_base.json"
+        base_path.write_text(json.dumps(baseline))
+        return obs_slo.main(["--fleet", self._fleet_dir(tmp_path),
+                             "--gate", "--baseline", str(base_path),
+                             *argv_extra])
+
+    def test_gate_passes_in_band(self, tmp_path, capsys):
+        rc = self._run(tmp_path, self.BASELINE)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "slo gate ok" in out
+        assert "3 stitched trace(s)" in out
+
+    def test_gate_fails_on_breach(self, tmp_path, capsys):
+        bad = json.loads(json.dumps(self.BASELINE))
+        # 1/3 failovers breaches a 5% failover budget
+        bad["objectives"]["failover_rate"]["budget"] = 0.05
+        bad["objectives"]["failover_rate"]["bad_fraction"]["max"] = 0.05
+        bad["requests"] = {"min": 50}
+        rc = self._run(tmp_path, bad, ["--json"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        payload = json.loads(out.splitlines()[0])
+        assert payload["traces"] == 3
+        assert payload["objectives"]["failover_rate"]["ok"] is False
+
+    def test_unknown_section_is_loud(self, tmp_path):
+        bad = {**self.BASELINE, "frontier": {}}
+        with pytest.raises(ValueError, match="unknown SLO gate"):
+            self._run(tmp_path, bad)
+
+    def test_checked_fixture_is_the_ci_contract(self, tmp_path,
+                                                capsys):
+        """The banked fleet baseline (tests/fixtures/
+        fleet_slo_baseline.json — the CI fleet-smoke gate) parses,
+        declares all three default objectives, and passes over the
+        synthetic fleet run."""
+        with open(os.path.join(REPO, "tests", "fixtures",
+                               "fleet_slo_baseline.json")) as f:
+            banked = json.load(f)
+        assert banked["schema"] == "br-slo-gate-v1"
+        assert set(banked["objectives"]) == {
+            "latency_p95", "error_rate", "failover_rate"}
+        banked = json.loads(json.dumps(banked))
+        # re-scale the CI-sized floors to the 3-trace synthetic run
+        # (1 deliberate failover in 3 is over the banked 25%, which is
+        # sized for fleet-smoke's ~34 requests with ONE SIGKILL)
+        banked["requests"] = {"min": 1}
+        banked["objectives"]["failover_rate"]["budget"] = 0.5
+        banked["objectives"]["failover_rate"]["bad_fraction"]["max"] \
+            = 0.5
+        rc = self._run(tmp_path, banked)
+        assert rc == 0
+        assert "slo gate ok" in capsys.readouterr().out
+
+
+class TestObsTraceFleetCLI:
+    def test_fleet_waterfalls_and_artifact(self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import obs_trace
+        from batchreactor_tpu.obs import write_jsonl
+
+        d = tmp_path / "obs"
+        d.mkdir()
+        for host, rep in _fleet_reports():
+            write_jsonl(str(d / f"{host}.jsonl"), rep)
+        out_path = tmp_path / "fleet_wf.txt"
+        rc = obs_trace.main(["--fleet", str(d), "--slowest", "2",
+                             "--out", str(out_path)])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "fleet traces: 3 stitched, showing 2 slowest" in text
+        assert "FAILOVER" in text
+        assert out_path.read_text().strip() == text.strip()
+
+    def test_fleet_json_records(self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import obs_trace
+        from batchreactor_tpu.obs import write_jsonl
+
+        d = tmp_path / "obs"
+        d.mkdir()
+        for host, rep in _fleet_reports():
+            write_jsonl(str(d / f"{host}.jsonl"), rep)
+        rc = obs_trace.main(["--fleet", str(d), "--json"])
+        assert rc == 0
+        recs = [json.loads(ln) for ln in
+                capsys.readouterr().out.splitlines()]
+        assert {r["request"] for r in recs} == {"fo", "ok", "lone"}
+
+    def test_exactly_one_input_mode(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import obs_trace
+
+        with pytest.raises(SystemExit):
+            obs_trace.main([])
+        with pytest.raises(SystemExit):
+            obs_trace.main(["rep.jsonl", "--fleet", str(tmp_path)])
