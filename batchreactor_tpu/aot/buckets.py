@@ -142,6 +142,47 @@ def downshift_bucket(n_live, buckets, current, *, mesh_size=1):
     return target if target < int(current) else None
 
 
+def upshift_bucket(demand, buckets, current, *, cap=None, mesh_size=1):
+    """The next-larger ladder rung a backlogged stream can up-shift onto,
+    or ``None`` when no up-shift applies — the autoscaling dual of
+    :func:`downshift_bucket`.
+
+    The streaming admission driver (``parallel/sweep.py``, ``upshift=``)
+    calls this when its backlog has exceeded the current bucket's
+    headroom for ``upshift_patience`` consecutive polls: ``demand`` is
+    the lane count the stream wants resident (live lanes + backlog
+    depth).  The answer is always the SINGLE next rung up — one rung
+    per shift keeps every migration inside the warmed ladder
+    (:func:`aot.warmup` bakes each rung, so the executable switch costs
+    zero compiles) and gives the hysteresis window a fixed step size to
+    damp against.  ``cap`` bounds the climb: rungs above
+    ``resolve_bucket(cap)`` are never proposed (the ``upshift=`` knob's
+    resident-lane ceiling — the ladder analogue of ``resident=``).
+    ``buckets=None`` (bucketing off) never up-shifts — there is no
+    canonical ladder to climb.
+    """
+    buckets = normalize_buckets(buckets)
+    if buckets is None:
+        return None
+    current = int(current)
+    if int(demand) <= current:
+        return None
+    if buckets == POW2:
+        target = resolve_bucket(current + 1, buckets,
+                                mesh_size=mesh_size)
+    else:
+        target = next((b for b in buckets
+                       if b > current and b % int(mesh_size) == 0), None)
+        if target is None:
+            return None
+    if cap is not None:
+        ceiling = resolve_bucket(max(int(cap), 1), buckets,
+                                 mesh_size=mesh_size)
+        if target > ceiling:
+            return None
+    return target if target > current else None
+
+
 def bucket_ladder(lanes, buckets):
     """The deduplicated, sorted bucket set covering the given lane
     counts — what :func:`aot.warmup` compiles and ``scripts/

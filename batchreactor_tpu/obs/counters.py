@@ -77,7 +77,9 @@ FAULT_KEYS = ("fetch_timeouts", "chunk_retries", "chunks_corrupt",
 #: docs/performance.md "Continuous batching"): Recorder counters, not
 #: device stats.  ``compactions``/``admitted_lanes``/``bucket_downshifts``
 #: count the streaming driver's queue events and appear only when
-#: admission ran; ``lane_attempts``/``lane_capacity`` are the occupancy
+#: admission ran (``bucket_upshifts`` — the autoscaling up-shift dual,
+#: ``upshift=`` — counts warmed-ladder rung climbs the same way);
+#: ``lane_attempts``/``lane_capacity`` are the occupancy
 #: pair — useful LIVE-lane step attempts vs the device's attempt
 #: capacity (padded B x segments x segment_steps) — recorded by the
 #: pipelined driver whenever a recorder is armed, admission on OR off
@@ -88,7 +90,7 @@ FAULT_KEYS = ("fetch_timeouts", "chunk_retries", "chunks_corrupt",
 #: gear, or admission off for the queue counters) — ``obs.diff`` maps
 #: it to 0 (the FAULT_KEYS convention).
 ADMISSION_KEYS = ("compactions", "admitted_lanes", "bucket_downshifts",
-                  "lane_attempts", "lane_capacity")
+                  "bucket_upshifts", "lane_attempts", "lane_capacity")
 
 #: step_audit payloads folded into stats (not counters; excluded from sums)
 AUDIT_KEYS = ("accept_ring", "it_matrix")
@@ -109,7 +111,10 @@ LIVE_KEYS = ("metrics_scrapes", "live_publishes", "fleet_snapshots",
 #: counters incremented by the daemon's scheduler (request admission /
 #: rejection / resolution, epoch turnover, injected stalls), the
 #: streaming driver's live feed (``fed_lanes`` — lanes appended to a
-#: resident backlog mid-stream), and the session warmup wall.
+#: resident backlog mid-stream), the multi-epoch spray
+#: (``epoch_spray`` — lanes a secondary resident epoch pulled from the
+#: shared pack-key queue; structurally zero at ``resident_epochs=1``),
+#: and the session warmup wall.
 #: Request latency is NOT here: the old ``serve_latency_s`` additive
 #: counter summed seconds across requests into a meaningless total —
 #: it migrated to the ``serve_stage_seconds`` HISTOGRAM family
@@ -119,7 +124,7 @@ LIVE_KEYS = ("metrics_scrapes", "live_publishes", "fleet_snapshots",
 SERVE_KEYS = ("serve_requests", "serve_lanes", "serve_answered",
               "serve_failed", "serve_rejects_overload",
               "serve_rejects_draining", "serve_stalls", "serve_epochs",
-              "serve_warmup_s", "fed_lanes")
+              "serve_warmup_s", "fed_lanes", "epoch_spray")
 #: AOT program-store counters (aot/registry.py — docs/performance.md
 #: "Mechanism-shape economy"): Recorder counters incremented by the
 #: registry's LRU capacity policy (``enforce_capacity`` — entries

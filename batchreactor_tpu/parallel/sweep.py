@@ -181,23 +181,26 @@ def _host_fetch(x, recorder=None, deadline=None):
     return jax.device_get(x)
 
 
-def _retire_live(live, recorder, final_counters):
+def _retire_live(live, recorder, final_counters, source="sweep"):
     """Clear-on-return for the drivers' live overlay: fold the final
     counter totals onto the recorder and drop the in-flight overlay
     ATOMICALLY (``LiveRegistry.retire``) — the old fold-then-clear
     sequence let a concurrent scrape observe both and double-count the
     sweep.  When the registry fronts a different recorder than the
     driver's (no in-tree wiring does), the totals go to the driver's
-    recorder and only the clear loses atomicity."""
+    recorder and only the clear loses atomicity.  ``source`` is the
+    overlay name the driver published under — per-epoch streaming
+    drivers publish disjoint sources (``_live_source``) so concurrent
+    epochs never clobber each other's overlay."""
     if live is not None and (final_counters is None
                              or live.recorder is recorder):
-        live.retire("sweep", final_counters)
+        live.retire(source, final_counters)
         return
     if final_counters and recorder is not None:
         for k, v in final_counters.items():
             recorder.counter(k, v)
     if live is not None:
-        live.clear("sweep")
+        live.clear(source)
 
 
 def make_mesh(devices=None, axis="batch"):
@@ -205,6 +208,33 @@ def make_mesh(devices=None, axis="batch"):
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (axis,))
+
+
+def _resolve_mesh_resident(mesh_resident):
+    """THE validation/resolution rule for the streaming driver's
+    ``mesh_resident=`` knob: ``None``/``False`` — sharding off (returns
+    ``None``); ``True`` — a 1-D batch mesh over ALL local devices; an
+    int ``n >= 1`` — over the first ``n`` local devices.  Anything else
+    (or asking for more devices than the process has) is a loud
+    ``ValueError`` — silently clamping would run a program shape the
+    warmed cache never baked."""
+    if mesh_resident is None or mesh_resident is False:
+        return None
+    if mesh_resident is True:
+        return make_mesh(jax.local_devices())
+    if isinstance(mesh_resident, bool) or not isinstance(
+            mesh_resident, (int, np.integer)):
+        raise ValueError(
+            f"mesh_resident must be None/False (off), True (all local "
+            f"devices), or a positive int device count; got "
+            f"{mesh_resident!r}")
+    n = int(mesh_resident)
+    devs = jax.local_devices()
+    if n < 1 or n > len(devs):
+        raise ValueError(
+            f"mesh_resident={n} outside the 1..{len(devs)} local "
+            f"device range")
+    return make_mesh(devs[:n])
 
 
 def pad_batch(batch_size, mesh):
@@ -480,8 +510,10 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                              stats=False, recorder=None, watch=None,
                              pipeline=None, poll_every=None, buckets=None,
                              fetch_deadline=None, admission=None,
-                             refill=None, timeline=None, live=None,
-                             _on_harvest=None, _feed=None):
+                             refill=None, mesh_resident=None, upshift=None,
+                             upshift_patience=2, timeline=None, live=None,
+                             _on_harvest=None, _feed=None,
+                             _live_source="sweep"):
     """ensemble_solve with the device program bounded to ``segment_steps``
     step attempts per launch; the host loops segments until every lane
     terminates.
@@ -619,6 +651,40 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     ``bucket_downshifts``, and the occupancy pair ``lane_attempts`` /
     ``lane_capacity`` (docs/observability.md).
 
+    ``mesh_resident`` (streaming driver only — docs/performance.md
+    "Capacity levers") lays the resident carry out with a
+    ``NamedSharding`` over the batch dim so ONE streaming epoch spans
+    multiple local devices: ``True`` meshes all local devices, an int
+    ``n`` the first ``n``.  The resident bucket must divide evenly over
+    the mesh (:func:`aot.buckets.resolve_bucket` ``mesh_size=`` — a
+    pow2 ladder on a pow2 mesh always does; anything else is a loud
+    error), and the sharding is applied OUTSIDE the armed regions
+    (eager ``device_put``), so the traced segment/compaction programs
+    stay collective-free batch-dim-sharded programs.
+    ``mesh_resident=None`` (the default) leaves every traced program
+    byte-identical to the unsharded driver (brlint tier-C
+    ``mesh-resident-noop-fork``).  Distinct from ``mesh=`` (the static
+    sweep sharding): combining ``mesh=`` with admission stays the loud
+    error it always was.
+
+    ``upshift``/``upshift_patience`` (streaming driver only, needs a
+    ``buckets`` ladder) arm the autoscaling UP-shift — the dual of the
+    drain-tail down-shift: when the live backlog has exceeded the next
+    rung's headroom for ``upshift_patience`` consecutive polls, the
+    carry migrates onto the next warmed bucket up
+    (:func:`aot.buckets.upshift_bucket`; ``upshift`` is the resident-
+    lane ceiling the ladder may climb to).  The migration is an eager
+    concat-grow off the armed regions — new tail slots are dead copies
+    parked at ``t1`` that the very next compaction admits real backlog
+    lanes into — so on a warmed ladder an up-shift costs ZERO compiles
+    (CompileWatch ``program_key`` marks the new rung's first launch
+    expected, exactly like the down-shift).  With the up-shift armed,
+    the down-shift also runs under an OPEN feed (same patience window,
+    plus a post-shift cooldown, so up/down never thrash on an
+    oscillating backlog); ``upshift=None`` (the default) keeps the
+    drain-tail-only behaviour bit-identical to before.  Counter:
+    ``bucket_upshifts``.
+
     ``_on_harvest``/``_feed`` (streaming driver only; the serving
     scheduler's hooks — ``serving/scheduler.py``, and the
     ``checkpointed_sweep`` backlog mode for ``_on_harvest``):
@@ -654,7 +720,13 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
     occupancy counter pair plus segment/lanes-done gauges (the
     streaming driver adds backlog depth, harvested/admitted lanes, and
     the resident bucket).  Purely host-side; cleared on return after
-    the final totals land on the recorder.
+    the final totals land on the recorder.  ``_live_source`` (streaming
+    driver only) renames the overlay source the driver publishes under
+    (default ``"sweep"``): the multi-epoch scheduler gives each
+    resident epoch a disjoint source (``sweep-e0``, ``sweep-e1``, ...)
+    so concurrent epochs' counters SUM in the registry instead of
+    clobbering one overlay, and the per-epoch gauges render with the
+    epoch tag suffixed (``br_sweep_lanes_running_e0``, ...).
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
@@ -707,6 +779,22 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         if setup_economy and method != "bdf":
             raise ValueError(
                 f"setup_economy is a bdf-only knob; method={method!r}")
+        res_mesh = _resolve_mesh_resident(mesh_resident)
+        if upshift is not None:
+            if buckets is None:
+                raise ValueError(
+                    "upshift= climbs the buckets= ladder (aot/buckets."
+                    "py); pass buckets= or drop the upshift knob")
+            if (isinstance(upshift, bool)
+                    or not isinstance(upshift, (int, np.integer))
+                    or int(upshift) < resident):
+                raise ValueError(
+                    f"upshift must be an int resident-lane ceiling >= "
+                    f"the admission resident count ({resident}); got "
+                    f"{upshift!r}")
+        if int(upshift_patience) < 1:
+            raise ValueError(
+                f"upshift_patience must be >= 1, got {upshift_patience}")
         own_watch = None
         if watch is None and recorder is not None:
             own_watch = CompileWatch(recorder=recorder,
@@ -730,7 +818,24 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
                 setup_economy=setup_economy, stale_tol=float(stale_tol),
                 stats=stats, recorder=recorder, watch=watch,
                 progress=progress, fetch_kw=fkw, timeline=timeline,
-                live=live, on_harvest=_on_harvest, feed=_feed)
+                live=live, on_harvest=_on_harvest, feed=_feed,
+                res_mesh=res_mesh,
+                upshift=None if upshift is None else int(upshift),
+                upshift_patience=int(upshift_patience),
+                live_source=str(_live_source))
+    if mesh_resident:
+        # loudness convention (pipeline/poll_every): the sharded
+        # resident carry only exists on the streaming admission driver —
+        # the static sweeps already have mesh= for batch-dim sharding
+        raise ValueError(
+            "mesh_resident= shards the streaming admission driver's "
+            "resident program; pass admission= (continuous batching) or "
+            "use mesh= for static sweeps")
+    if upshift is not None:
+        raise ValueError(
+            "upshift= autoscales the streaming admission driver's "
+            "resident bucket; pass admission= (continuous batching) or "
+            "drop the upshift knobs")
     if _feed is not None:
         # loudness convention (pipeline/poll_every): a live backlog only
         # exists on the streaming admission driver — silently ignoring
@@ -738,6 +843,10 @@ def ensemble_solve_segmented(rhs, y0s, t0, t1, cfgs, *, segment_steps=1024,
         raise ValueError(
             "_feed is a streaming-driver hook; pass admission= (continuous "
             "batching) or drop the feed")
+    if _live_source != "sweep":
+        raise ValueError(
+            "_live_source renames the streaming driver's live overlay; "
+            "pass admission= (continuous batching) or drop it")
     B_live = y0s.shape[0]
     bucket = resolve_bucket(
         B_live, buckets,
@@ -1601,6 +1710,20 @@ def _compact_admit(carry, cfgs, order, new_y0, new_cfgs, fresh, n_live,
 _COMPACT_ADMIT = jax.jit(_compact_admit, donate_argnums=(0, 1))
 
 
+def _grow_tail(tree, grow):
+    """Concat-grow every leading-B leaf by ``grow`` copies of its LAST
+    row — the up-shift migration's eager resize (the symmetric twin of
+    the down-shift's ``x[:B2]`` slice, and the same dead-copy-lane
+    discipline as :func:`_pad_lanes`: a copied row holds real values,
+    so the grown program's heuristic first step never sees NaNs).  Runs
+    EAGERLY outside the armed compile regions, exactly like the
+    down-shift slice — the bucket migration is host-orchestrated
+    plumbing, never part of a single-program contract."""
+    return jax.tree.map(
+        lambda x: jnp.concatenate([x, jnp.repeat(x[-1:], grow, axis=0)]),
+        tree)
+
+
 def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
                              resident, refill_spec, buckets, segment_steps,
                              max_segments, max_attempts, poll_every, rtol,
@@ -1609,7 +1732,8 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
                              newton_tol, method, setup_economy, stale_tol,
                              stats, recorder, watch, progress, fetch_kw,
                              timeline=None, live=None, on_harvest=None,
-                             feed=None):
+                             feed=None, res_mesh=None, upshift=None,
+                             upshift_patience=2, live_source="sweep"):
     """Continuous batching: one resident B-lane segment program streams
     through an N-lane backlog (``ensemble_solve_segmented`` docstring,
     ``admission=``).  The loop structure is the pipelined driver's —
@@ -1655,9 +1779,29 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
     # same hazard class as the pipelined driver's explicit carry[0] copy.
     y0_np = np.asarray(y0s).copy()
     cfg_np = jax.tree.map(lambda v: np.asarray(v).copy(), cfgs)
+    # mesh-sharded resident carry (mesh_resident= — docstring above):
+    # every leading-B leaf is laid out P("batch") over the 1-D local
+    # mesh by EAGER device_put, outside the armed regions, so the
+    # traced segment/compaction programs stay collective-free and
+    # byte-identical with the sharding off (tier-C noop-fork contract)
+    ndev = 1 if res_mesh is None else int(res_mesh.devices.size)
+    shard_spec = (None if res_mesh is None
+                  else NamedSharding(res_mesh, P("batch")))
+
+    def _shard(tree):
+        if shard_spec is None:
+            return tree
+        return jax.tree.map(lambda x: jax.device_put(x, shard_spec),
+                            tree)
+
     n0 = min(int(resident), N)
-    B = resolve_bucket(n0, buckets)
+    B = resolve_bucket(n0, buckets, mesh_size=ndev)
     refill_n = _refill_slots(refill_spec, B)
+    # the up-shift ceiling rung (upshift= — docstring above): the
+    # largest bucket the autoscaler may climb to; None = up-shift off
+    upshift_cap = (None if upshift is None
+                   else resolve_bucket(max(int(upshift), 1), buckets,
+                                       mesh_size=ndev))
     economy = bool(setup_economy) and jac_window > 1 and method == "bdf"
     linsolve = resolve_linsolve(linsolve, method=method,
                                 platform=jax.default_backend(),
@@ -1686,17 +1830,19 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
     slot_gid = np.concatenate([np.arange(n_seed, dtype=np.int64),
                                np.full((B - n_seed,), -1, dtype=np.int64)])
     next_gid = n_seed
-    carry = _init_segment_carry(y0_blk, t0, method, observer,
-                                observer_init, stats, 0, economy=economy,
-                                linsolve=linsolve, timeline=timeline)
-    cfgs_res = cfg_blk
+    carry = _shard(_init_segment_carry(y0_blk, t0, method, observer,
+                                       observer_init, stats, 0,
+                                       economy=economy, linsolve=linsolve,
+                                       timeline=timeline))
+    cfgs_res = _shard(cfg_blk)
     # cold per-slot template for admissions (the y slot is replaced by
     # the admitted rows inside the traced program); NOT donated — reused
     # by every compaction
-    fresh = _init_segment_carry(jnp.zeros((B,) + tail, dtype=dtype), t0,
-                                method, observer, observer_init, stats, 0,
-                                economy=economy, linsolve=linsolve,
-                                timeline=timeline)
+    fresh = _shard(_init_segment_carry(jnp.zeros((B,) + tail, dtype=dtype),
+                                       t0, method, observer, observer_init,
+                                       stats, 0, economy=economy,
+                                       linsolve=linsolve,
+                                       timeline=timeline))
 
     # N-lane output accumulators, caller order (the un-shuffle target)
     out_t = np.full((N,), np.nan)
@@ -1725,8 +1871,17 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
     admitted_total = 0
     compactions = 0
     downshifts = 0
+    upshifts = 0
     capacity_lane_segs = 0
     launched = 0
+    # autoscaling hysteresis (upshift= — docstring above): a shift in
+    # EITHER direction needs `upshift_patience` consecutive qualifying
+    # polls, and a post-shift cooldown of the same length blocks the
+    # next shift — an oscillating backlog straddling a rung boundary
+    # therefore settles instead of thrashing the carry between rungs
+    up_streak = 0
+    down_streak = 0
+    shift_cooldown = 0
 
     def _harvest(status_np, force=False):
         """Fetch finished slots' payload, scatter to caller lane order,
@@ -1831,18 +1986,19 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
     def _downshift(status_np):
         """Backlog empty: if the live lanes fit a smaller bucket of the
         ladder, compact live-first and slice the carry onto the smaller
-        warmed program (aot.buckets.downshift_bucket)."""
+        warmed program (aot.buckets.downshift_bucket).  Returns True if
+        a shift happened (the autoscaler's hysteresis needs to know)."""
         nonlocal B, carry, cfgs_res, fresh, slot_gid, refill_n, downshifts
         from ..aot.buckets import downshift_bucket
 
         n_live = int((status_np == RUN).sum())
-        B2 = downshift_bucket(n_live, buckets, B)
+        B2 = downshift_bucket(n_live, buckets, B, mesh_size=ndev)
         if B2 is None:
-            return
+            return False
         _compact(status_np, 0)
-        carry = jax.tree.map(lambda x: x[:B2], carry)
-        cfgs_res = jax.tree.map(lambda x: x[:B2], cfgs_res)
-        fresh = jax.tree.map(lambda x: x[:B2], fresh)
+        carry = _shard(jax.tree.map(lambda x: x[:B2], carry))
+        cfgs_res = _shard(jax.tree.map(lambda x: x[:B2], cfgs_res))
+        fresh = _shard(jax.tree.map(lambda x: x[:B2], fresh))
         slot_gid = slot_gid[:B2]
         B = B2
         refill_n = _refill_slots(refill_spec, B)
@@ -1850,6 +2006,73 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
         if recorder is not None:
             recorder.counter("bucket_downshifts")
             recorder.event("bucket_downshift", bucket=B, live=n_live)
+        return True
+
+    def _upshift(status_np):
+        """Backlog over the next rung's headroom for long enough: grow
+        the carry onto the next warmed bucket UP and admit backlog lanes
+        into the new slots at once (aot.buckets.upshift_bucket — the
+        autoscaling dual of :func:`_downshift`).  The grown tail rows
+        are dead copies of the last slot, parked at ``t1`` with a
+        non-RUNNING status and gid -1, so the extended status vector
+        handed to :func:`_compact` reads them as free slots and the
+        admission program overwrites them from ``fresh`` — between the
+        grow and the compact no segment ever launches, so the copies
+        are never stepped.  Eager and unarmed, like the down-shift
+        slice; on a warmed ladder the new rung's programs are cache
+        loads (zero compiles — acceptance-asserted under CompileWatch).
+        Returns True if a shift happened."""
+        nonlocal B, carry, cfgs_res, fresh, slot_gid, refill_n, upshifts
+        from ..aot.buckets import upshift_bucket
+
+        n_live = int((status_np == RUN).sum())
+        backlog = int(N - next_gid)
+        B2 = upshift_bucket(n_live + backlog, buckets, B,
+                            cap=upshift_cap, mesh_size=ndev)
+        if B2 is None:
+            return False
+        grow = B2 - B
+        carry = _grow_tail(carry, grow)
+        y_g, t_g, h_g, e_g, obs_g, sstate_g, ctrl_g = carry
+        # park the grown tail: t forced to t1 (a relaunch before the
+        # admit would run them as zero-span no-ops) and a terminal
+        # status so the compaction's permutation treats them as freed
+        ctrl_g = dict(ctrl_g)
+        ctrl_g["final_status"] = ctrl_g["final_status"].at[B:].set(
+            jnp.int32(int(sdirk.MAX_STEPS_REACHED)))
+        carry = (y_g, t_g.at[B:].set(t1), h_g, e_g, obs_g, sstate_g,
+                 ctrl_g)
+        carry = _shard(carry)
+        cfgs_res = _shard(_grow_tail(cfgs_res, grow))
+        fresh = _shard(_init_segment_carry(
+            jnp.zeros((B2,) + tail, dtype=dtype), t0, method, observer,
+            observer_init, stats, 0, economy=economy, linsolve=linsolve,
+            timeline=timeline))
+        slot_gid = np.concatenate(
+            [slot_gid, np.full((grow,), -1, dtype=np.int64)])
+        status_ext = np.concatenate(
+            [status_np,
+             np.full((grow,), int(sdirk.MAX_STEPS_REACHED),
+                     dtype=status_np.dtype)])
+        B = B2
+        refill_n = _refill_slots(refill_spec, B)
+        upshifts += 1
+        if recorder is not None:
+            recorder.counter("bucket_upshifts")
+            recorder.event("bucket_upshift", bucket=B, live=n_live,
+                           backlog=backlog)
+        _compact(status_ext, min(B - n_live, backlog))
+        return True
+
+    def _up_rung(n_live, backlog):
+        """The rung an up-shift would land on for the current demand
+        (live + backlog lanes), or None — the trigger's qualification
+        check, sharing :func:`aot.buckets.upshift_bucket` with the
+        migration itself so the two can never disagree."""
+        from ..aot.buckets import upshift_bucket
+
+        return upshift_bucket(n_live + backlog, buckets, B,
+                              cap=upshift_cap, mesh_size=ndev)
 
     def _feed_more(n_space, idle):
         """Ask the live feed for up to ``n_space`` more backlog lanes
@@ -1914,6 +2137,14 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
                                         + acc_np[live_rows].sum()),
                   "admitted_total": n_seed + admitted_total})
 
+    # multi-epoch gauge naming (live_source= — docstring above): each
+    # epoch's gauges carry its tag as a suffix (lanes_running_e0, ...)
+    # because LiveRegistry gauges merge ACROSS sources by name — two
+    # epochs publishing "lanes_running" would clobber each other at
+    # every scrape; counters sum across sources and keep plain names
+    gauge_tag = ("" if live_source == "sweep"
+                 else "_" + live_source.rpartition("-")[2])
+
     def _publish_live(seg, status_np, acc_np, rej_np):
         """In-flight publish at the poll boundary (obs/live.py): the
         streaming queue's own state — backlog depth, harvested/admitted
@@ -1926,19 +2157,21 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
         lanes_done = harvested + int(((status_np != RUN)
                                       & live_rows).sum())
         live.publish(
-            "sweep",
+            live_source,
             counters={"lane_attempts": int(out_acc.sum() + out_rej.sum()
                                            + acc_np[live_rows].sum()
                                            + rej_np[live_rows].sum()),
                       "lane_capacity": (int(capacity_lane_segs)
                                         * int(segment_steps))},
-            gauges={"segment": int(seg), "lanes_done": lanes_done,
-                    "lanes_total": int(N),
-                    "lanes_running": int(N) - lanes_done,
-                    "backlog_depth": int(N - next_gid),
-                    "harvested_lanes": int(harvested),
-                    "admitted_lanes": int(n_seed + admitted_total),
-                    "resident_bucket": int(B)})
+            gauges={f"segment{gauge_tag}": int(seg),
+                    f"lanes_done{gauge_tag}": lanes_done,
+                    f"lanes_total{gauge_tag}": int(N),
+                    f"lanes_running{gauge_tag}": int(N) - lanes_done,
+                    f"backlog_depth{gauge_tag}": int(N - next_gid),
+                    f"harvested_lanes{gauge_tag}": int(harvested),
+                    f"admitted_lanes{gauge_tag}": int(n_seed
+                                                      + admitted_total),
+                    f"resident_bucket{gauge_tag}": int(B)})
 
     done = False
     for seg in range(max_segments):
@@ -1976,16 +2209,46 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
         _progress(seg, status_np, acc_np)
         running = status_np == RUN
         n_parked = int(B - running.sum())
+        if shift_cooldown:
+            shift_cooldown -= 1
         if feed is not None and next_gid >= N and n_parked:
             # live backlog (serving/scheduler.py): the static backlog is
             # exhausted but the stream may refill it — harvest finished
             # lanes NOW (their callbacks fire at this poll boundary, not
             # at stream end), then ask the feed for more, blocking only
-            # when nothing is left running
+            # when nothing is left running.  With the up-shift armed the
+            # ask overshoots the free slots by the remaining climb
+            # headroom (feed contract: k <= n_space), so the backlog CAN
+            # exceed the current bucket and qualify the next rung —
+            # capped asks would pin the autoscaler at its seed bucket
             _harvest(status_np)
-            if _feed_more(n_parked, idle=not running.any()) is None:
+            ask = n_parked
+            if upshift_cap is not None and B < upshift_cap:
+                ask += upshift_cap - B
+            if _feed_more(ask, idle=not running.any()) is None:
                 feed = None
+        if upshift_cap is not None:
+            # up-shift qualification: the backlog alone must fill the
+            # next rung's extra slots (the shift pays for itself), for
+            # `upshift_patience` consecutive polls, outside a cooldown
+            backlog_d = int(N - next_gid)
+            B_up = (_up_rung(int(running.sum()), backlog_d)
+                    if backlog_d else None)
+            if B_up is not None and backlog_d >= (B_up - B):
+                up_streak += 1
+            else:
+                up_streak = 0
+            if up_streak >= int(upshift_patience) and not shift_cooldown:
+                _harvest(status_np)
+                if _upshift(status_np):
+                    up_streak = 0
+                    down_streak = 0
+                    shift_cooldown = int(upshift_patience)
+                    # the up-shift already compacted + admitted into the
+                    # grown slots; relaunch on the new bucket
+                    continue
         if next_gid < N:
+            down_streak = 0
             if n_parked >= refill_n or not running.any():
                 _harvest(status_np)
                 _compact(status_np, min(n_parked, N - next_gid))
@@ -1994,13 +2257,27 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
             done = True
             break
         elif buckets is not None and n_parked and feed is None:
-            # drain-tail down-shift only once the backlog can never
-            # refill: there is no up-shift path, so shrinking the
-            # resident program under an OPEN feed would serialize every
-            # later-fed lane through the shrunken bucket for the rest
-            # of the stream
+            # drain-tail down-shift once the backlog can never refill:
+            # without the up-shift gear there is no path back up, so
+            # shrinking the resident program under an OPEN feed would
+            # serialize every later-fed lane through the shrunken
+            # bucket for the rest of the stream
             _harvest(status_np)
             _downshift(status_np)
+        elif upshift_cap is not None and n_parked:
+            # the autoscaling dual (upshift= armed): the ladder works
+            # BOTH ways under an open feed — an emptied backlog may
+            # shrink the resident program, because a later burst climbs
+            # back up the warmed ladder; same patience + cooldown
+            # hysteresis as the up-shift, so an oscillating backlog
+            # never thrashes the carry between rungs
+            down_streak += 1
+            if (down_streak >= int(upshift_patience)
+                    and not shift_cooldown):
+                _harvest(status_np)
+                if _downshift(status_np):
+                    shift_cooldown = int(upshift_patience)
+                down_streak = 0
     if not done:
         # max_segments exhausted: park still-running lanes as MaxSteps at
         # their current t (blocking-driver for-else semantics), harvest
@@ -2038,7 +2315,7 @@ def _run_segmented_streaming(rhs, y0s, t0, t1, cfgs, bundle_arg, *,
             "lane_attempts": int(out_acc.sum() + out_rej.sum()),
             "lane_capacity": (int(capacity_lane_segs)
                               * int(segment_steps))}
-    _retire_live(live, recorder, final_counters)
+    _retire_live(live, recorder, final_counters, source=live_source)
     return sdirk.SolveResult(
         t=jnp.asarray(out_t, dtype=dtype), y=jnp.asarray(out_y),
         status=jnp.asarray(out_status),
@@ -2365,6 +2642,75 @@ def _contract_admission(h):
         "the continuous-batching plumbing leaked into the shared "
         "segment program (parallel/sweep.py admission-off "
         "byte-identity contract)")
+
+
+@program_contract(
+    "sweep-upshift",
+    doc="up-shift migration pure; segment program byte-identical after "
+        "the autoscaler ran")
+def _contract_upshift(h):
+    # (1) the grow-tail migration helper — the only program the
+    # up-shift adds — is pure concats/gathers over the carry; (2) the
+    # segment program re-traced AFTER a real autoscaled streaming sweep
+    # (overfed backlog on a pow2 ladder, so the up-shift actually
+    # fires, then the drain tail down-shifts back) stays byte-identical
+    # to the pre-autoscaler baseline: the hysteresis counters and rung
+    # migration are host-side BY CONTRACT.
+    y0b, cfgb, mk_seg_fn, run_seg = _contract_seg_tools(h)
+    j_base = _segment_baseline_str(h)
+    carry_g = _init_segment_carry(y0b, 0.0, "bdf", None, None, False, 0)
+    yield Pure("sweep-upshift-grow",
+               h.jaxpr(lambda c: _grow_tail(c, 2), carry_g))
+    k8 = jnp.asarray([10.0, 20.0, 40.0, 80.0, 10.0, 20.0, 40.0, 80.0])
+    up_res = ensemble_solve_segmented(
+        lambda t, y, cfg: -cfg["k"] * y,
+        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (8, 2)), 0.0, 1.0,
+        {"k": k8}, segment_steps=8, max_segments=160, pipeline=True,
+        admission=2, refill=1, poll_every=1, method="bdf",
+        buckets="pow2", upshift=8, upshift_patience=1)
+    assert int(up_res.status.sum()) == 8  # 8 lanes, all SUCCESS(=1)
+    carry = _init_segment_carry(y0b, 0.0, "bdf", None, None, False, 8)
+    jaxpr_post = h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry)
+    yield CostProbe("segment-upshift-post", jaxpr_post)
+    yield Identical(
+        "upshift-noop-fork", "segment-upshift-noop",
+        j_base, str(jaxpr_post),
+        "the segment program traced after building and running the "
+        "bucket autoscaler differs from the upshift-less trace: the "
+        "rung-migration plumbing leaked into the shared segment "
+        "program (parallel/sweep.py upshift-off byte-identity "
+        "contract)")
+
+
+@program_contract(
+    "sweep-mesh-resident",
+    doc="segment program byte-identical after a mesh-sharded resident "
+        "stream ran")
+def _contract_mesh_resident(h):
+    # mesh_resident= is eager device_put layout only: a streaming sweep
+    # run WITH the sharded resident carry (a 1-device mesh — the only
+    # size a CPU test host guarantees; the layout path is identical)
+    # must leave the segment program byte-identical to the unsharded
+    # baseline — the sharding must never reach a traced program.
+    y0b, cfgb, mk_seg_fn, run_seg = _contract_seg_tools(h)
+    j_base = _segment_baseline_str(h)
+    mesh_res = ensemble_solve_segmented(
+        lambda t, y, cfg: -cfg["k"] * y,
+        jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (4, 2)), 0.0, 1.0,
+        {"k": jnp.asarray([10.0, 20.0, 40.0, 80.0])}, segment_steps=8,
+        max_segments=80, pipeline=True, admission=2, refill=1,
+        poll_every=1, method="bdf", buckets="pow2", mesh_resident=1)
+    assert int(mesh_res.status.sum()) == 4  # 4 lanes, all SUCCESS(=1)
+    carry = _init_segment_carry(y0b, 0.0, "bdf", None, None, False, 8)
+    jaxpr_post = h.jaxpr(run_seg(mk_seg_fn(False), cfgb), carry)
+    yield CostProbe("segment-mesh-resident-post", jaxpr_post)
+    yield Identical(
+        "mesh-resident-noop-fork", "segment-mesh-resident-noop",
+        j_base, str(jaxpr_post),
+        "the segment program traced after running a mesh_resident= "
+        "stream differs from the unsharded trace: the resident-carry "
+        "sharding leaked into the traced program (parallel/sweep.py "
+        "mesh_resident-off byte-identity contract)")
 
 
 @program_contract(

@@ -23,6 +23,20 @@ resident program is released; the next request re-enters through the
 warmed AOT cache at zero compiles), when a different pack key has work
 waiting (fairness rotation), or at drain.
 
+**Multi-epoch capacity** (``SessionSpec.resident_epochs`` — docs/
+serving.md "Capacity levers"): with ``resident_epochs=N`` the scheduler
+runs N worker threads, each hosting its own resident streaming epoch,
+all pulling from the ONE shared pack-key queue.  The spray is
+pull-based: each epoch's seed/feed pops up to its own free-slot depth
+under the scheduler lock, so pops are disjoint and exactly-once
+resolution needs no new machinery — a request belongs to exactly the
+epoch that popped it, and its harvest un-shuffle stays epoch-local.
+Lanes a secondary epoch pulls count ``epoch_spray``; each epoch
+publishes its driver gauges under its own live source (``sweep-e0``,
+``sweep-e1``, ...) so per-epoch occupancy survives the registry merge.
+``resident_epochs=1`` is byte-identical to the single-worker scheduler
+(same thread name, same stream call signature, zero spray).
+
 **Backpressure is explicit**: ``submit`` REJECTS with
 :class:`Overloaded` once ``max_queue_lanes`` lanes are queued
 (un-admitted) — never silent unbounded queueing — and with
@@ -171,8 +185,25 @@ class Scheduler:
         self._draining = False
         self._closed = False
         self._seq = 0
-        self._worker = threading.Thread(target=self._run, daemon=True,
+        # capacity plane (module doc): N resident epochs, one worker
+        # thread each.  The session resolves "auto" (one per local
+        # device) to an int before the scheduler sees it; a stub
+        # session without the knob runs single-epoch
+        epochs = getattr(session, "resident_epochs", None)
+        if epochs is None:
+            epochs = getattr(spec, "resident_epochs", 1)
+        try:
+            epochs = int(epochs)
+        except (TypeError, ValueError):
+            epochs = 1
+        self.epochs = max(epochs, 1)
+        self._worker = threading.Thread(target=self._run, args=(0,),
+                                        daemon=True,
                                         name="br-serve-scheduler")
+        self._workers = [self._worker] + [
+            threading.Thread(target=self._run, args=(k,), daemon=True,
+                             name=f"br-serve-scheduler-{k}")
+            for k in range(1, self.epochs)]
         self._started = False
 
     # ---- producer side ----------------------------------------------------
@@ -185,7 +216,8 @@ class Scheduler:
         with self._cond:
             if not self._started:
                 self._started = True
-                self._worker.start()
+                for w in self._workers:
+                    w.start()
         return self
 
     def submit(self, request):
@@ -238,8 +270,12 @@ class Scheduler:
                 w.future.set_exception(Draining(
                     "scheduler closed before it ever started"))
             return True
-        self._worker.join(timeout)
-        done = not self._worker.is_alive()
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        for w in self._workers:
+            w.join(None if deadline is None
+                   else max(deadline - time.monotonic(), 0.0))
+        done = not any(w.is_alive() for w in self._workers)
         with self._cond:
             self._closed = True
         return done
@@ -260,7 +296,8 @@ class Scheduler:
             "serve_inflight_lanes": int(self._inflight_lanes),
             "serve_pending_requests": int(
                 sum(len(q) for q in self._queues.values())),
-            "serve_draining": int(self._draining)})
+            "serve_draining": int(self._draining),
+            "resident_epochs": int(self.epochs)})
 
     # ---- worker side ------------------------------------------------------
     def _next_key_locked(self):
@@ -272,7 +309,7 @@ class Scheduler:
                 best = (key, q[0].seq)
         return best[0] if best else None
 
-    def _run(self):
+    def _run(self, epoch=0):
         while True:
             with self._cond:
                 key = self._next_key_locked()
@@ -282,15 +319,17 @@ class Scheduler:
                 if key is None:       # draining and empty: done
                     self._publish_locked()
                     break
-            self._run_epoch(key)
+            self._run_epoch(key, epoch)
         with self._cond:
             self._publish_locked()
 
-    def _pop_work_locked(self, key, n_space):
+    def _pop_work_locked(self, key, n_space, epoch=0):
         """Pop whole queued requests of ``key`` up to ~``n_space`` lanes
         (always at least one when any is queued) — the rest stays
         QUEUED, which is what keeps the ``max_queue_lanes`` bound
-        meaningful while a stream is resident."""
+        meaningful while a stream is resident.  Pops are the spray:
+        each epoch pulls up to its own free-slot depth under THIS lock,
+        so concurrent epochs never double-pop a request."""
         q = self._queues.get(key)
         works, lanes = [], 0
         while q and (not works or lanes + q[0].request.n_lanes
@@ -304,11 +343,16 @@ class Scheduler:
         self._queued_lanes -= lanes
         self._inflight_lanes += lanes
         if works:
+            if epoch:
+                rec = getattr(self.session, "recorder", None)
+                if rec is not None:
+                    rec.counter("epoch_spray", lanes)
             self._publish_locked()
         return works
 
-    def _run_epoch(self, key):
-        """One resident stream over one pack key (module doc)."""
+    def _run_epoch(self, key, epoch=0):
+        """One resident stream over one pack key (module doc);
+        ``epoch`` is this worker's slot in the multi-epoch spray."""
         from ..resilience import inject
 
         rec = getattr(self.session, "recorder", None)
@@ -368,6 +412,15 @@ class Scheduler:
                         # wakeup.  Mostly-free resident slots mean the
                         # batch was never coming: seed now, let
                         # latecomers ride the live feed
+                        free = (self.epochs * (cap or 1)
+                                - self._inflight_lanes)
+                        if _key_lanes() <= max(free, 0):
+                            # the resident tier can absorb everything
+                            # queued RIGHT NOW: waiting buys no batch
+                            # density, only queue-wait — collapse the
+                            # window to zero
+                            window = 0.0
+                            break
                         window = coalesce * (_key_lanes()
                                              / float(cap or 1))
                     left = start + window - time.monotonic()
@@ -389,9 +442,9 @@ class Scheduler:
                     reg.publish("coalesce", gauges={
                         "coalesce_window_s": round(window, 6)})
             seed = self._pop_work_locked(
-                key, cap if cap else self.max_queue_lanes)
-            if not seed:    # drained away while coalescing
-                return
+                key, cap if cap else self.max_queue_lanes, epoch)
+            if not seed:    # drained away (or sprayed onto a sibling
+                return      # epoch) while coalescing
         _admit(seed)
         y0s, cfgs = _stack(seed)
 
@@ -399,7 +452,7 @@ class Scheduler:
             with self._cond:
                 deadline = time.monotonic() + self.idle_timeout
                 while True:
-                    works = self._pop_work_locked(key, n_space)
+                    works = self._pop_work_locked(key, n_space, epoch)
                     if works:
                         break
                     other = any(k != key and q
@@ -459,8 +512,12 @@ class Scheduler:
 
         try:
             # energy rides only when set, so fake sessions (and any
-            # pre-energy stream signature) keep working
+            # pre-energy stream signature) keep working; the per-epoch
+            # live source likewise rides only at resident_epochs > 1 —
+            # single-epoch keeps today's stream call byte-identical
             ekw = {} if energy is None else {"energy": energy}
+            if self.epochs > 1:
+                ekw["live_source"] = f"sweep-e{epoch}"
             self.session.stream(y0s, cfgs, t1=t1, rtol=rtol, atol=atol,
                                 on_harvest=on_harvest, feed=feed, **ekw)
         except BaseException as e:  # noqa: BLE001 — an epoch must not

@@ -57,7 +57,8 @@ _SERVE_KEYS = ("resident", "refill", "buckets", "poll_every",
                "max_queue_lanes", "idle_timeout_s", "request_timeout_s",
                "max_lanes_per_request", "coalesce_s",
                "coalesce_adaptive", "max_mechanisms",
-               "slow_request_s")
+               "slow_request_s", "resident_epochs", "mesh_resident",
+               "upshift", "upshift_patience")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,6 +136,27 @@ class SessionSpec:
     #: counter snapshot.  0 (default) disables the alarm; the
     #: histograms and per-request traces record regardless.
     slow_request_s: float = 0.0
+    #: capacity plane (docs/serving.md "Capacity levers"): number of
+    #: resident streaming epochs the scheduler runs concurrently, each
+    #: a full ``resident``-slot program pulling from the one shared
+    #: pack-key queue.  ``"auto"`` = one per local device.  1 (default)
+    #: is byte-identical to the single-epoch scheduler.
+    resident_epochs: object = 1
+    #: mesh-sharded resident program: lay the streaming carry out with
+    #: a NamedSharding over the batch dim so one epoch spans this many
+    #: local devices (``True`` = all of them).  Buckets must divide
+    #: over the mesh; ``None`` (default) keeps the single-device
+    #: program byte-identical.
+    mesh_resident: object = None
+    #: resident-bucket up-shift autoscaling: the lane ceiling the
+    #: resident program may climb to (along the warmed ``buckets``
+    #: ladder) when backlog outgrows the current rung — the dual of the
+    #: drain-time down-shift.  ``None`` (default) never up-shifts.
+    upshift: object = None
+    #: consecutive over-headroom polls before an up-shift fires (and
+    #: the post-shift cooldown) — the hysteresis damping both shift
+    #: directions against an oscillating backlog.
+    upshift_patience: int = 2
 
 
 def load_spec(source):
@@ -208,6 +230,28 @@ def load_spec(source):
     if int(spec.max_queue_lanes) < 1:
         raise ValueError(f"session spec: max_queue_lanes must be >= 1, "
                          f"got {spec.max_queue_lanes!r}")
+    re_ = spec.resident_epochs
+    if re_ != "auto" and (isinstance(re_, bool)
+                          or not isinstance(re_, int) or re_ < 1):
+        raise ValueError(f"session spec: resident_epochs must be an "
+                         f"int >= 1 or 'auto', got {re_!r}")
+    mr = spec.mesh_resident
+    if mr is not None and mr is not True and mr is not False and (
+            isinstance(mr, bool) or not isinstance(mr, int) or mr < 1):
+        raise ValueError(f"session spec: mesh_resident must be null, "
+                         f"true (all local devices), or an int >= 1; "
+                         f"got {mr!r}")
+    up = spec.upshift
+    if up is not None and (isinstance(up, bool)
+                           or not isinstance(up, int)
+                           or up < int(spec.resident)):
+        raise ValueError(f"session spec: upshift must be an int >= "
+                         f"resident ({spec.resident}) — it is the lane "
+                         f"CEILING the resident program may climb to; "
+                         f"got {up!r}")
+    if int(spec.upshift_patience) < 1:
+        raise ValueError(f"session spec: upshift_patience must be >= 1, "
+                         f"got {spec.upshift_patience!r}")
     return spec
 
 
@@ -302,9 +346,21 @@ class SolverSession:
             self._mode_fns[m] = (rhs_m, jac_m, obs_m, obs0_m)
         self.jac_window = resolve_jac_window(spec.jac_window, spec.method)
         self.buckets = normalize_buckets(spec.buckets)
+        # capacity plane (docs/serving.md "Capacity levers"): resolve
+        # the spec's "auto"/bool forms against the local device set
+        # once, here — the scheduler and the stream read ints
+        mr = spec.mesh_resident
+        self.mesh_resident = (len(jax.local_devices()) if mr is True
+                              else int(mr) if mr else None)
+        self._mesh_size = self.mesh_resident or 1
+        self.resident_epochs = (
+            max(1, len(jax.local_devices()))
+            if spec.resident_epochs == "auto"
+            else max(1, int(spec.resident_epochs)))
         #: the largest resident program shape the session will run —
         #: admission packs into at most this many slots
-        self.bucket_cap = resolve_bucket(int(spec.resident), self.buckets)
+        self.bucket_cap = resolve_bucket(int(spec.resident), self.buckets,
+                                         mesh_size=self._mesh_size)
         self.recorder = recorder if recorder is not None else Recorder()
         self.registry = LiveRegistry(
             recorder=self.recorder,
@@ -401,21 +457,35 @@ class SolverSession:
     def warmup_specs(self, rtol=None, atol=None):
         """One ``aot.warmup`` spec per ladder rung per energy mode
         (isothermal + every ``spec.energy_modes`` entry) <= the
-        resident cap: each warms its rung's segment program AND
-        (``backlog=2`` + ``admission=rung``) the traced
-        compaction/admission step, so a cold daemon's first streamed
-        request — isothermal or adiabatic — compiles nothing."""
-        from ..aot import bucket_ladder
+        resident cap — or, with ``upshift`` set, <= the resolved
+        up-shift ceiling, so every rung the autoscaler can climb to is
+        warmed and a live up-shift migration compiles nothing: each
+        warms its rung's segment program AND (``backlog=2`` +
+        ``admission=rung``) the traced compaction/admission step, so a
+        cold daemon's first streamed request — isothermal or adiabatic
+        — compiles nothing.  Under ``mesh_resident`` the rung set is
+        the mesh-divisible ladder and each spec carries the mesh knob
+        (a distinct program family — its AOT keys grow the mesh axis);
+        unset, the spec dicts are byte-identical to the pre-mesh keys."""
+        from ..aot import resolve_bucket
 
         rtol = self.spec.rtol if rtol is None else rtol
         atol = self.spec.atol if atol is None else atol
+        top = self.bucket_cap
+        if self.spec.upshift is not None:
+            top = max(top, resolve_bucket(
+                int(self.spec.upshift), self.buckets,
+                mesh_size=self._mesh_size))
         if self.buckets is None:
-            rungs = (self.bucket_cap,)
+            rungs = (top,)
         else:
-            rungs = tuple(
-                b for b in bucket_ladder(
-                    range(1, self.bucket_cap + 1), self.buckets)
-                if b <= self.bucket_cap)
+            rungs = tuple(sorted({
+                resolve_bucket(b, self.buckets,
+                               mesh_size=self._mesh_size)
+                for b in range(1, top + 1)}))
+            rungs = tuple(b for b in rungs if b <= top)
+        mesh_kw = ({} if self.mesh_resident is None
+                   else {"mesh_resident": self.mesh_resident})
         specs = []
         for mode in (None,) + tuple(self.spec.energy_modes or ()):
             # exemplar lane: an equimolar mix over the first two
@@ -427,7 +497,7 @@ class SolverSession:
                 dict(rhs=rhs_m, y0=y0, cfg=cfg_row, lanes=[r],
                      buckets=self.buckets, backlog=2, admission=r,
                      refill=1, poll_every=int(self.spec.poll_every),
-                     **self._stream_flags(rtol, atol, mode))
+                     **mesh_kw, **self._stream_flags(rtol, atol, mode))
                 for r in rungs)
         return specs
 
@@ -572,13 +642,15 @@ class SolverSession:
 
     # ---- the resident stream ----------------------------------------------
     def stream(self, y0s, cfgs, *, t1, rtol, atol, energy=None,
-               on_harvest=None, feed=None):
+               on_harvest=None, feed=None, live_source="sweep"):
         """Run one resident streaming sweep epoch over the given
         backlog, with the scheduler's harvest/feed hooks attached
         (``parallel.ensemble_solve_segmented`` ``_on_harvest``/
         ``_feed`` contract).  ``energy`` (a pack key's static half)
-        selects the per-mode program family.  Blocks until the feed
-        closes and every admitted lane harvests."""
+        selects the per-mode program family; ``live_source`` names this
+        epoch's live-registry source (the multi-epoch scheduler passes
+        ``sweep-e{k}`` so per-epoch gauges survive the merge).  Blocks
+        until the feed closes and every admitted lane harvests."""
         import jax.numpy as jnp
 
         from ..parallel.sweep import ensemble_solve_segmented
@@ -592,9 +664,13 @@ class SolverSession:
             admission=int(s.resident),
             refill=s.refill, buckets=self.buckets,
             poll_every=int(s.poll_every),
+            mesh_resident=self.mesh_resident,
+            upshift=(None if s.upshift is None else int(s.upshift)),
+            upshift_patience=int(s.upshift_patience),
             recorder=self.recorder,
             watch=self._watch if self._watch_entered else None,
             live=self.registry, _on_harvest=on_harvest, _feed=feed,
+            _live_source=str(live_source),
             **self._stream_flags(rtol, atol, energy))
 
     # ---- results -> response payload --------------------------------------
@@ -673,6 +749,10 @@ class SolverSession:
         return {"fingerprint": self.fingerprint,
                 "species": len(self.species),
                 "bucket_cap": self.bucket_cap,
+                "resident_epochs": self.resident_epochs,
+                "mesh_resident": self.mesh_resident,
+                "upshift": (None if self.spec.upshift is None
+                            else int(self.spec.upshift)),
                 "mech_shape": self.mech_shape,
                 "mech_operands": self.mech_bundle is not None,
                 "energy_modes": list(self.spec.energy_modes or ()),

@@ -99,6 +99,13 @@ def main(argv=None):
     ap.add_argument("--fleet-dir",
                     help="fleet membership dir for --router (default: "
                          "a fresh temp dir)")
+    ap.add_argument("--epochs", type=int, metavar="N",
+                    help="override the spec's serve.resident_epochs "
+                         "(capacity plane, docs/performance.md "
+                         "\"Capacity levers\"): N resident streaming "
+                         "epochs pull from one shared admission queue; "
+                         "the A/B lever for the multi-epoch PERF "
+                         "rounds (needs --spec)")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--cache-dir",
                     default=os.environ.get("JAX_COMPILATION_CACHE_DIR"))
@@ -136,6 +143,21 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.url and not args.spec:
         ap.error("--spec (in-process daemon) or --url (external) needed")
+    if args.epochs is not None and not args.spec:
+        ap.error("--epochs overrides the spec's serve.resident_epochs; "
+                 "it needs --spec (an external daemon fixes its own)")
+    spec_arg = args.spec
+    if args.epochs is not None:
+        with open(args.spec) as fh:
+            spec_arg = json.load(fh)
+        # a dict spec loses the file's directory, so pre-resolve the
+        # relative mechanism paths the way load_spec(path) would
+        base = os.path.dirname(os.path.abspath(args.spec))
+        for k in ("mech", "therm"):
+            p = (spec_arg.get("mechanism") or {}).get(k)
+            if isinstance(p, str) and not os.path.isabs(p):
+                spec_arg["mechanism"][k] = os.path.join(base, p)
+        spec_arg.setdefault("serve", {})["resident_epochs"] = args.epochs
     if args.obs_out and args.url:
         ap.error("--obs-out reads the in-process session's recorder; "
                  "use --spec (an external daemon writes its own via "
@@ -230,7 +252,7 @@ def main(argv=None):
             prefix="br-fleet-bench-")
         for i in range(args.router):
             name = f"m{i + 1}"
-            s = SolverSession.from_spec(args.spec)
+            s = SolverSession.from_spec(spec_arg)
             if not args.no_warmup:
                 s.warmup(cache_dir=args.cache_dir,
                          log=lambda m: print(m, file=sys.stderr),
@@ -255,7 +277,7 @@ def main(argv=None):
         from batchreactor_tpu.serving.session import (SessionStore,
                                                       SolverSession)
 
-        session = SolverSession.from_spec(args.spec)
+        session = SolverSession.from_spec(spec_arg)
         if not args.no_warmup:
             session.warmup(cache_dir=args.cache_dir,
                            log=lambda m: print(m, file=sys.stderr))
@@ -463,6 +485,15 @@ def main(argv=None):
             print(f"[serve-bench] obs report -> {args.obs_out}",
                   file=sys.stderr)
         w = session.compile_summary()
+        # the capacity-plane levers this run served under + their
+        # autoscaler evidence (ISSUE 20): the A/B axes of the
+        # multi-epoch PERF rounds ride every summary
+        summary["resident_epochs"] = int(
+            getattr(session, "resident_epochs", 1))
+        summary["mesh_resident"] = getattr(session, "mesh_resident",
+                                           None)
+        summary["bucket_upshifts"] = int(
+            session.recorder.snapshot()[2].get("bucket_upshifts", 0))
         # program_compiles is the warm-serving contract (0 after
         # warmup); "compiles" totals additionally count sub-ms host
         # eager-op programs on the unarmed serve-host label

@@ -460,3 +460,215 @@ class TestLiveFeed:
             _feed=lambda n, idle: blocks.pop(0) if blocks else None)
         _s, _e, counters = rec.snapshot()
         assert counters["fed_lanes"] == 2
+
+
+# --------------------------------------------------------------------------
+# capacity levers (ISSUE 20): resident-bucket up-shift autoscaling +
+# the mesh-sharded resident program
+# --------------------------------------------------------------------------
+def test_upshift_bucket_ladder():
+    """aot.buckets.upshift_bucket: always the SINGLE next rung up, only
+    under real demand, capped at the knob's resolved ceiling — the dual
+    of downshift_bucket."""
+    from batchreactor_tpu.aot.buckets import upshift_bucket
+
+    assert upshift_bucket(10, "pow2", 4) == 8      # one rung, not 16
+    assert upshift_bucket(3, "pow2", 4) is None    # demand fits current
+    assert upshift_bucket(100, "pow2", 8, cap=8) is None   # at ceiling
+    assert upshift_bucket(100, "pow2", 8, cap=32) == 16
+    assert upshift_bucket(5, (4, 16, 64), 4) == 16
+    assert upshift_bucket(100, (4, 16, 64), 64) is None    # ladder top
+    assert upshift_bucket(100, None, 4) is None    # bucketing off
+    assert upshift_bucket(9, "pow2", 4, mesh_size=8) == 8
+    assert upshift_bucket(5, (4, 6, 8), 4, mesh_size=4) == 8  # 6 skipped
+
+
+def test_capacity_knob_validation():
+    y0s, cfgs = _decay_setup(B=4)
+    kw = dict(segment_steps=16, max_segments=8)
+    with pytest.raises(ValueError, match="upshift= climbs the buckets"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 admission=2, upshift=8, **kw)
+    with pytest.raises(ValueError, match="upshift must be an int"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 admission=4, buckets="pow2", upshift=2,
+                                 **kw)
+    with pytest.raises(ValueError, match="upshift_patience"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 admission=2, buckets="pow2", upshift=8,
+                                 upshift_patience=0, **kw)
+    with pytest.raises(ValueError, match="local device"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 admission=2, mesh_resident=99, **kw)
+    # the capacity knobs are streaming-only gear, loud elsewhere
+    with pytest.raises(ValueError, match="mesh_resident"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 mesh_resident=1, **kw)
+    with pytest.raises(ValueError, match="upshift"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 upshift=8, **kw)
+    with pytest.raises(ValueError, match="_live_source"):
+        ensemble_solve_segmented(_decay_rhs, y0s, 0.0, 1.0, cfgs,
+                                 _live_source="sweep-e1", **kw)
+
+
+def _upshift_run(recorder=None, watch=None):
+    """A backlog that outgrows its seed bucket: 2 resident slots, 6
+    backlog lanes, ceiling 8 — the autoscaler must climb 2 -> 4 -> 8
+    on the pow2 ladder to absorb it."""
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (8, 2))
+    cfgs = {"k": jnp.asarray([10.0, 20.0, 40.0, 80.0] * 2)}
+    return ensemble_solve_segmented(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, segment_steps=8,
+        max_segments=160, poll_every=1, admission=2, refill=1,
+        buckets="pow2", upshift=8, upshift_patience=1, stats=True,
+        recorder=recorder, watch=watch)
+
+
+def test_bucket_upshift_fires_and_warm_ladder_zero_compiles():
+    """Acceptance: the up-shift fires under sustained backlog, every
+    lane still solves, and on a WARMED ladder (every rung's programs
+    already compiled) the whole multi-shift stream records zero
+    compiles and zero retraces under CompileWatch — the migration is an
+    executable switch, never a compile."""
+    from batchreactor_tpu.obs import CompileWatch, Recorder
+
+    warm = _upshift_run()          # bakes every rung's programs
+    assert np.all(np.asarray(warm.status) == SUCCESS)
+    rec = Recorder()
+    watch = CompileWatch(recorder=rec, default_label="test")
+    with watch:
+        res = _upshift_run(recorder=rec, watch=watch)
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    _s, events, ctrs = rec.snapshot()
+    assert ctrs["bucket_upshifts"] >= 1
+    w = watch.summary()
+    assert w["compiles"] == 0 and w["retraces"] == 0, w
+    # the shift event carries the migration's shape evidence
+    ups = [e for e in events if e["name"] == "bucket_upshift"]
+    assert ups and all(e["attrs"]["bucket"] > 2 for e in ups)
+    # determinism: the warmed re-run reproduces the first run exactly
+    _assert_bit_exact(warm, res, "upshift warm re-run")
+
+
+def test_upshift_hysteresis_no_thrash():
+    """An oscillating backlog must not thrash the ladder: a single-lane
+    trickle (blips that never exceed the next rung's headroom) climbs
+    nothing; one sustained burst climbs monotonically — at most one
+    shift per rung — and the stream never re-climbs after its post-burst
+    down-shift (the patience + cooldown damping)."""
+    from batchreactor_tpu.obs import Recorder
+
+    state = {"calls": 0, "fed": 0, "burst": False}
+    one = (np.asarray([[1.0, 0.5]]), {"k": np.asarray([30.0])})
+
+    def feed(n_space, idle):
+        state["calls"] += 1
+        p = state["calls"]
+        if p < 11:
+            # trickle: one lane per consultation — the backlog never
+            # exceeds the next rung's headroom, so nothing qualifies
+            state["fed"] += 1
+            return one
+        if not state["burst"]:
+            # ONE sustained burst, sized to the driver's over-ask
+            # (feed contract: k <= n_space)
+            state["burst"] = True
+            k = min(int(n_space), 8)
+            state["fed"] += k
+            return (np.broadcast_to(np.asarray([1.0, 0.5]),
+                                    (k, 2)).copy(),
+                    {"k": np.logspace(1.0, 1.9, k)})
+        if p % 5 == 0 and p <= 40:
+            state["fed"] += 1          # post-burst blips: must not re-climb
+            return one
+        if idle:
+            return None                # drained: close the feed
+        return (np.zeros((0, 2)), {"k": np.zeros((0,))})
+
+    rec = Recorder()
+    y0s, cfgs = _decay_setup(B=2)
+    res = ensemble_solve_segmented(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, segment_steps=8,
+        max_segments=2000, poll_every=1, admission=2, refill=1,
+        buckets="pow2", upshift=8, upshift_patience=2, _feed=feed,
+        recorder=rec)
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    assert np.asarray(res.status).shape[0] == 2 + state["fed"]
+    _s, events, ctrs = rec.snapshot()
+    # the pow2 climb 2 -> 8 is at most two shifts; a thrashing ladder
+    # would re-climb after down-shifting and exceed it
+    assert 1 <= ctrs["bucket_upshifts"] <= 2, ctrs
+    shifts = [e["name"] for e in events
+              if e["name"] in ("bucket_upshift", "bucket_downshift")]
+    first_down = (shifts.index("bucket_downshift")
+                  if "bucket_downshift" in shifts else len(shifts))
+    assert "bucket_upshift" not in shifts[first_down:], shifts
+
+
+def _mesh_resident_pair(mr):
+    from batchreactor_tpu.obs import Recorder
+
+    y0s, cfgs = _decay_setup(B=6)
+    rec = Recorder()
+    res = ensemble_solve_segmented(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, pipeline=True,
+        segment_steps=16, max_segments=60, stats=True, buckets="pow2",
+        poll_every=1, admission=3, refill=1, mesh_resident=mr,
+        recorder=rec)
+    # drop wall-clock counters (poll_wait_s): only the admission
+    # bookkeeping is results-equivalence material
+    ctrs = {k: v for k, v in rec.snapshot()[2].items()
+            if not k.endswith("_s")}
+    return res, ctrs
+
+
+def test_mesh_resident_one_device_bit_exact():
+    """``mesh_resident=1`` lays the carry out through the NamedSharding
+    path over a single device; that must be bit-exact against the
+    unsharded driver across every field and admission counter — the
+    no-op fork the brlint contract pins at the jaxpr level, asserted
+    here at the results level."""
+    base, base_c = _mesh_resident_pair(None)
+    one, one_c = _mesh_resident_pair(1)
+    _assert_bit_exact(base, one, "mesh_resident=1 vs None")
+    assert base_c == one_c
+
+
+def test_mesh_resident_multi_device_shard():
+    """``mesh_resident=True`` shards the resident program over ALL local
+    devices (8 virtual CPU devices under conftest's harness).  Cross-
+    shard vectorization is the documented ulp-class caveat (module
+    docstring), so the sharded run pins statuses, step counts and
+    tolerance-level state rather than bits."""
+    import jax
+
+    assert len(jax.local_devices()) == 8  # conftest harness contract
+    base, base_c = _mesh_resident_pair(None)
+    shard, shard_c = _mesh_resident_pair(True)
+    assert np.all(np.asarray(shard.status) == SUCCESS)
+    assert np.array_equal(np.asarray(base.status),
+                          np.asarray(shard.status))
+    assert np.array_equal(np.asarray(base.n_accepted),
+                          np.asarray(shard.n_accepted))
+    np.testing.assert_allclose(np.asarray(shard.y), np.asarray(base.y),
+                               rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(shard.t), np.asarray(base.t),
+                               rtol=1e-12)
+
+
+def test_mesh_resident_upshift_compose():
+    """The levers stack: a sharded resident program still climbs the
+    (mesh-divisible) ladder under backlog pressure."""
+    from batchreactor_tpu.obs import Recorder
+
+    y0s = jnp.broadcast_to(jnp.asarray([1.0, 0.5]), (8, 2))
+    cfgs = {"k": jnp.logspace(1.0, 1.9, 8)}
+    rec = Recorder()
+    res = ensemble_solve_segmented(
+        _decay_rhs, y0s, 0.0, 1.0, cfgs, segment_steps=8,
+        max_segments=160, poll_every=1, admission=2, refill=1,
+        buckets="pow2", mesh_resident=1, upshift=8, upshift_patience=1,
+        recorder=rec)
+    assert np.all(np.asarray(res.status) == SUCCESS)
+    assert rec.snapshot()[2]["bucket_upshifts"] >= 1

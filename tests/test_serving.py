@@ -137,6 +137,7 @@ class FakeSession:
         self.recorder = Recorder()
         self.registry = None
         self.streams = []          # (t1, rtol, atol) per epoch
+        self.sources = []          # live_source kw per epoch (None = unset)
         self.harvest = harvest
         self.chunk = chunk
         self.hold = hold           # threading.Event gating the epoch
@@ -148,8 +149,10 @@ class FakeSession:
         y0 = np.stack([np.asarray(req.T), np.asarray(req.Asv)], axis=1)
         return y0, {"T": np.asarray(req.T), "Asv": np.asarray(req.Asv)}
 
-    def stream(self, y0s, cfgs, *, t1, rtol, atol, on_harvest, feed):
+    def stream(self, y0s, cfgs, *, t1, rtol, atol, on_harvest, feed,
+               **kw):
         self.streams.append((t1, rtol, atol))
+        self.sources.append(kw.get("live_source"))
         if self.hold is not None:
             self.hold.wait(5.0)
         if self.fail:
@@ -429,6 +432,81 @@ class TestAdaptiveCoalesce:
             sched.drain(5.0)
             assert len(sess.streams) == 1
 
+    def test_adaptive_window_collapses_with_free_slots(self):
+        """ISSUE 20 satellite: when the resident tier can absorb the
+        whole queue RIGHT NOW (free slots >= queued lanes) waiting buys
+        no batch density — the adaptive window collapses toward ZERO,
+        not just the earned fill fraction, so the unsaturated
+        submitted->coalesced stage wait is negligible."""
+        p50 = self._p50_coalesce_wait(adaptive=True)
+        assert p50 <= 0.1, p50
+
+
+class TestMultiEpoch:
+    """Capacity plane (scheduler module doc "Multi-epoch capacity"):
+    ``resident_epochs=N`` runs N resident epochs off ONE shared
+    pack-key queue with pull-based spray — pops are disjoint under the
+    scheduler lock, so the harvest un-shuffle stays exactly-once per
+    request no matter which epoch pulled it."""
+
+    def test_two_epochs_spray_and_unshuffle(self):
+        """Both epochs seed disjoint slices of one queued burst (held
+        open so the spray is observable), harvests arrive scrambled and
+        chunked inside each epoch, and every request still resolves
+        with ITS lanes in ITS order."""
+        hold = threading.Event()
+        sess = FakeSession(harvest="scramble", chunk=2, hold=hold,
+                           resident_epochs=2, idle_timeout_s=0.05)
+        sched = Scheduler(sess)
+        assert sched.epochs == 2 and len(sched._workers) == 2
+        # queue BEFORE start so the seed pops race for real: 9 lanes
+        # against two bucket_cap=4 epochs — neither epoch can take it all
+        futs = [sched.submit(_request(f"m{i}",
+                                      [1000.0 * (i + 1) + j
+                                       for j in range(1 + i % 2)]))
+                for i in range(6)]
+        sched.start()
+        deadline = time.monotonic() + 5.0
+        while len(sess.streams) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(sess.streams) == 2   # both epochs took a seed
+        hold.set()
+        res = _results(futs)
+        sched.drain(5.0)
+        for i, r in enumerate(res):
+            assert all(p == "success" for p in r.provenance)
+            np.testing.assert_array_equal(
+                r.y[:, 0], [1000.0 * (i + 1) + j + 1000.0
+                            for j in range(1 + i % 2)])
+        # each epoch published under its own live source
+        assert sorted(sess.sources) == ["sweep-e0", "sweep-e1"]
+        _s, _e, counters = sess.recorder.snapshot()
+        assert counters["epoch_spray"] >= 1   # the sibling pulled lanes
+        assert counters["serve_answered"] == 6
+
+    def test_single_epoch_stream_signature_unchanged(self):
+        """``resident_epochs=1`` (the default) is byte-identical to the
+        pre-multi-epoch scheduler at the session boundary: a session
+        pinned to the OLD ``stream`` signature (no ``**kw``) serves
+        unchanged, on the same single worker thread name."""
+        class StrictSession(FakeSession):
+            def stream(self, y0s, cfgs, *, t1, rtol, atol, on_harvest,
+                       feed):
+                return FakeSession.stream(
+                    self, y0s, cfgs, t1=t1, rtol=rtol, atol=atol,
+                    on_harvest=on_harvest, feed=feed)
+
+        sess = StrictSession()
+        sched = Scheduler(sess).start()
+        r = sched.submit(_request("a", [1000.0])).result(5.0)
+        sched.drain(5.0)
+        assert r.provenance == ["success"]
+        assert sess.sources == [None]   # no live_source kw at N=1
+        assert sched.epochs == 1 and len(sched._workers) == 1
+        assert sched._worker.name == "br-serve-scheduler"
+        _s, _e, counters = sess.recorder.snapshot()
+        assert "epoch_spray" not in counters
+
 
 # --------------------------------------------------------------------------
 # end-to-end: real session, real HTTP, vendored h2o2 fixture
@@ -593,6 +671,88 @@ class TestServingEndToEnd:
         moving = {st for st in states
                   if any(v is not None for v in st)}
         assert len(moving) >= 2, (len(scrapes), states)
+
+    def test_two_epoch_daemon_bit_exact_zero_compiles(self,
+                                                      h2o2_session):
+        """ISSUE 20 acceptance (the CI serve-smoke's in-process
+        mirror): a 2-epoch daemon answers two pack keys bit-exact vs
+        the direct sweep per key, with zero armed compiles, and a
+        mid-flight scrape shows ``br_sweep_resident_epochs 2`` plus a
+        per-epoch occupancy gauge."""
+        import batchreactor_tpu as br
+        from batchreactor_tpu.serving.client import SolveClient
+        from batchreactor_tpu.serving.server import ServingServer
+
+        session = h2o2_session
+        N = 8
+        Ts = [1150.0 + 37.0 * i for i in range(N)]
+        t1s = (5e-5, 1e-4)
+        old = session.resident_epochs
+        session.resident_epochs = 2
+        inject.arm("slow_request:delay=0.1,count=4")
+        responses = {}
+        scrapes = []
+        try:
+            sched = Scheduler(session)
+            assert sched.epochs == 2
+            with ServingServer(session, sched) as srv:
+                client = SolveClient(srv.url)
+                stop = threading.Event()
+
+                def scraper():
+                    while not stop.is_set():
+                        try:
+                            scrapes.append(client.metrics())
+                        except OSError:
+                            pass
+                        stop.wait(0.02)
+
+                scr = threading.Thread(target=scraper, daemon=True)
+                scr.start()
+
+                def fire(t1):
+                    responses[t1] = client.solve(
+                        {"id": f"k{t1}", "T": Ts, "X": _COMP,
+                         "t1": t1})
+
+                threads = [threading.Thread(target=fire, args=(t1,))
+                           for t1 in t1s]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                stop.set()
+                scr.join()
+                health = client.healthz()
+        finally:
+            session.resident_epochs = old
+        assert health["serving"]["resident_epochs"] == 2
+        # each key's 8-lane request fills one epoch's bucket-8 program
+        # whole, so per-key results stay bit-exact vs the direct sweep
+        # regardless of which epoch pulled it
+        for t1 in t1s:
+            resp = responses[t1]
+            assert resp["solver_status"] == ["Success"] * N
+            out = br.batch_reactor_sweep(
+                _COMP, np.asarray(Ts), 1e5, t1,
+                chem=br.Chemistry(gaschem=True),
+                thermo_obj=session.thermo, md=session.gm,
+                segment_steps=8, admission=8, refill=1, buckets=(8,),
+                poll_every=1)
+            np.testing.assert_array_equal(resp["t"],
+                                          np.asarray(out["t"]))
+            for sp in session.species:
+                np.testing.assert_array_equal(
+                    resp["x"][sp], np.asarray(out["x"][sp]),
+                    err_msg=f"t1={t1} species {sp}")
+        prog = session.program_compiles()
+        assert all(v == 0 for v in prog.values()), prog
+        # the capacity plane was visible mid-flight
+        assert any("br_sweep_resident_epochs 2" in s
+                   for s in scrapes), len(scrapes)
+        assert any(ln.startswith("br_sweep_lanes_running_e")
+                   for s in scrapes for ln in s.splitlines()), \
+            len(scrapes)
 
     def test_request_level_stats_and_counters(self, h2o2_session):
         from batchreactor_tpu.serving.client import SolveClient
